@@ -1,0 +1,5 @@
+from pipegoose_tpu.distributed.parallel_context import ParallelContext
+from pipegoose_tpu.distributed.parallel_mode import MESH_AXIS_ORDER, ParallelMode
+from pipegoose_tpu.distributed import functional
+
+__all__ = ["ParallelContext", "ParallelMode", "MESH_AXIS_ORDER", "functional"]

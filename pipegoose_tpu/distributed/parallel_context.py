@@ -1,0 +1,190 @@
+"""ParallelContext: device-mesh construction and axis queries.
+
+TPU-native analog of the reference's ``ParallelContext``
+(pipegoose/distributed/parallel_context.py:49-407). The reference builds
+torch.distributed process groups for a TP x PP x DP (x expert) cartesian
+decomposition of the world, plus RPC workers for pipeline transport. On
+TPU all of that collapses into ONE ``jax.sharding.Mesh`` with named axes:
+collectives become XLA HLO ops emitted under ``shard_map``/``jit``, and
+pipeline transport becomes ``lax.ppermute`` inside a compiled program —
+no process groups, no RPC, no per-rank bookkeeping.
+
+Rank-layout parity with the reference (so tests and checkpoints line up):
+a global rank r in the reference decomposes as
+
+    r = pipe_rank * (dp*sp*ep*tp) + data_rank * (sp*ep*tp)
+        + seq_rank * (ep*tp) + expert_rank * tp + tensor_rank
+
+which is exactly ``devices.reshape(pp, dp, sp, ep, tp)`` with axis names
+``(pipe, data, seq, expert, tensor)``:
+
+- TENSOR groups = contiguous blocks of size tp (initialize_tensor.py:27-56)
+- PIPELINE groups = strided by world//pp (initialize_pipeline.py:27-56)
+- DATA groups = strided by tp within a pipe block (initialize_data.py:27-62)
+
+The ``seq`` axis (sequence/context parallelism) is new capability the
+reference only advertised (SURVEY.md §5).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from pipegoose_tpu.distributed.parallel_mode import MESH_AXIS_ORDER, ParallelMode
+
+_GLOBAL_CONTEXT: Optional["ParallelContext"] = None
+
+
+@dataclasses.dataclass
+class ParallelContext:
+    """Holds the device mesh and answers axis-topology queries.
+
+    Replaces the reference's god-object (parallel_context.py:86-137): no
+    ``init_process_group``, no ``new_group`` storms, no RPC bring-up —
+    constructing a Mesh is a purely local, instant operation.
+    """
+
+    tensor_parallel_size: int = 1
+    pipeline_parallel_size: int = 1
+    data_parallel_size: int = 1
+    expert_parallel_size: int = 1
+    sequence_parallel_size: int = 1
+    devices: Optional[Sequence[jax.Device]] = None
+    mesh: Mesh = dataclasses.field(init=False)
+
+    def __post_init__(self) -> None:
+        tp = self.tensor_parallel_size
+        pp = self.pipeline_parallel_size
+        dp = self.data_parallel_size
+        ep = self.expert_parallel_size
+        sp = self.sequence_parallel_size
+        for name, size in [("tensor", tp), ("pipeline", pp), ("data", dp),
+                           ("expert", ep), ("sequence", sp)]:
+            if size < 1:
+                raise ValueError(f"{name}_parallel_size must be >= 1, got {size}")
+
+        devices = list(self.devices) if self.devices is not None else jax.devices()
+        world = tp * pp * dp * ep * sp
+        if len(devices) < world:
+            raise ValueError(
+                f"need tp*pp*dp*ep*sp = {tp}*{pp}*{dp}*{ep}*{sp} = {world} devices, "
+                f"have {len(devices)}"
+                # mirrors the reference's world-size assert (parallel_context.py:101-113)
+            )
+        dev_array = np.asarray(devices[:world], dtype=object).reshape(pp, dp, sp, ep, tp)
+        self.mesh = Mesh(dev_array, MESH_AXIS_ORDER)
+        _set_context(self)
+
+    # -- constructors -------------------------------------------------------
+
+    @classmethod
+    def from_mesh(cls, mesh: Mesh) -> "ParallelContext":
+        """Wrap an existing mesh (axis names must be a subset of ours)."""
+        sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+        ctx = cls.__new__(cls)
+        ctx.tensor_parallel_size = sizes.get("tensor", 1)
+        ctx.pipeline_parallel_size = sizes.get("pipe", 1)
+        ctx.data_parallel_size = sizes.get("data", 1)
+        ctx.expert_parallel_size = sizes.get("expert", 1)
+        ctx.sequence_parallel_size = sizes.get("seq", 1)
+        ctx.devices = list(mesh.devices.flat)
+        ctx.mesh = mesh
+        _set_context(ctx)
+        return ctx
+
+    @classmethod
+    def init_multihost(cls, **kwargs) -> "ParallelContext":
+        """Multi-host bring-up: the analog of the reference's torchrun env-var
+        path (from_torch, parallel_context.py:55-84). ``jax.distributed`` uses
+        its own coordinator discovery (TPU metadata / env vars)."""
+        import jax.distributed
+
+        try:
+            jax.distributed.initialize()
+        except (RuntimeError, ValueError):
+            pass  # already initialized or single-process
+        return cls(**kwargs)
+
+    @classmethod
+    def get_context(cls) -> Optional["ParallelContext"]:
+        """Singleton accessor (reference parallel_context.py:143-146)."""
+        return _GLOBAL_CONTEXT
+
+    # -- axis queries -------------------------------------------------------
+
+    def get_world_size(self, mode: ParallelMode = ParallelMode.GLOBAL) -> int:
+        """Axis size (reference get_world_size, parallel_context.py:324-330)."""
+        if mode == ParallelMode.GLOBAL:
+            return int(np.prod(self.mesh.devices.shape))
+        return self.mesh.shape[mode.axis_name]
+
+    def axis_size(self, axis: str) -> int:
+        return self.mesh.shape[axis]
+
+    def get_local_rank(self, device: jax.Device, mode: ParallelMode) -> int:
+        """Coordinate of ``device`` along the mode's axis. Inside a
+        shard_map'd function use ``jax.lax.axis_index(mode.axis_name)``
+        instead — this host-side query is for placement/checkpoint logic
+        (reference get_local_rank, parallel_context.py:313-317)."""
+        coords = self._device_coords(device)
+        if mode == ParallelMode.GLOBAL:
+            return self.get_global_rank(device)
+        return coords[MESH_AXIS_ORDER.index(mode.axis_name)]
+
+    def get_global_rank(self, device: jax.Device) -> int:
+        idx = np.flatnonzero(self.mesh.devices.flat == device)
+        if idx.size == 0:
+            raise ValueError(f"{device} not in mesh")
+        return int(idx[0])
+
+    def _device_coords(self, device: jax.Device):
+        pos = np.argwhere(self.mesh.devices == device)
+        if pos.size == 0:
+            raise ValueError(f"{device} not in mesh")
+        return tuple(int(c) for c in pos[0])
+
+    def get_ranks_in_group(self, device: jax.Device, mode: ParallelMode):
+        """Global ranks sharing every coordinate with ``device`` except the
+        mode's axis (reference get_ranks_in_group, parallel_context.py:341-353)."""
+        if mode == ParallelMode.GLOBAL:
+            return list(range(self.get_world_size()))
+        coords = list(self._device_coords(device))
+        ax = MESH_AXIS_ORDER.index(mode.axis_name)
+        ranks = []
+        for i in range(self.mesh.devices.shape[ax]):
+            coords[ax] = i
+            ranks.append(self.get_global_rank(self.mesh.devices[tuple(coords)]))
+        return ranks
+
+    def is_first_rank(self, device: jax.Device, mode: ParallelMode) -> bool:
+        return self.get_local_rank(device, mode) == 0
+
+    def is_last_rank(self, device: jax.Device, mode: ParallelMode) -> bool:
+        return self.get_local_rank(device, mode) == self.get_world_size(mode) - 1
+
+    # -- sharding helpers ---------------------------------------------------
+
+    def sharding(self, *spec) -> NamedSharding:
+        return NamedSharding(self.mesh, P(*spec))
+
+    def replicated(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P())
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def destroy(self) -> None:
+        """Reference destroy() tears down process groups + RPC
+        (parallel_context.py:390-407); here only the singleton needs
+        clearing — the mesh owns no OS resources."""
+        global _GLOBAL_CONTEXT
+        if _GLOBAL_CONTEXT is self:
+            _GLOBAL_CONTEXT = None
+
+
+def _set_context(ctx: ParallelContext) -> None:
+    global _GLOBAL_CONTEXT
+    _GLOBAL_CONTEXT = ctx

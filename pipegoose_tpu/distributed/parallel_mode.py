@@ -1,0 +1,39 @@
+"""Parallel axis names.
+
+TPU-native analog of the reference's ``ParallelMode`` enum
+(pipegoose/distributed/parallel_mode.py:4-12). Instead of naming process
+groups, each mode names an axis of a single ``jax.sharding.Mesh``. The
+GLOBAL mode corresponds to the whole mesh (all axes at once).
+
+The reference's EXPERT_DATA group shares its layout with the TENSOR group
+(pipegoose/distributed/_initializers/initialize_expert.py:10-44); here the
+expert axis is a first-class mesh axis instead, with size 1 unless expert
+parallelism is enabled.
+"""
+from __future__ import annotations
+
+import enum
+
+
+class ParallelMode(str, enum.Enum):
+    GLOBAL = "global"
+    TENSOR = "tensor"
+    PIPELINE = "pipe"
+    DATA = "data"
+    EXPERT = "expert"
+    # Long-context/sequence axis — new capability, absent from the reference
+    # (SURVEY.md §5: sequence parallelism advertised but unimplemented).
+    SEQUENCE = "seq"
+
+    @property
+    def axis_name(self) -> str:
+        return self.value
+
+
+# Canonical mesh axis order, outermost first. ``pipe`` is outermost (stage
+# boundaries cross the slowest links), ``tensor`` is innermost so tensor
+# collectives ride the fastest ICI hops — mirroring the reference's layout
+# where TENSOR groups are contiguous rank blocks
+# (initialize_tensor.py:27-56) and PIPELINE groups are strided by
+# world//pp (initialize_pipeline.py:27-56).
+MESH_AXIS_ORDER = ("pipe", "data", "seq", "expert", "tensor")

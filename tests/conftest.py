@@ -1,0 +1,32 @@
+"""Test bootstrap: simulate an 8-device TPU slice with fake CPU devices.
+
+The reference simulated a multi-node cluster by spawning N OS processes
+over gloo/TCP (pipegoose/testing/utils.py:20-41). On TPU the same
+coverage comes from XLA's fake-device flag: one process, 8 CPU devices,
+exercising the *real* jit/shard_map code paths (SURVEY.md §4).
+
+Must run before the first ``import jax`` anywhere in the test session.
+"""
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+import pytest  # noqa: E402
+
+# The environment's sitecustomize may pin jax_platforms to a TPU plugin;
+# tests always run on fake CPU devices, so override via config (env vars
+# alone are not enough once the plugin registered itself).
+jax.config.update("jax_platforms", "cpu")
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected 8 fake CPU devices, got {len(devs)}"
+    return devs

@@ -1,0 +1,75 @@
+"""Mesh layout parity with the reference's group initializers
+(tests modeled on reference tests/distributed/_initializers/* and
+tests/distributed/test_parallel_context.py)."""
+import numpy as np
+import pytest
+
+from pipegoose_tpu.distributed import ParallelContext, ParallelMode
+
+
+def test_world_size_assert(devices):
+    with pytest.raises(ValueError):
+        ParallelContext(tensor_parallel_size=8, data_parallel_size=8)
+
+
+@pytest.mark.parametrize("tp,pp,dp", [(1, 1, 1), (2, 2, 2), (2, 1, 4), (8, 1, 1)])
+def test_axis_sizes(devices, tp, pp, dp):
+    ctx = ParallelContext(
+        tensor_parallel_size=tp, pipeline_parallel_size=pp, data_parallel_size=dp
+    )
+    assert ctx.get_world_size() == tp * pp * dp
+    assert ctx.get_world_size(ParallelMode.TENSOR) == tp
+    assert ctx.get_world_size(ParallelMode.PIPELINE) == pp
+    assert ctx.get_world_size(ParallelMode.DATA) == dp
+    assert ctx.get_world_size(ParallelMode.EXPERT) == 1
+    ctx.destroy()
+
+
+def test_reference_rank_layout(devices):
+    """The reference's group layouts (SURVEY.md §2.1 ProcessGroupInitializer):
+    TENSOR = contiguous blocks of size tp; PIPELINE = strided world//pp;
+    DATA = strided by tp within each pipe block."""
+    tp, pp, dp = 2, 2, 2
+    ctx = ParallelContext(
+        tensor_parallel_size=tp, pipeline_parallel_size=pp, data_parallel_size=dp
+    )
+    world = tp * pp * dp
+    devs = list(ctx.mesh.devices.flat)
+
+    # global rank ordering follows the device list
+    for r, d in enumerate(devs):
+        assert ctx.get_global_rank(d) == r
+
+    # tensor groups: [0,1], [2,3], [4,5], [6,7]
+    assert ctx.get_ranks_in_group(devs[0], ParallelMode.TENSOR) == [0, 1]
+    assert ctx.get_ranks_in_group(devs[5], ParallelMode.TENSOR) == [4, 5]
+    # pipeline groups: strided by world//pp = 4 -> [0,4],[1,5],[2,6],[3,7]
+    assert ctx.get_ranks_in_group(devs[0], ParallelMode.PIPELINE) == [0, 4]
+    assert ctx.get_ranks_in_group(devs[3], ParallelMode.PIPELINE) == [3, 7]
+    # data groups: strided by tp within pipe block -> [0,2],[1,3],[4,6],[5,7]
+    assert ctx.get_ranks_in_group(devs[0], ParallelMode.DATA) == [0, 2]
+    assert ctx.get_ranks_in_group(devs[1], ParallelMode.DATA) == [1, 3]
+    assert ctx.get_ranks_in_group(devs[7], ParallelMode.DATA) == [5, 7]
+
+    # first/last rank queries (reference parallel_context.py:367-383)
+    assert ctx.is_first_rank(devs[0], ParallelMode.TENSOR)
+    assert ctx.is_last_rank(devs[1], ParallelMode.TENSOR)
+    assert not ctx.is_last_rank(devs[0], ParallelMode.PIPELINE)
+    assert ctx.is_last_rank(devs[4], ParallelMode.PIPELINE)
+    ctx.destroy()
+
+
+def test_singleton(devices):
+    ctx = ParallelContext(tensor_parallel_size=2)
+    assert ParallelContext.get_context() is ctx
+    ctx.destroy()
+    assert ParallelContext.get_context() is None
+
+
+def test_from_mesh_roundtrip(devices):
+    ctx = ParallelContext(tensor_parallel_size=2, data_parallel_size=2)
+    ctx2 = ParallelContext.from_mesh(ctx.mesh)
+    assert ctx2.tensor_parallel_size == 2
+    assert ctx2.data_parallel_size == 2
+    assert ctx2.pipeline_parallel_size == 1
+    ctx2.destroy()
