@@ -83,12 +83,15 @@ def reduce_scatter(x, axis_name: Optional[str], dim: int = -1):
 
 def broadcast(x, axis_name: Optional[str], src: int = 0):
     """Every rank gets rank ``src``'s value (reference functional.py:72-91).
-    Implemented as a masked psum — one collective, works for any dtype that
-    sums (floats/ints)."""
+    Implemented as select-then-psum: non-src ranks contribute exact zeros
+    (a multiply would leak NaN/Inf from non-src ranks into every rank)."""
     if _noop(axis_name):
         return x
-    mask = (lax.axis_index(axis_name) == src).astype(x.dtype)
-    return lax.psum(x * mask, axis_name)
+    is_bool = x.dtype == jnp.bool_
+    v = x.astype(jnp.int32) if is_bool else x
+    v = jnp.where(lax.axis_index(axis_name) == src, v, jnp.zeros_like(v))
+    out = lax.psum(v, axis_name)
+    return out.astype(jnp.bool_) if is_bool else out
 
 
 def reduce(x, axis_name: Optional[str], dst: int = 0, op: str = "sum"):

@@ -84,6 +84,12 @@ class ParallelContext:
     @classmethod
     def from_mesh(cls, mesh: Mesh) -> "ParallelContext":
         """Wrap an existing mesh (axis names must be a subset of ours)."""
+        unknown = set(mesh.axis_names) - set(MESH_AXIS_ORDER)
+        if unknown:
+            raise ValueError(
+                f"mesh axis names {sorted(unknown)} are not parallel axes; "
+                f"expected a subset of {MESH_AXIS_ORDER}"
+            )
         sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
         ctx = cls.__new__(cls)
         ctx.tensor_parallel_size = sizes.get("tensor", 1)
@@ -103,10 +109,8 @@ class ParallelContext:
         its own coordinator discovery (TPU metadata / env vars)."""
         import jax.distributed
 
-        try:
+        if not jax.distributed.is_initialized():
             jax.distributed.initialize()
-        except (RuntimeError, ValueError):
-            pass  # already initialized or single-process
         return cls(**kwargs)
 
     @classmethod

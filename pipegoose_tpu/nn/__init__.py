@@ -1,0 +1,24 @@
+from pipegoose_tpu.nn.parallel import Parallel, shard_tree, spec_tree, unshard_tree
+from pipegoose_tpu.nn.parallel_mapping import (
+    Column,
+    Expert,
+    ParallelInfo,
+    ParallelMapping,
+    Replicate,
+    Row,
+    Vocab,
+)
+
+__all__ = [
+    "Parallel",
+    "shard_tree",
+    "spec_tree",
+    "unshard_tree",
+    "ParallelMapping",
+    "ParallelInfo",
+    "Column",
+    "Row",
+    "Vocab",
+    "Expert",
+    "Replicate",
+]

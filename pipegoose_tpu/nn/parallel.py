@@ -1,0 +1,72 @@
+"""Parallel base: pytree sharding utilities.
+
+TPU-native analog of the reference's ``Parallel`` base class
+(pipegoose/nn/parallel.py:19-93). The reference monkey-patches ``.to()``
+onto the torch module and moves shards to the rank's GPU; here
+"parallelize" means: compute a ``PartitionSpec`` pytree for the params
+and ``jax.device_put`` the arrays onto the mesh — XLA then keeps every
+downstream computation sharded. Nothing is mutated and no device
+placement is implicit.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+from jax.tree_util import tree_flatten_with_path, tree_map_with_path
+
+from pipegoose_tpu.distributed.parallel_context import ParallelContext
+
+
+def path_str(path) -> str:
+    """'/'-joined readable param path for a tree_util key path."""
+    parts = []
+    for k in path:
+        if hasattr(k, "key"):
+            parts.append(str(k.key))
+        elif hasattr(k, "idx"):
+            parts.append(str(k.idx))
+        else:
+            parts.append(str(k))
+    return "/".join(parts)
+
+
+def spec_tree(params: Any, spec_fn: Callable[[str, jax.Array], P]) -> Any:
+    """Map every leaf to a PartitionSpec via its path."""
+    return tree_map_with_path(lambda p, x: spec_fn(path_str(p), x), params)
+
+
+def shard_tree(params: Any, specs: Any, ctx: Optional[ParallelContext] = None) -> Any:
+    """Place a (host or replicated) params pytree onto the mesh according
+    to ``specs``. The sharded result is what the reference achieved by
+    slicing weights per rank (parallelizer.py:105-112) — here XLA slices."""
+    ctx = ctx or ParallelContext.get_context()
+    mesh = ctx.mesh
+    return jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, specs
+    )
+
+
+def unshard_tree(params: Any, ctx: Optional[ParallelContext] = None) -> Any:
+    """Gather every leaf back to a fully-replicated array — the analog of
+    the reference's ``deparallelize`` (unimplemented there)."""
+    ctx = ctx or ParallelContext.get_context()
+    rep = NamedSharding(ctx.mesh, P())
+    return jax.tree_util.tree_map(lambda x: jax.device_put(x, rep), params)
+
+
+class Parallel:
+    """Base for the parallelization wrappers (TensorParallel,
+    DataParallel, ...). Subclasses return (sharded_params, specs)."""
+
+    def __init__(self, parallel_context: Optional[ParallelContext] = None):
+        self.parallel_context = parallel_context or ParallelContext.get_context()
+        if self.parallel_context is None:
+            raise ValueError("no ParallelContext; construct one first")
+
+    def parallelize(self, params: Any):
+        raise NotImplementedError
+
+    def deparallelize(self, params: Any):
+        return unshard_tree(params, self.parallel_context)

@@ -1,0 +1,113 @@
+"""Parallelization policy registry.
+
+TPU-native analog of the reference's ``ParallelMapping`` / ``ParallelInfo``
+(pipegoose/nn/parallel_mapping.py:10-37 and
+nn/tensor_parallel/parallel_mapping.py:16-52). The reference substring-
+matches module-name suffixes and mutates matching modules' classes in
+place; here a policy maps *param-path* regexes to declarative roles, and
+the roles translate to ``PartitionSpec`` entries — the params pytree is
+never mutated, and the same table drives GSPMD auto-sharding, shard_map
+manual sharding, and checkpoint resharding.
+"""
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Optional, Sequence
+
+from jax.sharding import PartitionSpec as P
+
+
+@dataclasses.dataclass(frozen=True)
+class ParallelInfo:
+    """Role of one param tensor. ``spec`` gives the mesh-axis name (or
+    None) for each array dimension."""
+
+    role: str  # "column" | "row" | "vocab" | "replicate" | "expert" | custom
+    spec: P
+
+
+# roles for a kernel stored (in_features, out_features), JAX convention
+def Column(axis: str = "tensor") -> ParallelInfo:
+    """Shard OUT dim (reference ColumnParallelLinear weight dim-0 slice in
+    torch's (out, in) layout, parallelizer.py:105-108)."""
+    return ParallelInfo("column", P(None, axis))
+
+
+def Row(axis: str = "tensor") -> ParallelInfo:
+    """Shard IN dim (reference RowParallelLinear weight dim-1 slice,
+    parallelizer.py:109-112)."""
+    return ParallelInfo("row", P(axis, None))
+
+
+def Vocab(axis: str = "tensor") -> ParallelInfo:
+    """Shard vocab (dim 0) of an embedding table (reference
+    EmbeddingParallelizer, parallelizer.py:114-170)."""
+    return ParallelInfo("vocab", P(axis, None))
+
+
+def ColumnBias(axis: str = "tensor") -> ParallelInfo:
+    return ParallelInfo("column_bias", P(axis))
+
+
+def Replicate() -> ParallelInfo:
+    return ParallelInfo("replicate", P())
+
+
+def Expert(axis: str = "expert") -> ParallelInfo:
+    """Shard the leading num_experts dim over the expert axis."""
+    return ParallelInfo("expert", P(axis, None, None))
+
+
+class ParallelMapping:
+    """Ordered (pattern -> ParallelInfo) table; first match wins,
+    unmatched params replicate. Patterns are regexes over the
+    '/'-joined param path (reference _search, parallel_mapping.py:12-37,
+    which substring-matched the last two dotted name segments)."""
+
+    def __init__(self, rules: Sequence[tuple[str, ParallelInfo]]):
+        self.rules = [(re.compile(pat), info) for pat, info in rules]
+
+    def search(self, path: str) -> Optional[ParallelInfo]:
+        for pat, info in self.rules:
+            if pat.search(path):
+                return info
+        return None
+
+    def spec_for(self, path: str, ndim: Optional[int] = None) -> P:
+        """PartitionSpec for a param. Pass ``ndim`` to get the rank-aware
+        spec: a column layer shards its 1-d bias (it lives on the OUT
+        dim) while a row layer replicates its bias, added after the
+        all-reduce — the reference's slicing rules
+        (parallelizer.py:105-112, linear.py:74-82)."""
+        info = self.search(path)
+        if info is None:
+            return P()
+        if ndim is None:
+            return info.spec
+        is_1d = ndim == 1
+        if info.role == "column":
+            return P(info.spec[1]) if is_1d else info.spec
+        if info.role == "row":
+            return P() if is_1d else info.spec
+        if is_1d and len(info.spec) > 1:
+            return P(*info.spec[:1])
+        return info.spec
+
+    # convenience predicates, mirroring the reference API
+    # (parallel_mapping.py:40-74: is_column_parallel/is_row_parallel/...)
+    def _role(self, path: str) -> Optional[str]:
+        info = self.search(path)
+        return info.role if info else None
+
+    def is_column_parallel(self, path: str) -> bool:
+        return self._role(path) in ("column", "column_bias")
+
+    def is_row_parallel(self, path: str) -> bool:
+        return self._role(path) == "row"
+
+    def is_vocab_parallel(self, path: str) -> bool:
+        return self._role(path) == "vocab"
+
+    def is_expert(self, path: str) -> bool:
+        return self._role(path) == "expert"

@@ -1,0 +1,18 @@
+from pipegoose_tpu.nn.tensor_parallel.layers import (
+    column_parallel_linear,
+    layer_norm,
+    row_parallel_linear,
+    vocab_parallel_cross_entropy,
+    vocab_parallel_embedding,
+)
+from pipegoose_tpu.nn.tensor_parallel.tensor_parallel import TensorParallel, pad_vocab
+
+__all__ = [
+    "column_parallel_linear",
+    "row_parallel_linear",
+    "layer_norm",
+    "vocab_parallel_embedding",
+    "vocab_parallel_cross_entropy",
+    "TensorParallel",
+    "pad_vocab",
+]

@@ -1,0 +1,55 @@
+"""TensorParallel: shard a params pytree along the tensor axis.
+
+TPU-native analog of the reference's ``TensorParallel`` wrapper
+(pipegoose/nn/tensor_parallel/tensor_parallel.py:18-82) and its
+``ModuleParallelizer`` subclasses (parallelizer.py:61-229). The reference
+walks leaf modules and re-classes them in place; here ``parallelize``
+maps the params pytree through the policy table to PartitionSpecs and
+device_puts the arrays. Vocab padding (EmbeddingParallelizer,
+parallelizer.py:125-141) becomes an explicit ``pad_vocab`` helper.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from pipegoose_tpu.distributed.parallel_context import ParallelContext
+from pipegoose_tpu.nn.parallel import Parallel, shard_tree, spec_tree
+from pipegoose_tpu.nn.parallel_mapping import ParallelMapping
+
+
+class TensorParallel(Parallel):
+    def __init__(
+        self,
+        mapping: ParallelMapping,
+        parallel_context: Optional[ParallelContext] = None,
+    ):
+        super().__init__(parallel_context)
+        self.mapping = mapping
+
+    def specs(self, params: Any) -> Any:
+        """PartitionSpec pytree for ``params`` (first policy match wins;
+        unmatched params replicate — the reference simply skipped modules
+        with no parallelizer, tensor_parallel.py:71-75).
+
+        Bias handling mirrors the reference's slicing rules
+        (parallelizer.py:105-112) via the rank-aware
+        ``ParallelMapping.spec_for``."""
+        return spec_tree(params, lambda path, x: self.mapping.spec_for(path, x.ndim))
+
+    def parallelize(self, params: Any):
+        specs = self.specs(params)
+        return shard_tree(params, specs, self.parallel_context), specs
+
+
+def pad_vocab(weight: jax.Array, multiple: int) -> jax.Array:
+    """Pad embedding rows so vocab divides the tensor axis (reference
+    EmbeddingParallelizer._resize_vocab_size, parallelizer.py:125-141)."""
+    vocab = weight.shape[0]
+    rem = (-vocab) % multiple
+    if rem == 0:
+        return weight
+    return jnp.pad(weight, ((0, rem),) + ((0, 0),) * (weight.ndim - 1))
