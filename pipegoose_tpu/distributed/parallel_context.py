@@ -107,10 +107,20 @@ class ParallelContext:
         """Multi-host bring-up: the analog of the reference's torchrun env-var
         path (from_torch, parallel_context.py:55-84). ``jax.distributed`` uses
         its own coordinator discovery (TPU metadata / env vars)."""
+        import warnings
+
         import jax.distributed
 
         if not jax.distributed.is_initialized():
-            jax.distributed.initialize()
+            try:
+                jax.distributed.initialize()
+            except RuntimeError as e:
+                # no coordinator configured — single-process dev run
+                warnings.warn(
+                    f"jax.distributed.initialize failed ({e}); continuing "
+                    "single-process. Multi-host runs need coordinator env "
+                    "vars or TPU metadata."
+                )
         return cls(**kwargs)
 
     @classmethod

@@ -1,0 +1,4 @@
+from pipegoose_tpu.models import bloom
+from pipegoose_tpu.models.bloom import BloomConfig
+
+__all__ = ["bloom", "BloomConfig"]

@@ -1,0 +1,340 @@
+"""BLOOM, TPU-native.
+
+The reference wraps HuggingFace's torch ``BloomForCausalLM`` and rewrites
+its modules in place (pipegoose/nn/tensor_parallel/tensor_parallel.py:18-82);
+BLOOM is its supported model family (reference README.md:19). Here BLOOM
+is implemented from scratch in pure JAX, designed for the MXU and for
+4D sharding:
+
+- per-layer params are STACKED on a leading ``n_layer`` dim and the
+  forward scans over them (``lax.scan`` + optional ``jax.checkpoint``):
+  one compiled block regardless of depth, and pipeline stages slice the
+  leading dim instead of torch.fx graph surgery
+  (vs reference partitioner.py:29-219).
+- attention/MLP use the tensor-parallel layer functions, so the same
+  code runs single-device (``tp_axis=None``) or inside ``shard_map``
+  with head- and vocab-sharded params.
+- matmuls accumulate in fp32 (``preferred_element_type``), activations
+  can be bf16; softmax and layernorm stats are always fp32.
+
+Semantics match HF ``modeling_bloom`` (gelu-tanh MLP, fused qkv in
+[n_head, 3, head_dim] layout, alibi from mask positions, fp32 softmax,
+pre-LN residuals with ``apply_residual_connection_post_layernorm=False``)
+so HF checkpoints load exactly; parity is tested against the torch
+implementation in tests/models/test_bloom.py.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from functools import partial
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pipegoose_tpu.nn.parallel_mapping import (
+    Column,
+    ParallelMapping,
+    Row,
+    Vocab,
+)
+from pipegoose_tpu.nn.tensor_parallel.layers import (
+    column_parallel_linear,
+    layer_norm,
+    row_parallel_linear,
+    vocab_parallel_cross_entropy,
+    vocab_parallel_embedding,
+)
+
+NEG_INF = -1e9
+
+
+@dataclasses.dataclass(frozen=True)
+class BloomConfig:
+    vocab_size: int = 250880
+    hidden_size: int = 64
+    n_layer: int = 2
+    n_head: int = 8
+    layer_norm_epsilon: float = 1e-5
+    initializer_range: float = 0.02
+    # dtype of activations/params at run time; f32 for parity tests,
+    # bf16 for TPU throughput
+    dtype: Any = jnp.float32
+    # rematerialize each block's activations in backward (HBM for FLOPs)
+    remat: bool = False
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.n_head
+
+    @classmethod
+    def bloom_560m(cls, **kw) -> "BloomConfig":
+        return cls(vocab_size=250880, hidden_size=1024, n_layer=24, n_head=16, **kw)
+
+
+# -- init ------------------------------------------------------------------
+
+def init_params(config: BloomConfig, key: jax.Array) -> dict:
+    """Random init matching HF's scheme (normal(0, initializer_range) for
+    dense/embedding, zeros bias, ones/zeros layernorm)."""
+    h, v, L = config.hidden_size, config.vocab_size, config.n_layer
+    std = config.initializer_range
+    dt = config.dtype
+    ks = jax.random.split(key, 6)
+
+    def dense(k, shape):
+        return (jax.random.normal(k, shape) * std).astype(dt)
+
+    def ln():
+        return {"scale": jnp.ones(h, dt), "bias": jnp.zeros(h, dt)}
+
+    def ln_stack():
+        return {"scale": jnp.ones((L, h), dt), "bias": jnp.zeros((L, h), dt)}
+
+    return {
+        "embed": {"weight": dense(ks[0], (v, h))},
+        "embed_ln": ln(),
+        "blocks": {
+            "ln_1": ln_stack(),
+            "attn": {
+                "qkv": {
+                    "kernel": dense(ks[1], (L, h, 3 * h)),
+                    "bias": jnp.zeros((L, 3 * h), dt),
+                },
+                "out": {
+                    "kernel": dense(ks[2], (L, h, h)),
+                    "bias": jnp.zeros((L, h), dt),
+                },
+            },
+            "ln_2": ln_stack(),
+            "mlp": {
+                "up": {
+                    "kernel": dense(ks[3], (L, h, 4 * h)),
+                    "bias": jnp.zeros((L, 4 * h), dt),
+                },
+                "down": {
+                    "kernel": dense(ks[4], (L, 4 * h, h)),
+                    "bias": jnp.zeros((L, h), dt),
+                },
+            },
+        },
+        "ln_f": {"scale": jnp.ones(h, dt), "bias": jnp.zeros(h, dt)},
+    }
+
+
+# -- alibi -----------------------------------------------------------------
+
+def alibi_slopes(n_head: int) -> np.ndarray:
+    """Per-head slopes from the ALiBi paper's geometric recipe (matches
+    HF build_alibi_tensor's closest-power-of-2 construction)."""
+    closest = 2 ** math.floor(math.log2(n_head))
+    base = 2.0 ** (-(2.0 ** -(math.log2(closest) - 3)))
+    slopes = [base ** i for i in range(1, closest + 1)]
+    if closest != n_head:
+        extra_base = 2.0 ** (-(2.0 ** -(math.log2(2 * closest) - 3)))
+        n_extra = min(closest, n_head - closest)
+        slopes += [extra_base ** i for i in range(1, 2 * n_extra, 2)]
+    return np.asarray(slopes, dtype=np.float32)
+
+
+def build_alibi(attention_mask: jax.Array, n_head: int) -> jax.Array:
+    """(B, n_head, 1, S) bias: slope * key position, where position is the
+    mask-aware index ``(cumsum(mask)-1)*mask``. Constant per query row, so
+    softmax translation-invariance makes it equivalent to relative bias
+    under the causal mask."""
+    slopes = jnp.asarray(alibi_slopes(n_head))
+    pos = (jnp.cumsum(attention_mask, axis=-1) - 1) * attention_mask  # (B,S)
+    return slopes[None, :, None, None] * pos[:, None, None, :].astype(jnp.float32)
+
+
+def bloom_gelu(x: jax.Array) -> jax.Array:
+    """Megatron-style tanh gelu. Deliberately uses HF's truncated constant
+    0.79788456 (not jax.nn.gelu's full-precision sqrt(2/pi)) so logits
+    match HF bit-for-bit in the parity tests."""
+    return x * 0.5 * (1.0 + jnp.tanh(0.79788456 * x * (1.0 + 0.044715 * x * x)))
+
+
+# -- forward ---------------------------------------------------------------
+
+def _attention(
+    blk: dict,
+    x: jax.Array,
+    alibi: jax.Array,
+    mask_bias: jax.Array,
+    config: BloomConfig,
+    tp_axis: Optional[str],
+) -> jax.Array:
+    """Self-attention with heads sharded over ``tp_axis``. qkv is
+    column-parallel, the output projection row-parallel — the Megatron
+    pattern the reference applies by module surgery
+    (tensor_parallel/parallel_mapping.py:23-31)."""
+    b, s, _ = x.shape
+    hd = config.head_dim
+    tp = jax.lax.axis_size(tp_axis) if tp_axis else 1
+    if config.n_head % tp != 0:
+        raise ValueError(
+            f"n_head={config.n_head} must be divisible by the tensor axis "
+            f"size {tp} (whole heads per shard)"
+        )
+    local_heads = config.n_head // tp
+
+    fused = column_parallel_linear(blk["qkv"], x, tp_axis)  # (B,S,3H/tp)
+    fused = fused.reshape(b, s, local_heads, 3, hd)
+    q, k, v = fused[..., 0, :], fused[..., 1, :], fused[..., 2, :]
+
+    # local head slice of the alibi bias
+    if tp_axis:
+        h0 = jax.lax.axis_index(tp_axis) * local_heads
+        alibi = jax.lax.dynamic_slice_in_dim(alibi, h0, local_heads, axis=1)
+
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
+    scores = scores * (1.0 / math.sqrt(hd)) + alibi + mask_bias
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(x.dtype)
+    ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, v, preferred_element_type=jnp.float32)
+    ctx = ctx.astype(x.dtype).reshape(b, s, local_heads * hd)
+    return row_parallel_linear(blk["out"], ctx, tp_axis)
+
+
+def _block(
+    blk: dict,
+    x: jax.Array,
+    alibi: jax.Array,
+    mask_bias: jax.Array,
+    config: BloomConfig,
+    tp_axis: Optional[str],
+) -> jax.Array:
+    """One transformer block, HF BloomBlock ordering (pre-LN, residual
+    from the un-normalized stream)."""
+    eps = config.layer_norm_epsilon
+    ln1 = layer_norm(blk["ln_1"], x, eps)
+    x = x + _attention(blk["attn"], ln1, alibi, mask_bias, config, tp_axis)
+    ln2 = layer_norm(blk["ln_2"], x, eps)
+    h = column_parallel_linear(blk["mlp"]["up"], ln2, tp_axis)
+    h = bloom_gelu(h)
+    x = x + row_parallel_linear(blk["mlp"]["down"], h, tp_axis)
+    return x
+
+
+def forward_hidden(
+    params: dict,
+    input_ids: jax.Array,
+    attention_mask: Optional[jax.Array],
+    config: BloomConfig,
+    tp_axis: Optional[str] = None,
+) -> jax.Array:
+    """Embedding -> scanned blocks -> final LN. Returns (B, S, H)."""
+    b, s = input_ids.shape
+    if attention_mask is None:
+        attention_mask = jnp.ones((b, s), dtype=jnp.int32)
+
+    x = vocab_parallel_embedding(params["embed"], input_ids, tp_axis)
+    x = x.astype(config.dtype)
+    x = layer_norm(params["embed_ln"], x, config.layer_norm_epsilon)
+
+    alibi = build_alibi(attention_mask, config.n_head)
+    causal = jnp.tril(jnp.ones((s, s), dtype=bool))
+    keep = causal[None, None] & (attention_mask[:, None, None, :] > 0)
+    mask_bias = jnp.where(keep, 0.0, NEG_INF).astype(jnp.float32)
+
+    block = partial(_block, config=config, tp_axis=tp_axis)
+    if config.remat:
+        block = jax.checkpoint(block)
+
+    def scan_fn(carry, blk):
+        return block(blk, carry, alibi, mask_bias), None
+
+    x, _ = jax.lax.scan(scan_fn, x, params["blocks"])
+    return layer_norm(params["ln_f"], x, config.layer_norm_epsilon)
+
+
+def logits_fn(
+    params: dict,
+    hidden: jax.Array,
+    tp_axis: Optional[str] = None,
+) -> jax.Array:
+    """LM head tied to the (vocab-sharded) embedding: local logits are the
+    local vocab shard — exactly what vocab_parallel_cross_entropy expects.
+    Mirrors the reference's tied LMHead handling (parallelizer.py:205-211).
+
+    The f-operator (copy_to_tensor_group) on ``hidden`` is load-bearing:
+    in backward, each rank's hidden cotangent is only the partial sum over
+    its local vocab shard, and the f-operator's all-reduce completes it —
+    without it every gradient upstream of the LM head is wrong under TP."""
+    from pipegoose_tpu.distributed.functional import copy_to_tensor_group
+
+    if tp_axis:
+        hidden = copy_to_tensor_group(hidden, tp_axis)
+    w = params["embed"]["weight"]  # (V/tp, H) under TP
+    out = jnp.einsum("bsh,vh->bsv", hidden, w, preferred_element_type=jnp.float32)
+    return out
+
+
+def forward(
+    params: dict,
+    input_ids: jax.Array,
+    attention_mask: Optional[jax.Array],
+    config: BloomConfig,
+    tp_axis: Optional[str] = None,
+) -> jax.Array:
+    """Full causal-LM forward -> local-vocab-shard logits (B, S, V/tp)."""
+    hidden = forward_hidden(params, input_ids, attention_mask, config, tp_axis)
+    return logits_fn(params, hidden, tp_axis)
+
+
+def loss_fn(
+    params: dict,
+    input_ids: jax.Array,
+    attention_mask: Optional[jax.Array],
+    labels: jax.Array,
+    config: BloomConfig,
+    tp_axis: Optional[str] = None,
+) -> jax.Array:
+    """Next-token cross entropy (shift-by-one), masked by attention_mask,
+    vocab-parallel over ``tp_axis``."""
+    logits = forward(params, input_ids, attention_mask, config, tp_axis)
+    shift_logits = logits[:, :-1]
+    shift_labels = labels[:, 1:]
+    per_tok = vocab_parallel_cross_entropy(shift_logits, shift_labels, tp_axis)
+    if attention_mask is not None:
+        w = attention_mask[:, 1:].astype(per_tok.dtype)
+        return (per_tok * w).sum() / jnp.maximum(w.sum(), 1)
+    return per_tok.mean()
+
+
+# -- TP policy -------------------------------------------------------------
+
+def tp_mapping(axis: str = "tensor") -> ParallelMapping:
+    """Partition policy for the BLOOM params tree — the analog of the
+    reference's per-model __MAPPING__ table
+    (tensor_parallel/parallel_mapping.py:16-52): qkv/up column, out/down
+    row, embedding vocab-sharded (head-contiguous qkv layout keeps whole
+    heads per shard; requires n_head % tp == 0)."""
+    return ParallelMapping(
+        [
+            (r"blocks/attn/qkv", Column(axis)),
+            (r"blocks/attn/out", Row(axis)),
+            (r"blocks/mlp/up", Column(axis)),
+            (r"blocks/mlp/down", Row(axis)),
+            (r"embed/weight", Vocab(axis)),
+        ]
+    )
+
+
+def tp_specs(params: dict, axis: str = "tensor") -> dict:
+    """PartitionSpec pytree for the stacked-layer params layout. The
+    stacked leading n_layer dim shifts every kernel spec right by one."""
+    from jax.sharding import PartitionSpec as P
+
+    from pipegoose_tpu.nn.parallel import spec_tree
+
+    mapping = tp_mapping(axis)
+
+    def spec_fn(path, x):
+        if "blocks" in path:
+            base = mapping.spec_for(path, x.ndim - 1)
+            return P(None, *base)
+        return mapping.spec_for(path, x.ndim)
+
+    return spec_tree(params, spec_fn)

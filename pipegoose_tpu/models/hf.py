@@ -1,0 +1,144 @@
+"""HuggingFace checkpoint interop.
+
+The reference consumes HF torch models directly and mutates them
+(tensor_parallel.py:27-42); here HF weights are *converted once* into the
+framework's stacked-pytree layout. Torch is only imported inside these
+functions — the training path never touches it.
+
+Layout notes:
+- torch Linear stores (out, in); JAX kernels are (in, out) -> transpose.
+- per-layer tensors are stacked on a leading n_layer axis (models/bloom.py).
+- the fused qkv keeps HF's [n_head, 3, head_dim] output layout, so
+  head-contiguous TP slicing stays correct.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+import numpy as np
+
+from pipegoose_tpu.models.bloom import BloomConfig
+
+
+def _t(x) -> np.ndarray:
+    x = x.detach().cpu()
+    if str(x.dtype) == "torch.bfloat16":  # torch bf16 has no .numpy()
+        x = x.float()
+    return np.asarray(x.numpy())
+
+
+def bloom_config_from_hf(hf_config, **overrides) -> BloomConfig:
+    return BloomConfig(
+        vocab_size=hf_config.vocab_size,
+        hidden_size=hf_config.hidden_size,
+        n_layer=hf_config.n_layer,
+        n_head=hf_config.n_head,
+        layer_norm_epsilon=hf_config.layer_norm_epsilon,
+        initializer_range=hf_config.initializer_range,
+        **overrides,
+    )
+
+
+def bloom_params_from_hf(model: Any, dtype=jnp.float32) -> tuple[BloomConfig, dict]:
+    """Convert an HF ``BloomForCausalLM`` (or ``BloomModel``) to the
+    stacked params pytree. The lm_head is tied to the embedding in BLOOM,
+    so only the embedding table is stored (reference LMHeadParallelizer
+    tied-weight handling, parallelizer.py:205-211)."""
+    sd = {k: v for k, v in model.state_dict().items()}
+    prefix = "transformer." if any(k.startswith("transformer.") for k in sd) else ""
+    cfg = bloom_config_from_hf(model.config, dtype=dtype)
+    L = cfg.n_layer
+
+    def get(name):
+        return _t(sd[prefix + name])
+
+    def stack(fmt, transpose=False):
+        mats = [get(fmt.format(i)) for i in range(L)]
+        if transpose:
+            mats = [m.T for m in mats]
+        return jnp.asarray(np.stack(mats), dtype=dtype)
+
+    params = {
+        "embed": {"weight": jnp.asarray(get("word_embeddings.weight"), dtype=dtype)},
+        "embed_ln": {
+            "scale": jnp.asarray(get("word_embeddings_layernorm.weight"), dtype=dtype),
+            "bias": jnp.asarray(get("word_embeddings_layernorm.bias"), dtype=dtype),
+        },
+        "blocks": {
+            "ln_1": {
+                "scale": stack("h.{}.input_layernorm.weight"),
+                "bias": stack("h.{}.input_layernorm.bias"),
+            },
+            "attn": {
+                "qkv": {
+                    "kernel": stack("h.{}.self_attention.query_key_value.weight", transpose=True),
+                    "bias": stack("h.{}.self_attention.query_key_value.bias"),
+                },
+                "out": {
+                    "kernel": stack("h.{}.self_attention.dense.weight", transpose=True),
+                    "bias": stack("h.{}.self_attention.dense.bias"),
+                },
+            },
+            "ln_2": {
+                "scale": stack("h.{}.post_attention_layernorm.weight"),
+                "bias": stack("h.{}.post_attention_layernorm.bias"),
+            },
+            "mlp": {
+                "up": {
+                    "kernel": stack("h.{}.mlp.dense_h_to_4h.weight", transpose=True),
+                    "bias": stack("h.{}.mlp.dense_h_to_4h.bias"),
+                },
+                "down": {
+                    "kernel": stack("h.{}.mlp.dense_4h_to_h.weight", transpose=True),
+                    "bias": stack("h.{}.mlp.dense_4h_to_h.bias"),
+                },
+            },
+        },
+        "ln_f": {
+            "scale": jnp.asarray(get("ln_f.weight"), dtype=dtype),
+            "bias": jnp.asarray(get("ln_f.bias"), dtype=dtype),
+        },
+    }
+    return cfg, params
+
+
+def bloom_params_to_hf_state_dict(params: dict) -> dict:
+    """Inverse conversion, for exporting back to HF format (numpy arrays
+    keyed by HF names; caller wraps in torch tensors if needed)."""
+    out = {}
+    out["transformer.word_embeddings.weight"] = np.asarray(params["embed"]["weight"])
+    out["transformer.word_embeddings_layernorm.weight"] = np.asarray(
+        params["embed_ln"]["scale"]
+    )
+    out["transformer.word_embeddings_layernorm.bias"] = np.asarray(
+        params["embed_ln"]["bias"]
+    )
+    blocks = params["blocks"]
+    L = np.asarray(blocks["ln_1"]["scale"]).shape[0]
+    for i in range(L):
+        p = f"transformer.h.{i}."
+        out[p + "input_layernorm.weight"] = np.asarray(blocks["ln_1"]["scale"][i])
+        out[p + "input_layernorm.bias"] = np.asarray(blocks["ln_1"]["bias"][i])
+        out[p + "self_attention.query_key_value.weight"] = np.asarray(
+            blocks["attn"]["qkv"]["kernel"][i]
+        ).T
+        out[p + "self_attention.query_key_value.bias"] = np.asarray(
+            blocks["attn"]["qkv"]["bias"][i]
+        )
+        out[p + "self_attention.dense.weight"] = np.asarray(
+            blocks["attn"]["out"]["kernel"][i]
+        ).T
+        out[p + "self_attention.dense.bias"] = np.asarray(blocks["attn"]["out"]["bias"][i])
+        out[p + "post_attention_layernorm.weight"] = np.asarray(blocks["ln_2"]["scale"][i])
+        out[p + "post_attention_layernorm.bias"] = np.asarray(blocks["ln_2"]["bias"][i])
+        out[p + "mlp.dense_h_to_4h.weight"] = np.asarray(blocks["mlp"]["up"]["kernel"][i]).T
+        out[p + "mlp.dense_h_to_4h.bias"] = np.asarray(blocks["mlp"]["up"]["bias"][i])
+        out[p + "mlp.dense_4h_to_h.weight"] = np.asarray(
+            blocks["mlp"]["down"]["kernel"][i]
+        ).T
+        out[p + "mlp.dense_4h_to_h.bias"] = np.asarray(blocks["mlp"]["down"]["bias"][i])
+    out["transformer.ln_f.weight"] = np.asarray(params["ln_f"]["scale"])
+    out["transformer.ln_f.bias"] = np.asarray(params["ln_f"]["bias"])
+    out["lm_head.weight"] = out["transformer.word_embeddings.weight"]
+    return out

@@ -46,10 +46,6 @@ def Vocab(axis: str = "tensor") -> ParallelInfo:
     return ParallelInfo("vocab", P(axis, None))
 
 
-def ColumnBias(axis: str = "tensor") -> ParallelInfo:
-    return ParallelInfo("column_bias", P(axis))
-
-
 def Replicate() -> ParallelInfo:
     return ParallelInfo("replicate", P())
 
@@ -101,7 +97,7 @@ class ParallelMapping:
         return info.role if info else None
 
     def is_column_parallel(self, path: str) -> bool:
-        return self._role(path) in ("column", "column_bias")
+        return self._role(path) == "column"
 
     def is_row_parallel(self, path: str) -> bool:
         return self._role(path) == "row"

@@ -118,6 +118,7 @@ def vocab_parallel_cross_entropy(
     logits: jax.Array,
     targets: jax.Array,
     axis_name: Optional[str],
+    valid_size: Optional[int] = None,
 ) -> jax.Array:
     """Cross-entropy over vocab-sharded logits, per token.
 
@@ -132,13 +133,31 @@ def vocab_parallel_cross_entropy(
 
     Returns per-token losses; callers take the mean (the reference's
     module wrapper divides by len(targets), loss.py:92-103).
+
+    ``valid_size``: when the vocab was padded for divisibility
+    (``pad_vocab``), the true vocab size — padded slots are excluded from
+    the log-sum-exp so the loss matches the unpadded model.
     """
+    if valid_size is not None:
+        logits = mask_padded_vocab(logits, axis_name, valid_size)
     if not axis_name:
         logits = logits.astype(jnp.float32)
         lse = jax.nn.logsumexp(logits, axis=-1)
         pred = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
         return lse - pred
     return _vp_ce(logits, targets, axis_name)
+
+
+def mask_padded_vocab(
+    logits: jax.Array, axis_name: Optional[str], valid_size: int
+) -> jax.Array:
+    """Set logits of vocab slots >= valid_size to a large negative, so
+    padded slots (zero rows from ``pad_vocab``) can never win a softmax
+    or shift the log-sum-exp."""
+    shard_v = logits.shape[-1]
+    start = jax.lax.axis_index(axis_name) * shard_v if axis_name else 0
+    slot = start + jnp.arange(shard_v)
+    return jnp.where(slot < valid_size, logits, -1e9)
 
 
 from functools import partial  # noqa: E402

@@ -47,7 +47,12 @@ class TensorParallel(Parallel):
 
 def pad_vocab(weight: jax.Array, multiple: int) -> jax.Array:
     """Pad embedding rows so vocab divides the tensor axis (reference
-    EmbeddingParallelizer._resize_vocab_size, parallelizer.py:125-141)."""
+    EmbeddingParallelizer._resize_vocab_size, parallelizer.py:125-141).
+
+    Padded rows are zeros, so with a tied LM head every padded slot gets
+    logit exactly 0 — pass the true vocab size as ``valid_size`` to
+    ``vocab_parallel_cross_entropy`` (or apply ``mask_padded_vocab`` before
+    decoding) so padded slots can't shift the loss or win a greedy step."""
     vocab = weight.shape[0]
     rem = (-vocab) % multiple
     if rem == 0:

@@ -187,3 +187,22 @@ def test_vocab_parallel_embedding_grad(ctx):
     )
     g = fn({"weight": weight})["weight"]
     np.testing.assert_allclose(g, ref_grad, rtol=1e-5, atol=1e-6)
+
+
+def test_padded_vocab_ce_matches_unpadded(ctx):
+    """pad_vocab + valid_size masking: loss over a padded vocab equals the
+    unpadded loss (padded slots excluded from the log-sum-exp)."""
+    vocab, padded = 60, 64
+    logits = jax.random.normal(jax.random.PRNGKey(12), (4, vocab))
+    targets = jax.random.randint(jax.random.PRNGKey(13), (4,), 0, vocab)
+    ref = vocab_parallel_cross_entropy(logits, targets, None)
+
+    padded_logits = jnp.pad(logits, ((0, 0), (0, padded - vocab)))
+    fn = shard_map(
+        lambda l, t: vocab_parallel_cross_entropy(l, t, "tensor", valid_size=vocab),
+        mesh=ctx.mesh,
+        in_specs=(P(None, "tensor"), P()),
+        out_specs=P(),
+        check_vma=False,
+    )
+    np.testing.assert_allclose(fn(padded_logits, targets), ref, rtol=1e-5, atol=1e-6)
