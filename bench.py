@@ -1,0 +1,100 @@
+"""Throughput benchmark: BLOOM-560m train step on the available device.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The reference publishes no throughput numbers (BASELINE.md) — its
+acceptance bar is convergence only. ``vs_baseline`` therefore reports
+achieved MFU / 0.40, the north-star MFU threshold from BASELINE.json.
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+# per-chip peak bf16 FLOP/s
+PEAK_FLOPS = {
+    "v5 lite": 197e12,  # v5e
+    "v5e": 197e12,
+    "v5p": 459e12,
+    "v4": 275e12,
+    "cpu": 1e12,  # nominal, CPU fallback only
+}
+
+
+def _peak_flops(device) -> float:
+    kind = getattr(device, "device_kind", "cpu").lower()
+    for k, v in PEAK_FLOPS.items():
+        if k in kind:
+            return v
+    return 1e12
+
+
+def main() -> None:
+    from pipegoose_tpu.models import bloom
+
+    dev = jax.devices()[0]
+    on_tpu = "tpu" in getattr(dev, "platform", "").lower() or "lite" in getattr(
+        dev, "device_kind", ""
+    ).lower()
+
+    if on_tpu:
+        cfg = bloom.BloomConfig.bloom_560m(dtype=jnp.bfloat16, remat=True)
+        batch, seq, steps = 8, 1024, 10
+    else:  # CPU smoke fallback
+        cfg = bloom.BloomConfig(
+            vocab_size=1024, hidden_size=256, n_layer=4, n_head=8, dtype=jnp.float32
+        )
+        batch, seq, steps = 2, 128, 3
+
+    params = bloom.init_params(cfg, jax.random.PRNGKey(0))
+    opt = optax.adam(1e-4)
+    opt_state = opt.init(params)
+    ids = jnp.asarray(np.random.RandomState(0).randint(0, cfg.vocab_size, (batch, seq)))
+
+    @jax.jit
+    def step(params, opt_state, ids):
+        loss, grads = jax.value_and_grad(bloom.loss_fn)(params, ids, None, ids, cfg)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    # warmup/compile
+    params, opt_state, loss = step(params, opt_state, ids)
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, opt_state, loss = step(params, opt_state, ids)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = batch * seq * steps / dt
+
+    # model FLOPs per token: 6*N for dense matmuls + 12*L*H*seq attention
+    n_params = sum(int(np.prod(p.shape)) for p in jax.tree_util.tree_leaves(params))
+    flops_per_token = 6 * n_params + 12 * cfg.n_layer * cfg.hidden_size * seq
+    mfu = tokens_per_sec * flops_per_token / _peak_flops(dev)
+
+    print(
+        json.dumps(
+            {
+                "metric": "bloom-560m train tokens/sec/chip"
+                if on_tpu
+                else "bloom-tiny train tokens/sec (cpu smoke)",
+                "value": round(tokens_per_sec, 1),
+                "unit": "tokens/sec/chip",
+                "vs_baseline": round(mfu / 0.40, 4),
+                "mfu": round(mfu, 4),
+                "device": getattr(dev, "device_kind", str(dev)),
+                "loss": float(loss),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

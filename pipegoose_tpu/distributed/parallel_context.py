@@ -114,7 +114,9 @@ class ParallelContext:
         if not jax.distributed.is_initialized():
             try:
                 jax.distributed.initialize()
-            except RuntimeError as e:
+            except (RuntimeError, ValueError) as e:
+                # jax raises ValueError('coordinator_address should be
+                # defined.') when no coordinator is configured
                 # no coordinator configured — single-process dev run
                 warnings.warn(
                     f"jax.distributed.initialize failed ({e}); continuing "

@@ -29,6 +29,11 @@ def _t(x) -> np.ndarray:
 
 
 def bloom_config_from_hf(hf_config, **overrides) -> BloomConfig:
+    if getattr(hf_config, "apply_residual_connection_post_layernorm", False):
+        raise NotImplementedError(
+            "apply_residual_connection_post_layernorm=True checkpoints are "
+            "not supported (bloom._block uses the standard pre-LN residual)"
+        )
     return BloomConfig(
         vocab_size=hf_config.vocab_size,
         hidden_size=hf_config.hidden_size,

@@ -1,0 +1,3 @@
+from pipegoose_tpu.nn.data_parallel.data_parallel import DataParallel, average_gradients
+
+__all__ = ["DataParallel", "average_gradients"]

@@ -1,0 +1,80 @@
+"""Data parallelism.
+
+TPU-native analog of the reference's ``DataParallel``
+(pipegoose/nn/data_parallel/data_parallel.py:13-43), which registers a
+per-parameter grad hook doing ``grad.div_(dp); all_reduce(grad)`` — one
+unbucketed collective per parameter. Here the whole gradient pytree is
+averaged with ONE logical ``pmean`` per step inside the compiled program
+(XLA fuses and schedules the underlying all-reduces), and the batch is
+sharded over the ``data`` mesh axis so each device computes grads on its
+own shard.
+
+Expert parameters (flagged via the policy table, reference
+data_parallel.py:35-43) are reduced over a different axis — see
+``average_gradients``'s ``expert_mapping``/``expert_axis`` arguments.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+from jax import lax
+from jax.tree_util import tree_map_with_path
+
+from pipegoose_tpu.distributed.parallel_context import ParallelContext
+from pipegoose_tpu.nn.parallel import Parallel, path_str
+from pipegoose_tpu.nn.parallel_mapping import ParallelMapping
+
+
+def average_gradients(
+    grads: Any,
+    axis_name: Optional[str] = "data",
+    expert_mapping: Optional[ParallelMapping] = None,
+    expert_axis: Optional[str] = None,
+) -> Any:
+    """pmean the grad pytree over the data axis. Params matched as
+    ``expert`` by ``expert_mapping`` are averaged over ``expert_axis``
+    instead (the reference's is_expert -> EXPERT_DATA routing,
+    data_parallel.py:35-43); ``expert_axis=None`` leaves them local."""
+    if axis_name is None:
+        return grads
+
+    def avg(path, g):
+        if expert_mapping is not None and expert_mapping.is_expert(path_str(path)):
+            if expert_axis is None:
+                return g
+            return lax.pmean(g, expert_axis)
+        return lax.pmean(g, axis_name)
+
+    return tree_map_with_path(avg, grads)
+
+
+class DataParallel(Parallel):
+    """Wrapper with the reference's API shape. ``parallelize`` is a no-op
+    on params (replicas are identical by construction under jit);
+    the real work is ``average_gradients`` inside the train step plus
+    batch sharding via ``batch_spec``."""
+
+    def __init__(
+        self,
+        parallel_context: Optional[ParallelContext] = None,
+        axis_name: str = "data",
+    ):
+        super().__init__(parallel_context)
+        self.axis_name = axis_name
+
+    def parallelize(self, params: Any):
+        from jax.sharding import PartitionSpec as P
+
+        from pipegoose_tpu.nn.parallel import shard_tree, spec_tree
+
+        specs = spec_tree(params, lambda _p, _x: P())
+        return shard_tree(params, specs, self.parallel_context), specs
+
+    def batch_spec(self):
+        from jax.sharding import PartitionSpec as P
+
+        return P(self.axis_name)
+
+    def average_gradients(self, grads: Any, **kw) -> Any:
+        return average_gradients(grads, self.axis_name, **kw)

@@ -1,0 +1,3 @@
+from pipegoose_tpu.optim.zero import DistributedOptimizer, ZeroState
+
+__all__ = ["DistributedOptimizer", "ZeroState"]

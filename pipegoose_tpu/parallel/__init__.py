@@ -1,0 +1,3 @@
+from pipegoose_tpu.parallel.hybrid import make_hybrid_train_step
+
+__all__ = ["make_hybrid_train_step"]

@@ -1,0 +1,92 @@
+"""Hybrid-parallel train-step composition.
+
+The TPU-native replacement for the reference's wrapper-chaining pattern
+(examples/hybrid_parallelism.py: TensorParallel(...).parallelize() ->
+DataParallel(...).parallelize() -> DistributedOptimizer(...)): here the
+same composition is ONE compiled SPMD program — a ``shard_map`` over the
+mesh in which the loss/grad runs tensor-parallel, the batch is sharded
+over the data axis, and the ZeRO-1 optimizer reduce-scatters grads and
+all-gathers params. No hooks, no module mutation, no per-param
+collectives.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from pipegoose_tpu.distributed.parallel_context import ParallelContext
+from pipegoose_tpu.optim.zero import (
+    DistributedOptimizer,
+    ZeroState,
+    shard_shapes,
+    state_specs,
+)
+
+try:
+    from jax import shard_map
+except ImportError:  # pragma: no cover - jax < 0.6
+    from jax.experimental.shard_map import shard_map
+
+
+def make_hybrid_train_step(
+    loss_fn: Callable[[Any, Any], jax.Array],
+    param_specs: Any,
+    optimizer: DistributedOptimizer,
+    parallel_context: Optional[ParallelContext] = None,
+    batch_spec: P = P("data"),
+    loss_axis: str = "data",
+):
+    """Build (init_fn, step_fn), both jitted over the context's mesh.
+
+    - ``loss_fn(params, batch) -> scalar`` runs on per-device shards
+      inside shard_map (use tp_axis='tensor' collectives inside it).
+    - ``param_specs``: PartitionSpec pytree for params (e.g.
+      ``bloom.tp_specs``).
+    - ``optimizer``: ZeRO-1 ``DistributedOptimizer``; its state lives
+      sharded over the data axis for the whole run.
+
+    step_fn(params, opt_state, batch) -> (params, opt_state, loss);
+    params and opt_state buffers are donated.
+    """
+    ctx = parallel_context or ParallelContext.get_context()
+    mesh = ctx.mesh
+    dp = optimizer.axis_name and mesh.shape.get(optimizer.axis_name, 1) or 1
+
+    def _state_spec_for(params):
+        shapes = jax.eval_shape(optimizer.inner.init, shard_shapes(params, dp))
+        inner_spec = state_specs(shapes, params, param_specs, optimizer.axis_name or "data")
+        return ZeroState(inner_spec)
+
+    def init_fn(params):
+        spec = _state_spec_for(params)
+        f = shard_map(
+            optimizer.init,
+            mesh=mesh,
+            in_specs=(param_specs,),
+            out_specs=spec,
+            check_vma=False,
+        )
+        return jax.jit(f)(params)
+
+    def _step(params, opt_state, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+        new_params, new_state = optimizer.step(grads, opt_state, params)
+        if optimizer.axis_name:
+            loss = lax.pmean(loss, loss_axis)
+        return new_params, new_state, loss
+
+    def make_step(params):
+        spec = _state_spec_for(params)
+        f = shard_map(
+            _step,
+            mesh=mesh,
+            in_specs=(param_specs, spec, batch_spec),
+            out_specs=(param_specs, spec, P()),
+            check_vma=False,
+        )
+        return jax.jit(f, donate_argnums=(0, 1))
+
+    return init_fn, make_step
