@@ -217,6 +217,29 @@ def _block(
     return x
 
 
+def embed_tokens(
+    params: dict, input_ids: jax.Array, config: BloomConfig, tp_axis: Optional[str]
+) -> jax.Array:
+    """Embedding lookup + embedding layernorm (single source for the
+    plain and pipeline forward paths)."""
+    x = vocab_parallel_embedding(params["embed"], input_ids, tp_axis)
+    x = x.astype(config.dtype)
+    return layer_norm(params["embed_ln"], x, config.layer_norm_epsilon)
+
+
+def attention_bias(attention_mask: jax.Array, config: BloomConfig) -> dict:
+    """ALiBi + combined causal/padding mask bias (single source for the
+    plain and pipeline forward paths)."""
+    s = attention_mask.shape[-1]
+    alibi = build_alibi(attention_mask, config.n_head)
+    causal = jnp.tril(jnp.ones((s, s), dtype=bool))
+    keep = causal[None, None] & (attention_mask[:, None, None, :] > 0)
+    return {
+        "alibi": alibi,
+        "mask_bias": jnp.where(keep, 0.0, NEG_INF).astype(jnp.float32),
+    }
+
+
 def forward_hidden(
     params: dict,
     input_ids: jax.Array,
@@ -229,14 +252,9 @@ def forward_hidden(
     if attention_mask is None:
         attention_mask = jnp.ones((b, s), dtype=jnp.int32)
 
-    x = vocab_parallel_embedding(params["embed"], input_ids, tp_axis)
-    x = x.astype(config.dtype)
-    x = layer_norm(params["embed_ln"], x, config.layer_norm_epsilon)
-
-    alibi = build_alibi(attention_mask, config.n_head)
-    causal = jnp.tril(jnp.ones((s, s), dtype=bool))
-    keep = causal[None, None] & (attention_mask[:, None, None, :] > 0)
-    mask_bias = jnp.where(keep, 0.0, NEG_INF).astype(jnp.float32)
+    x = embed_tokens(params, input_ids, config, tp_axis)
+    bias = attention_bias(attention_mask, config)
+    alibi, mask_bias = bias["alibi"], bias["mask_bias"]
 
     block = partial(_block, config=config, tp_axis=tp_axis)
     if config.remat:
@@ -338,3 +356,85 @@ def tp_specs(params: dict, axis: str = "tensor") -> dict:
         return mapping.spec_for(path, x.ndim)
 
     return spec_tree(params, spec_fn)
+
+
+# -- pipeline-parallel composition ------------------------------------------
+
+def loss_fn_pp(
+    params: dict,
+    input_ids: jax.Array,
+    attention_mask: Optional[jax.Array],
+    labels: jax.Array,
+    config: BloomConfig,
+    n_microbatches: int,
+    tp_axis: Optional[str] = None,
+    pipe_axis: str = "pipe",
+) -> jax.Array:
+    """Pipeline-parallel loss: embed (vectorized over all microbatches on
+    every rank — replicated compute off the critical path), GPipe over
+    the pipe-sharded block stack, then vectorized LN/LM-head/CE, with the
+    scalar combined from the last stage.
+
+    Replaces the reference's PipelineEngine.run + scheduled backward
+    (pipeline_engine.py:60-134, _job/creator.py:182-277) with one
+    differentiable program.
+    """
+    from pipegoose_tpu.nn.pipeline_parallel import microbatch as mb
+    from pipegoose_tpu.nn.pipeline_parallel.pipeline import gpipe, last_stage_value
+
+    b, s = input_ids.shape
+    if attention_mask is None:
+        attention_mask = jnp.ones((b, s), dtype=jnp.int32)
+
+    mbs = mb.split(
+        {"ids": input_ids, "mask": attention_mask, "labels": labels}, n_microbatches
+    )
+
+    # pipeline-entry activations for ALL microbatches (vmapped embed);
+    # shared helpers keep PP/non-PP loss parity by construction
+    h0 = jax.vmap(lambda ids: embed_tokens(params, ids, config, tp_axis))(mbs["ids"])
+
+    # per-microbatch side inputs: alibi + combined mask bias
+    side = jax.vmap(lambda m: attention_bias(m, config))(mbs["mask"])
+
+    def stage_fn(blocks, h, side):
+        def scan_fn(carry, blk):
+            return (
+                _block(blk, carry, side["alibi"], side["mask_bias"], config, tp_axis),
+                None,
+            )
+
+        h, _ = jax.lax.scan(scan_fn, h, blocks)
+        return h
+
+    outs = gpipe(
+        stage_fn,
+        params["blocks"],
+        h0,
+        side_inputs=side,
+        axis_name=pipe_axis,
+        remat=config.remat,
+    )  # (M, mb, S, H), valid on last stage
+
+    # vectorized head over all microbatches
+    def head_one(h, ids, mask, labels):
+        h = layer_norm(params["ln_f"], h, config.layer_norm_epsilon)
+        logits = logits_fn(params, h, tp_axis)
+        per_tok = vocab_parallel_cross_entropy(logits[:, :-1], labels[:, 1:], tp_axis)
+        w = mask[:, 1:].astype(per_tok.dtype)
+        return (per_tok * w).sum(), w.sum()
+
+    tot, cnt = jax.vmap(head_one)(outs, mbs["ids"], mbs["mask"], mbs["labels"])
+    loss_local = tot.sum() / jnp.maximum(cnt.sum(), 1)
+    return last_stage_value(loss_local, pipe_axis)
+
+
+def pp_specs(params: dict, tp_axis: str = "tensor", pipe_axis: str = "pipe") -> dict:
+    """tp_specs with the stacked n_layer dim of blocks sharded over the
+    pipe axis — stage assignment as a PartitionSpec (vs the reference's
+    torch.fx partitioner, partitioner.py:29-219)."""
+    from pipegoose_tpu.nn.pipeline_parallel.pipeline import pipe_stage_specs
+
+    specs = tp_specs(params, tp_axis)
+    specs["blocks"] = pipe_stage_specs(specs["blocks"], pipe_axis)
+    return specs

@@ -1,0 +1,24 @@
+from pipegoose_tpu.nn.pipeline_parallel.microbatch import merge, split
+from pipegoose_tpu.nn.pipeline_parallel.pipeline import (
+    gpipe,
+    last_stage_value,
+    pipe_stage_specs,
+)
+from pipegoose_tpu.nn.pipeline_parallel.scheduler import (
+    GPipeScheduler,
+    JobType,
+    OneFOneBScheduler,
+    Task,
+)
+
+__all__ = [
+    "gpipe",
+    "last_stage_value",
+    "pipe_stage_specs",
+    "GPipeScheduler",
+    "OneFOneBScheduler",
+    "JobType",
+    "Task",
+    "split",
+    "merge",
+]

@@ -1,0 +1,37 @@
+"""Microbatch splitting.
+
+Analog of the reference's ``microbatch.split``
+(pipegoose/nn/pipeline_parallel/microbatch.py:11-26) — which passed the
+microbatch COUNT to ``torch.split`` (a chunk-SIZE argument), yielding
+size-n chunks instead of n chunks (SURVEY.md §7 quirks). Here splitting
+is an explicit reshape to a leading microbatch dim: (B, ...) ->
+(n, B/n, ...), which is also exactly the layout ``lax.scan`` wants.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+
+
+def split(batch: Any, n_microbatches: int) -> Any:
+    """Reshape every leaf (B, ...) -> (n_microbatches, B/n, ...)."""
+    if n_microbatches < 1:
+        raise ValueError(f"n_microbatches must be >= 1, got {n_microbatches}")
+
+    def f(x: jax.Array) -> jax.Array:
+        if x.shape[0] % n_microbatches != 0:
+            raise ValueError(
+                f"batch dim {x.shape[0]} not divisible by "
+                f"n_microbatches={n_microbatches}"
+            )
+        return x.reshape((n_microbatches, x.shape[0] // n_microbatches) + x.shape[1:])
+
+    return jax.tree_util.tree_map(f, batch)
+
+
+def merge(microbatches: Any) -> Any:
+    """Inverse of split: (n, b, ...) -> (n*b, ...)."""
+    return jax.tree_util.tree_map(
+        lambda x: x.reshape((x.shape[0] * x.shape[1],) + x.shape[2:]), microbatches
+    )

@@ -1,0 +1,146 @@
+"""GPipe as one compiled SPMD program.
+
+This replaces the reference's entire pipeline runtime — PipelineEngine
+(pipeline_engine.py:36-157), the Job system (_job/, 742 LoC), the
+daemon-thread worker pool (_worker.py), RPC package transport (_comm.py)
+and the RPC clock-consensus handshake (sync/, 290 LoC) — with a single
+``lax.scan`` over clock cycles inside ``shard_map`` over the ``pipe``
+mesh axis:
+
+- stage-to-stage transfer is ``lax.ppermute`` over ICI (no TensorPipe,
+  no dtype/shape preambles: shapes are static in the compiled program);
+- clock consensus is unnecessary: the schedule is data-independent, so
+  every device advances in lockstep by construction;
+- the backward pass is reverse-mode AD of the scan — ppermute transposes
+  to the reverse permutation and the scan replays in reverse, which IS
+  the reference's reversed-forward backward schedule (scheduler.py:82-94)
+  with none of its machinery;
+- the GPipe bubble (P-1 idle clocks) manifests as masked compute on
+  garbage inputs rather than idle threads — same cost, zero control flow.
+
+Stage assignment falls out of the stacked-params layout: block params
+(n_layer leading dim) are sharded over ``pipe``, so "partitioning" is a
+PartitionSpec, not torch.fx graph surgery (vs partitioner.py:29-219).
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from pipegoose_tpu.distributed.functional import reduce_from_tensor_group, shift_right
+from pipegoose_tpu.nn.pipeline_parallel.scheduler import GPipeScheduler
+
+
+def _tree_index(tree: Any, i) -> Any:
+    return jax.tree_util.tree_map(lambda a: lax.dynamic_index_in_dim(a, i, 0, keepdims=False), tree)
+
+
+def _tree_update(tree: Any, vals: Any, i, write_mask) -> Any:
+    """tree[i] = where(write_mask, vals, tree[i]) with dynamic i."""
+
+    def f(buf, v):
+        cur = lax.dynamic_index_in_dim(buf, i, 0, keepdims=False)
+        new = jnp.where(write_mask, v, cur)
+        return lax.dynamic_update_index_in_dim(buf, new, i, 0)
+
+    return jax.tree_util.tree_map(f, tree, vals)
+
+
+def gpipe(
+    stage_fn: Callable[..., Any],
+    stage_params: Any,
+    inputs: Any,
+    side_inputs: Optional[Any] = None,
+    axis_name: str = "pipe",
+    remat: bool = True,
+) -> Any:
+    """Run ``inputs`` (a pytree with leading microbatch dim M, the
+    pipeline-entry activations, replicated over the pipe axis but only
+    read on stage 0) through P pipeline stages.
+
+    ``stage_fn(stage_params, h[, side]) -> h`` must preserve the
+    activation structure/shape (each stage applies its local slice of the
+    layer stack). ``side_inputs`` (optional, M-leading, replicated over
+    pipe) are per-microbatch values every stage needs — attention masks,
+    position biases. Each stage indexes them by ITS OWN current
+    microbatch (m = clock - stage) instead of shipping them around the
+    ring — for seq-length masks this avoids O(S^2) ppermute traffic.
+
+    Returns the last stage's outputs, shape like ``inputs``, valid on
+    the last pipe rank (garbage elsewhere — combine with
+    ``last_stage_value`` or mask downstream).
+
+    Clock-cycle semantics match GPipeScheduler: task (m, p) runs at
+    clock m + p; n_clock = M + P - 1 (reference scheduler.py:66-80).
+    """
+    P = lax.axis_size(axis_name)
+    stage = lax.axis_index(axis_name)
+    M = jax.tree_util.tree_leaves(inputs)[0].shape[0]
+    n_clock = GPipeScheduler(M, P).total_forward_clocks
+
+    fn = jax.checkpoint(stage_fn) if remat else stage_fn
+
+    template = _tree_index(inputs, 0)
+    out_buf = jax.tree_util.tree_map(lambda a: jnp.zeros_like(a), inputs)
+    is_first = stage == 0
+    is_last = stage == P - 1
+
+    def clock_step(carry, c):
+        recv, out_buf = carry
+        # stage 0 consumes microbatch c (clamped; garbage past M never
+        # reaches a valid output slot within n_clock clocks)
+        m_in = jnp.clip(c, 0, M - 1)
+        x0 = _tree_index(inputs, m_in)
+        h_in = jax.tree_util.tree_map(
+            lambda a, b: jnp.where(is_first, a, b), x0, recv
+        )
+        if side_inputs is not None:
+            m_mine = jnp.clip(c - stage, 0, M - 1)  # this stage's microbatch
+            side = _tree_index(side_inputs, m_mine)
+            h_out = fn(stage_params, h_in, side)
+        else:
+            h_out = fn(stage_params, h_in)
+        # last stage completed microbatch m = c - (P - 1)
+        m_out = jnp.clip(c - (P - 1), 0, M - 1)
+        write = is_last & (c >= P - 1)
+        out_buf = _tree_update(out_buf, h_out, m_out, write)
+        # hand to the next stage (ring; last->first carries garbage)
+        sent = jax.tree_util.tree_map(lambda a: shift_right(a, axis_name), h_out)
+        return (sent, out_buf), None
+
+    (_, out_buf), _ = lax.scan(clock_step, (template, out_buf), jnp.arange(n_clock))
+    return out_buf
+
+
+def last_stage_value(x: jax.Array, axis_name: str = "pipe") -> jax.Array:
+    """Combine a value computed validly on the LAST pipe rank (zeros/garbage
+    elsewhere) into a replicated value, with identity backward so each
+    rank's gradient contribution stays local (the psum-transpose hazard —
+    see vocab_parallel_cross_entropy)."""
+    P = lax.axis_size(axis_name)
+    masked = jnp.where(lax.axis_index(axis_name) == P - 1, x, jnp.zeros_like(x))
+    return reduce_from_tensor_group(masked, axis_name)
+
+
+def pipe_stage_specs(n_layer_spec_tree: Any, axis_name: str = "pipe") -> Any:
+    """Shift a stacked-blocks spec tree to shard the leading n_layer dim
+    over the pipe axis (stage assignment as a PartitionSpec)."""
+    from jax.sharding import PartitionSpec as P
+
+    def f(spec):
+        dim0 = spec[0] if len(spec) else None
+        if dim0 is None:
+            new0 = axis_name
+        elif isinstance(dim0, (tuple, list)):
+            new0 = (axis_name, *dim0)
+        else:
+            new0 = (axis_name, dim0)
+        return P(new0, *spec[1:])
+
+    return jax.tree_util.tree_map(
+        f, n_layer_spec_tree, is_leaf=lambda x: isinstance(x, P)
+    )

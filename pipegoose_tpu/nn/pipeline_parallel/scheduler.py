@@ -1,0 +1,106 @@
+"""Pipeline schedules as pure timeline data.
+
+TPU-native analog of the reference's ``GPipeScheduler``
+(pipegoose/nn/pipeline_parallel/scheduler.py:35-115). There, the schedule
+drives a thread/RPC engine at run time; here the schedule is *compiled
+into* the program (pipeline.py runs one ``lax.scan`` step per clock), so
+this module's timeline exists for: sizing the scan (n_clock), tests that
+pin the clock-cycle semantics to the torchgpipe timeline the reference
+used, utilization analysis, and the 1F1B variant's ordering.
+
+A task is (microbatch_idx, partition_idx); clock c runs every task with
+``microbatch_idx + partition_idx == c`` (torchgpipe §3.2.1).
+"""
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import List
+
+
+class JobType(str, enum.Enum):
+    FORWARD = "forward"
+    BACKWARD = "backward"
+
+
+@dataclasses.dataclass(frozen=True)
+class Task:
+    job_type: JobType
+    microbatch_idx: int
+    partition_idx: int
+
+
+class GPipeScheduler:
+    """Deterministic clock-cycle timeline (reference scheduler.py:66-94).
+
+    Unlike the reference, the backward timeline here is a *description*
+    of what autodiff already does: reverse-mode differentiation of the
+    forward scan replays the clocks in reverse with flipped job types —
+    there is no separate backward engine to drive.
+    """
+
+    def __init__(self, n_microbatches: int, n_partitions: int):
+        assert n_microbatches >= 1 and n_partitions >= 1
+        self.n_microbatches = n_microbatches
+        self.n_partitions = n_partitions
+
+    @property
+    def total_forward_clocks(self) -> int:
+        return self.n_microbatches + self.n_partitions - 1
+
+    @property
+    def total_backward_clocks(self) -> int:
+        return self.total_forward_clocks
+
+    def get_forward_schedules(self) -> List[List[Task]]:
+        """clock -> tasks, forward: task (m, p) runs at clock m + p."""
+        out: List[List[Task]] = []
+        for c in range(self.total_forward_clocks):
+            tasks = [
+                Task(JobType.FORWARD, m, c - m)
+                for m in range(self.n_microbatches)
+                if 0 <= c - m < self.n_partitions
+            ]
+            out.append(tasks)
+        return out
+
+    def get_backward_schedules(self) -> List[List[Task]]:
+        """Reverse of forward with flipped job type — matching the
+        reference's deepcopy+reverse construction (scheduler.py:82-94),
+        and exactly the order reverse-mode AD visits the forward scan."""
+        fwd = self.get_forward_schedules()
+        return [
+            [Task(JobType.BACKWARD, t.microbatch_idx, t.partition_idx) for t in tasks]
+            for tasks in reversed(fwd)
+        ]
+
+
+class OneFOneBScheduler(GPipeScheduler):
+    """1F1B (PipeDream-flush) ordering: same total clocks, but each
+    stage starts its backward as soon as its first microbatch returns,
+    bounding live activations at ``n_partitions`` instead of
+    ``n_microbatches``. The reference's backward schedule is a naive
+    reversed-forward (SURVEY.md §7 quirks). Currently timeline-only:
+    it documents/tests the ordering an interleaved pipeline runtime
+    would follow; pipeline.py's gpipe keeps the plain GPipe schedule
+    (remat bounds its activation memory instead)."""
+
+    def timeline(self, partition_idx: int) -> List[Task]:
+        """Per-stage instruction stream: warmup forwards, steady 1F1B
+        pairs, cooldown backwards."""
+        M, P = self.n_microbatches, self.n_partitions
+        warmup = min(P - partition_idx - 1, M)
+        steps: List[Task] = []
+        fwd_m = bwd_m = 0
+        for _ in range(warmup):
+            steps.append(Task(JobType.FORWARD, fwd_m, partition_idx))
+            fwd_m += 1
+        while fwd_m < M:
+            steps.append(Task(JobType.FORWARD, fwd_m, partition_idx))
+            fwd_m += 1
+            steps.append(Task(JobType.BACKWARD, bwd_m, partition_idx))
+            bwd_m += 1
+        while bwd_m < M:
+            steps.append(Task(JobType.BACKWARD, bwd_m, partition_idx))
+            bwd_m += 1
+        return steps

@@ -31,6 +31,34 @@ except ImportError:  # pragma: no cover - jax < 0.6
     from jax.experimental.shard_map import shard_map
 
 
+def _spec_mentions(spec: P, axis: str) -> bool:
+    for entry in spec:
+        if entry == axis:
+            return True
+        if isinstance(entry, (tuple, list)) and axis in entry:
+            return True
+    return False
+
+
+def sync_replicated_grads(grads: Any, param_specs: Any, axes: tuple) -> Any:
+    """psum grads of params NOT sharded over ``axis``, for each axis in
+    ``axes``. Needed when a replicated param is only *used* on some ranks
+    of an axis (pipe: embedding on the first stage, ln_f/LM head on the
+    last) — each rank then holds a partial contribution and the true
+    gradient is the sum (the pipe-axis analog of the reference's DP
+    grad hook, data_parallel.py:28-43)."""
+
+    def f(g, spec):
+        for ax in axes:
+            if not _spec_mentions(spec, ax):
+                g = lax.psum(g, ax)
+        return g
+
+    return jax.tree_util.tree_map(
+        f, grads, param_specs, is_leaf=lambda x: isinstance(x, P)
+    )
+
+
 def make_hybrid_train_step(
     loss_fn: Callable[[Any, Any], jax.Array],
     param_specs: Any,
@@ -38,6 +66,7 @@ def make_hybrid_train_step(
     parallel_context: Optional[ParallelContext] = None,
     batch_spec: P = P("data"),
     loss_axis: str = "data",
+    grad_sync_axes: tuple = (),
 ):
     """Build (init_fn, step_fn), both jitted over the context's mesh.
 
@@ -73,6 +102,8 @@ def make_hybrid_train_step(
 
     def _step(params, opt_state, batch):
         loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+        if grad_sync_axes:
+            grads = sync_replicated_grads(grads, param_specs, grad_sync_axes)
         new_params, new_state = optimizer.step(grads, opt_state, params)
         if optimizer.axis_name:
             loss = lax.pmean(loss, loss_axis)

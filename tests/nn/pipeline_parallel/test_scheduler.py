@@ -1,0 +1,62 @@
+"""Schedule-timeline tests — analog of the reference's
+tests/nn/pipeline_parallel/test_scheduler.py (clock-cycle counts and task
+placement per torchgpipe §3.2.1)."""
+from pipegoose_tpu.nn.pipeline_parallel import (
+    GPipeScheduler,
+    JobType,
+    OneFOneBScheduler,
+)
+
+
+def test_total_clocks():
+    s = GPipeScheduler(n_microbatches=4, n_partitions=3)
+    assert s.total_forward_clocks == 6  # M + P - 1
+    assert s.total_backward_clocks == 6
+
+
+def test_forward_timeline():
+    s = GPipeScheduler(n_microbatches=3, n_partitions=2)
+    sched = s.get_forward_schedules()
+    as_pairs = [sorted((t.microbatch_idx, t.partition_idx) for t in c) for c in sched]
+    # task (m, p) at clock m + p
+    assert as_pairs == [
+        [(0, 0)],
+        [(0, 1), (1, 0)],
+        [(1, 1), (2, 0)],
+        [(2, 1)],
+    ]
+    assert all(t.job_type == JobType.FORWARD for c in sched for t in c)
+
+
+def test_backward_is_reversed_forward():
+    s = GPipeScheduler(n_microbatches=3, n_partitions=2)
+    fwd = s.get_forward_schedules()
+    bwd = s.get_backward_schedules()
+    assert len(bwd) == len(fwd)
+    for fc, bc in zip(reversed(fwd), bwd):
+        assert [(t.microbatch_idx, t.partition_idx) for t in fc] == [
+            (t.microbatch_idx, t.partition_idx) for t in bc
+        ]
+        assert all(t.job_type == JobType.BACKWARD for t in bc)
+
+
+def test_1f1b_per_stage_stream():
+    s = OneFOneBScheduler(n_microbatches=4, n_partitions=2)
+    # last stage: no warmup, strict F,B,F,B,...
+    tl = s.timeline(partition_idx=1)
+    kinds = [t.job_type for t in tl]
+    assert kinds == [
+        JobType.FORWARD, JobType.BACKWARD,
+        JobType.FORWARD, JobType.BACKWARD,
+        JobType.FORWARD, JobType.BACKWARD,
+        JobType.FORWARD, JobType.BACKWARD,
+    ]
+    # first stage: 1 warmup forward, then pairs, then cooldown backward
+    tl0 = s.timeline(partition_idx=0)
+    assert [t.job_type for t in tl0[:3]] == [
+        JobType.FORWARD, JobType.FORWARD, JobType.BACKWARD
+    ]
+    assert [t.job_type for t in tl0[-2:]] == [JobType.BACKWARD, JobType.BACKWARD]
+    # every microbatch appears exactly once per direction
+    assert sorted(t.microbatch_idx for t in tl0 if t.job_type == JobType.FORWARD) == [0, 1, 2, 3]
+    assert sorted(t.microbatch_idx for t in tl0 if t.job_type == JobType.BACKWARD) == [0, 1, 2, 3]
