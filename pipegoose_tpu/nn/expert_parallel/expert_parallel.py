@@ -1,0 +1,112 @@
+"""ExpertParallel: turn a dense model's MLPs into expert-parallel MoE.
+
+TPU-native analog of the reference's ``ExpertParallel`` wrapper
+(pipegoose/nn/expert_parallel/expert_parallel.py:13-83), which regex-
+matches ``transformer.h.{i}.mlp`` modules and swaps them for an
+ExpertLayer reusing the dense MLP as the expert template (:53-80). Here
+the transform is on the params pytree: each (stacked) dense MLP kernel
+is tiled into ``num_experts`` expert copies (optionally perturbed so
+experts diverge), and a router gate is added — returning a new params
+tree for the MoE model plus its PartitionSpecs.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from pipegoose_tpu.distributed.parallel_context import ParallelContext
+from pipegoose_tpu.nn.parallel import Parallel
+
+
+class ExpertParallel(Parallel):
+    """Expand BLOOM-style stacked dense MLP params into MoE params
+    (mirrors the reference's template-copy semantics; ``jitter`` adds
+    per-expert noise so tiled experts don't stay identical forever)."""
+
+    def __init__(
+        self,
+        num_experts: int,
+        expert_axis: str = "expert",
+        tensor_axis: Optional[str] = "tensor",
+        jitter: float = 0.0,
+        parallel_context: Optional[ParallelContext] = None,
+    ):
+        super().__init__(parallel_context)
+        self.num_experts = num_experts
+        self.expert_axis = expert_axis
+        self.tensor_axis = tensor_axis
+        self.jitter = jitter
+        ep_size = self.parallel_context.mesh.shape.get(expert_axis, 1)
+        if num_experts % ep_size != 0:
+            raise ValueError(
+                f"num_experts={num_experts} must divide over expert axis "
+                f"size {ep_size} (reference asserts num_experts % tp == 0, "
+                "expert_parallel.py:34)"
+            )
+
+    def expand_mlp(self, mlp_params: dict, key: Optional[jax.Array] = None) -> dict:
+        """(L, H, F) dense kernels -> (L, E, H, F) expert kernels."""
+        E = self.num_experts
+
+        def tile(x):
+            out = jnp.broadcast_to(x[:, None], (x.shape[0], E) + x.shape[1:])
+            return out
+
+        experts = jax.tree_util.tree_map(tile, mlp_params)
+        if self.jitter and key is not None:
+            leaves, treedef = jax.tree_util.tree_flatten(experts)
+            keys = jax.random.split(key, len(leaves))
+            leaves = [
+                x * (1 + self.jitter * jax.random.normal(k, x.shape, x.dtype))
+                for x, k in zip(leaves, keys)
+            ]
+            experts = jax.tree_util.tree_unflatten(treedef, leaves)
+        return experts
+
+    def init_router(self, key: jax.Array, n_layer: int, hidden: int, dtype=jnp.float32) -> dict:
+        return {
+            "gate": {
+                "kernel": (
+                    jax.random.normal(key, (n_layer, hidden, self.num_experts)) * 0.02
+                ).astype(dtype)
+            }
+        }
+
+    def expert_specs(self) -> dict:
+        from pipegoose_tpu.nn.expert_parallel.experts import expert_mlp_specs
+
+        return expert_mlp_specs(self.expert_axis, self.tensor_axis)
+
+    def from_dense(
+        self, params: dict, key: jax.Array, hidden: Optional[int] = None
+    ) -> dict:
+        """Upcycle a dense BLOOM params tree into BLOOM-MoE params: the
+        stacked dense MLP becomes the template for every expert
+        (reference semantics: the ExpertLayer reuses the wrapped dense
+        MLP, expert_parallel.py:53-80) and a fresh router gate is added."""
+        kj, kr = jax.random.split(key)
+        out = dict(params)
+        blocks = dict(params["blocks"])
+        mlp = blocks.pop("mlp")
+        blocks["moe"] = self.expand_mlp(mlp, kj if self.jitter else None)
+        n_layer = jax.tree_util.tree_leaves(mlp)[0].shape[0]
+        if hidden is None:
+            hidden = params["embed"]["weight"].shape[-1]
+        dtype = jax.tree_util.tree_leaves(mlp)[0].dtype
+        blocks["router"] = self.init_router(kr, n_layer, hidden, dtype)
+        out["blocks"] = blocks
+        return out
+
+    def parallelize(self, params: Any):
+        """Shard BLOOM-MoE params onto the mesh (reference API parity:
+        TensorParallel-style wrapper entry, expert_parallel.py:13-83)."""
+        from pipegoose_tpu.models.bloom_moe import moe_specs
+        from pipegoose_tpu.nn.parallel import shard_tree
+
+        specs = moe_specs(
+            params, tp_axis=self.tensor_axis or "tensor", ep_axis=self.expert_axis
+        )
+        return shard_tree(params, specs, self.parallel_context), specs

@@ -1,0 +1,124 @@
+"""Expert computation with all_to_all dispatch.
+
+TPU-native analog of the reference's ``Experts``/``ExpertLayer``
+(pipegoose/nn/expert_parallel/experts.py:15-102, layers.py:26-48). The
+reference holds num_experts/tp experts per rank and dispatches by
+boolean ``nonzero`` index-selects followed by an all_reduce combine
+(experts.py:41-80) — dynamic shapes, and every rank ships every token.
+Here dispatch is the GShard dataflow with static shapes:
+
+    local tokens --einsum dispatch--> (E, C, H)
+    all_to_all over the expert axis  -> (E_local, ep*C, H)
+    per-expert MLP (one batched einsum on the MXU)
+    all_to_all back                  -> (E, C, H)
+    --einsum combine--> local tokens
+
+Only capacity-bounded expert inputs cross the network, and expert grads
+stay local to the owning rank (the reference's ``is_expert``/EXPERT_DATA
+bookkeeping, experts.py:35-39 + data_parallel.py:35-43, falls out of the
+sharding specs instead).
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from pipegoose_tpu.distributed.functional import all_to_all
+from pipegoose_tpu.nn.expert_parallel.routers import RouterOutput, TopKRouter
+
+
+def init_experts(
+    key: jax.Array,
+    num_local_experts: int,
+    hidden: int,
+    ffn: int,
+    dtype=jnp.float32,
+    std: float = 0.02,
+) -> dict:
+    """Expert-stacked MLP params: leading dim = local experts."""
+    k1, k2 = jax.random.split(key)
+    return {
+        "up": {
+            "kernel": (jax.random.normal(k1, (num_local_experts, hidden, ffn)) * std).astype(dtype),
+            "bias": jnp.zeros((num_local_experts, ffn), dtype),
+        },
+        "down": {
+            "kernel": (jax.random.normal(k2, (num_local_experts, ffn, hidden)) * std).astype(dtype),
+            "bias": jnp.zeros((num_local_experts, hidden), dtype),
+        },
+    }
+
+
+def expert_mlp_specs(expert_axis: str = "expert", tensor_axis: Optional[str] = "tensor"):
+    """PartitionSpecs for stacked expert MLP params (L, E, in, out):
+    experts over the expert axis, FFN dim Megatron-sharded over tensor.
+    Single source consumed by bloom_moe.moe_specs and ExpertParallel."""
+    from jax.sharding import PartitionSpec as P
+
+    t = tensor_axis
+    e = expert_axis
+    return {
+        "up": {"kernel": P(None, e, None, t), "bias": P(None, e, t)},
+        "down": {"kernel": P(None, e, t, None), "bias": P(None, e, None)},
+    }
+
+
+def expert_mlp(
+    params: dict,
+    x: jax.Array,
+    act: Callable = jax.nn.gelu,
+    tp_axis: Optional[str] = None,
+) -> jax.Array:
+    """(E_local, S, H) -> (E_local, S, H), one batched einsum per matmul.
+
+    With ``tp_axis``, each expert's FFN dim is additionally Megatron-
+    sharded over the tensor axis (up column / down row + reduce) — the
+    4D interaction the reference only gestures at via its
+    num_experts % tp == 0 assert (expert_parallel.py:34)."""
+    from pipegoose_tpu.distributed.functional import reduce_from_tensor_group
+
+    h = jnp.einsum("esh,ehf->esf", x, params["up"]["kernel"],
+                   preferred_element_type=jnp.float32).astype(x.dtype)
+    h = act(h + params["up"]["bias"][:, None, :])
+    out = jnp.einsum("esf,efh->esh", h, params["down"]["kernel"],
+                     preferred_element_type=jnp.float32).astype(x.dtype)
+    if tp_axis is not None:
+        out = reduce_from_tensor_group(out, tp_axis)
+    return out + params["down"]["bias"][:, None, :]
+
+
+def moe_layer(
+    expert_params: dict,
+    x: jax.Array,  # (..., H) local tokens
+    routing: RouterOutput,
+    axis_name: Optional[str],
+    act: Callable = jax.nn.gelu,
+    tp_axis: Optional[str] = None,
+) -> jax.Array:
+    """Dispatch -> expert MLP -> combine. ``expert_params`` hold this
+    rank's E_local experts (stacked leading dim); ``routing`` covers the
+    E = E_local * ep global experts."""
+    orig_shape = x.shape
+    h = x.reshape(-1, orig_shape[-1])  # (T, H)
+    dispatch, combine = routing.dispatch, routing.combine
+    E = dispatch.shape[1]
+    e_local = jax.tree_util.tree_leaves(expert_params)[0].shape[0]
+    ep = 1 if axis_name is None else jax.lax.axis_size(axis_name)
+    if e_local * ep != E:
+        raise ValueError(
+            f"router has {E} experts but params hold {e_local} x ep={ep}"
+        )
+
+    # (T,H) -> (E, C, H): capacity-bucketed expert inputs
+    buckets = jnp.einsum("tec,th->ech", dispatch.astype(h.dtype), h)
+    if axis_name is not None and ep > 1:
+        # each rank keeps its E_local experts, gains every rank's C slots
+        buckets = all_to_all(buckets, axis_name, split_dim=0, concat_dim=1)
+    out = expert_mlp(expert_params, buckets, act, tp_axis=tp_axis)
+    if axis_name is not None and ep > 1:
+        out = all_to_all(out, axis_name, split_dim=1, concat_dim=0)
+    # (E, C, H) -> (T, H), gate-weighted
+    y = jnp.einsum("tec,ech->th", combine.astype(out.dtype), out)
+    return y.reshape(orig_shape)

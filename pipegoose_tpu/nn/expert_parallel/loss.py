@@ -1,0 +1,33 @@
+"""MoE auxiliary-loss combination.
+
+TPU-native analog of the reference's ``ExpertLoss`` + ``ExpertContext``
+(pipegoose/nn/expert_parallel/loss.py:8-29, expert_context.py:7-32). The
+reference accumulates aux/z losses in a process-global singleton pushed
+during forward and popped by the loss wrapper — incompatible with pure
+functions. Here model forwards RETURN their router losses (pytree of
+RouterOutput or scalars) and ``ExpertLoss`` just folds them in.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class ExpertLoss:
+    """loss = task_loss + aux_weight * sum(aux) + z_weight * sum(z)
+    (reference loss.py:25-29 semantics, functional plumbing)."""
+
+    aux_loss_weight: float = 0.01
+    z_loss_weight: float = 0.001
+
+    def __call__(self, task_loss: jax.Array, aux_losses: Any, z_losses: Any) -> jax.Array:
+        # SUM over layers/leaves, matching the reference's accumulate-
+        # then-sum (expert_context pushes per layer, loss.py:25-29) and
+        # Switch-Transformer hyperparameter conventions.
+        aux = sum(jnp.asarray(a).sum() for a in jax.tree_util.tree_leaves(aux_losses))
+        z = sum(jnp.asarray(a).sum() for a in jax.tree_util.tree_leaves(z_losses))
+        return task_loss + self.aux_loss_weight * aux + self.z_loss_weight * z

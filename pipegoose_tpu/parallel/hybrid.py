@@ -41,17 +41,23 @@ def _spec_mentions(spec: P, axis: str) -> bool:
 
 
 def sync_replicated_grads(grads: Any, param_specs: Any, axes: tuple) -> Any:
-    """psum grads of params NOT sharded over ``axis``, for each axis in
-    ``axes``. Needed when a replicated param is only *used* on some ranks
-    of an axis (pipe: embedding on the first stage, ln_f/LM head on the
-    last) — each rank then holds a partial contribution and the true
-    gradient is the sum (the pipe-axis analog of the reference's DP
-    grad hook, data_parallel.py:28-43)."""
+    """Reduce grads of params NOT sharded over an axis, for each entry in
+    ``axes`` — either a plain axis name (psum) or ``(axis, op)`` with op
+    in {"sum", "mean"}.
+
+    - "sum" (pipe): a replicated param *used* on only some ranks of the
+      axis (embedding on the first stage, ln_f/LM head on the last) —
+      each rank holds a partial contribution, the true grad is the sum.
+    - "mean" (expert): the axis carries *different tokens* (expert-data
+      parallelism) — replicated params average like DP (the reference's
+      EXPERT_DATA routing, data_parallel.py:35-43).
+    """
 
     def f(g, spec):
-        for ax in axes:
+        for entry in axes:
+            ax, op = entry if isinstance(entry, tuple) else (entry, "sum")
             if not _spec_mentions(spec, ax):
-                g = lax.psum(g, ax)
+                g = lax.psum(g, ax) if op == "sum" else lax.pmean(g, ax)
         return g
 
     return jax.tree_util.tree_map(
@@ -67,6 +73,7 @@ def make_hybrid_train_step(
     batch_spec: P = P("data"),
     loss_axis: str = "data",
     grad_sync_axes: tuple = (),
+    with_rng: bool = False,
 ):
     """Build (init_fn, step_fn), both jitted over the context's mesh.
 
@@ -79,6 +86,13 @@ def make_hybrid_train_step(
 
     step_fn(params, opt_state, batch) -> (params, opt_state, loss);
     params and opt_state buffers are donated.
+
+    ``with_rng=True``: ``loss_fn(params, batch, rng)`` and
+    ``step_fn(params, opt_state, batch, rng)`` — pass a FRESH key every
+    step (e.g. ``jax.random.fold_in(base, step)``); fold the data/expert
+    axis indices inside ``loss_fn`` for per-rank diversity (the
+    reference seeded every rank identically, parallel_context.py:253-261,
+    which SURVEY.md §7 flags as wrong for router noise).
     """
     ctx = parallel_context or ParallelContext.get_context()
     mesh = ctx.mesh
@@ -100,21 +114,22 @@ def make_hybrid_train_step(
         )
         return jax.jit(f)(params)
 
-    def _step(params, opt_state, batch):
-        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+    def _step(params, opt_state, batch, *rng):
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch, *rng)
         if grad_sync_axes:
             grads = sync_replicated_grads(grads, param_specs, grad_sync_axes)
         new_params, new_state = optimizer.step(grads, opt_state, params)
-        if optimizer.axis_name:
-            loss = lax.pmean(loss, loss_axis)
+        for ax in loss_axis if isinstance(loss_axis, tuple) else (loss_axis,):
+            loss = lax.pmean(loss, ax)
         return new_params, new_state, loss
 
     def make_step(params):
         spec = _state_spec_for(params)
+        in_specs = (param_specs, spec, batch_spec) + ((P(),) if with_rng else ())
         f = shard_map(
             _step,
             mesh=mesh,
-            in_specs=(param_specs, spec, batch_spec),
+            in_specs=in_specs,
             out_specs=(param_specs, spec, P()),
             check_vma=False,
         )

@@ -1,0 +1,100 @@
+"""Router unit tests — analog of the reference's
+tests/nn/expert_parallel/test_routers.py:1-88 (top-k selection, aux/z
+losses, capacity truncation, noise policy)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pipegoose_tpu.nn.expert_parallel import (
+    SwitchNoisePolicy,
+    Top1Router,
+    Top2Router,
+    TopKRouter,
+)
+
+H, E, T = 8, 4, 16
+
+
+def _gate(key=0):
+    return {"gate": {"kernel": jax.random.normal(jax.random.PRNGKey(key), (H, E))}}
+
+
+def _tokens(key=1):
+    return jax.random.normal(jax.random.PRNGKey(key), (T, H))
+
+
+def test_top1_dispatch_shape_and_onehot():
+    r = Top1Router(E, capacity_factor=10.0)  # capacity never binds
+    out = r(_gate(), _tokens())
+    C = r.capacity(T)
+    assert out.dispatch.shape == (T, E, C)
+    # every token dispatched exactly once
+    np.testing.assert_allclose(out.dispatch.sum(axis=(1, 2)), np.ones(T))
+    # dispatch matches argmax of router probs
+    probs = jax.nn.softmax(_tokens() @ _gate()["gate"]["kernel"], axis=-1)
+    np.testing.assert_array_equal(
+        np.asarray(out.dispatch.sum(axis=2).argmax(axis=1)), np.asarray(probs.argmax(1))
+    )
+
+
+def test_combine_weights_are_gate_probs():
+    r = Top1Router(E, capacity_factor=10.0)
+    out = r(_gate(), _tokens())
+    probs = jax.nn.softmax(_tokens() @ _gate()["gate"]["kernel"], axis=-1)
+    np.testing.assert_allclose(
+        np.asarray(out.combine.sum(axis=(1, 2))), np.asarray(probs.max(axis=1)), rtol=1e-5
+    )
+
+
+def test_capacity_truncation():
+    """With capacity 1, each expert takes at most one token — earlier
+    tokens win (reference cumsum-position semantics, routers.py:133-143)."""
+    r = TopKRouter(num_experts=E, top_k=1)
+    out = r(_gate(), _tokens(), capacity=1)
+    per_expert = np.asarray(out.dispatch.sum(axis=(0, 2)))
+    assert (per_expert <= 1).all()
+    # dropped tokens have zero combine weight
+    dropped = np.asarray(out.dispatch.sum(axis=(1, 2))) == 0
+    assert dropped.any()
+    np.testing.assert_allclose(np.asarray(out.combine.sum(axis=(1, 2)))[dropped], 0)
+
+
+def test_top2_two_slots_and_normalized_gates():
+    r = Top2Router(E, capacity_factor=10.0)
+    out = r(_gate(), _tokens())
+    np.testing.assert_allclose(out.dispatch.sum(axis=(1, 2)), 2 * np.ones(T))
+    np.testing.assert_allclose(out.combine.sum(axis=(1, 2)), np.ones(T), rtol=1e-5)
+
+
+def test_aux_and_z_losses():
+    r = Top1Router(E, capacity_factor=10.0)
+    out = r(_gate(), _tokens())
+    logits = _tokens() @ _gate()["gate"]["kernel"]
+    probs = jax.nn.softmax(logits, axis=-1)
+    f = np.zeros(E)
+    for e in np.asarray(probs.argmax(1)):
+        f[e] += 1 / T
+    expected_aux = E * float((f * np.asarray(probs.mean(0))).sum())
+    assert abs(float(out.aux_loss) - expected_aux) < 1e-5
+    expected_z = float((np.asarray(jax.nn.logsumexp(logits, axis=-1)) ** 2).mean())
+    assert abs(float(out.z_loss) - expected_z) < 1e-4
+    # perfectly balanced routing gives aux_loss ~ 1
+    uniform = TopKRouter(num_experts=E, top_k=1, noise=None)
+    ids = jnp.eye(E).repeat(T // E, axis=0) * 10  # force balanced argmax
+    outb = uniform({"gate": {"kernel": jnp.eye(E)}}, ids.astype(jnp.float32),
+                   capacity=T)
+    assert abs(float(outb.aux_loss) - 1.0) < 0.05
+
+
+def test_noise_changes_routing_only_in_train():
+    r = TopKRouter(num_experts=E, top_k=1, noise=SwitchNoisePolicy(0.5))
+    out1 = r(_gate(), _tokens(), train=False)
+    out2 = r(_gate(), _tokens(), train=False)
+    np.testing.assert_array_equal(np.asarray(out1.dispatch), np.asarray(out2.dispatch))
+    k1, k2 = jax.random.PRNGKey(11), jax.random.PRNGKey(12)
+    o1 = r(_gate(), _tokens(), key=k1, train=True)
+    o2 = r(_gate(), _tokens(), key=k2, train=True)
+    assert not np.array_equal(np.asarray(o1.combine), np.asarray(o2.combine))
+    with pytest.raises(ValueError):
+        r(_gate(), _tokens(), train=True)  # needs key
