@@ -158,6 +158,23 @@ def bloom_gelu(x: jax.Array) -> jax.Array:
 
 # -- forward ---------------------------------------------------------------
 
+def _local_heads(config: BloomConfig, tp: int) -> int:
+    if config.n_head % tp != 0:
+        raise ValueError(
+            f"n_head={config.n_head} must be divisible by the tensor axis "
+            f"size {tp} (whole heads per shard)"
+        )
+    return config.n_head // tp
+
+
+def _mlp(blk: dict, x: jax.Array, config: BloomConfig, tp_axis) -> jax.Array:
+    """ln_2 -> column up -> gelu -> row down (single source for the
+    dense, pipeline, and sequence-parallel block paths)."""
+    ln2 = layer_norm(blk["ln_2"], x, config.layer_norm_epsilon)
+    h = column_parallel_linear(blk["mlp"]["up"], ln2, tp_axis)
+    return row_parallel_linear(blk["mlp"]["down"], bloom_gelu(h), tp_axis)
+
+
 def _attention(
     blk: dict,
     x: jax.Array,
@@ -173,12 +190,7 @@ def _attention(
     b, s, _ = x.shape
     hd = config.head_dim
     tp = jax.lax.axis_size(tp_axis) if tp_axis else 1
-    if config.n_head % tp != 0:
-        raise ValueError(
-            f"n_head={config.n_head} must be divisible by the tensor axis "
-            f"size {tp} (whole heads per shard)"
-        )
-    local_heads = config.n_head // tp
+    local_heads = _local_heads(config, tp)
 
     fused = column_parallel_linear(blk["qkv"], x, tp_axis)  # (B,S,3H/tp)
     fused = fused.reshape(b, s, local_heads, 3, hd)
@@ -210,11 +222,7 @@ def _block(
     eps = config.layer_norm_epsilon
     ln1 = layer_norm(blk["ln_1"], x, eps)
     x = x + _attention(blk["attn"], ln1, alibi, mask_bias, config, tp_axis)
-    ln2 = layer_norm(blk["ln_2"], x, eps)
-    h = column_parallel_linear(blk["mlp"]["up"], ln2, tp_axis)
-    h = bloom_gelu(h)
-    x = x + row_parallel_linear(blk["mlp"]["down"], h, tp_axis)
-    return x
+    return x + _mlp(blk, x, config, tp_axis)
 
 
 def embed_tokens(
@@ -438,3 +446,101 @@ def pp_specs(params: dict, tp_axis: str = "tensor", pipe_axis: str = "pipe") -> 
     specs = tp_specs(params, tp_axis)
     specs["blocks"] = pipe_stage_specs(specs["blocks"], pipe_axis)
     return specs
+
+
+# -- sequence-parallel composition ------------------------------------------
+
+def _attention_sp(
+    blk: dict,
+    x: jax.Array,  # (B, S_local, H)
+    config: BloomConfig,
+    tp_axis: Optional[str],
+    sp_axis: str,
+    pad_mask_local: jax.Array,  # (B, S_local)
+) -> jax.Array:
+    """BLOOM attention with the sequence sharded over ``sp_axis`` (ring
+    attention) and heads over ``tp_axis``. ALiBi uses plain global key
+    positions — identical to HF's mask-aware positions for unpadded or
+    right-padded batches (the cumsum trick only differs under left/
+    interior padding)."""
+    from pipegoose_tpu.nn.sequence_parallel.ring_attention import (
+        make_causal_alibi_bias_fn,
+        ring_attention,
+    )
+
+    b, s_local, _ = x.shape
+    hd = config.head_dim
+    tp = jax.lax.axis_size(tp_axis) if tp_axis else 1
+    local_heads = _local_heads(config, tp)
+
+    fused = column_parallel_linear(blk["qkv"], x, tp_axis)
+    fused = fused.reshape(b, s_local, local_heads, 3, hd)
+    q, k, v = fused[..., 0, :], fused[..., 1, :], fused[..., 2, :]
+
+    slopes = jnp.asarray(alibi_slopes(config.n_head))
+    if tp_axis:
+        h0 = jax.lax.axis_index(tp_axis) * local_heads
+        slopes = jax.lax.dynamic_slice_in_dim(slopes, h0, local_heads, 0)
+
+    bias_fn = make_causal_alibi_bias_fn(s_local, sp_axis, alibi_slopes=slopes)
+    ctx = ring_attention(q, k, v, sp_axis, bias_fn, kv_side=pad_mask_local)
+    ctx = ctx.reshape(b, s_local, local_heads * hd)
+    return row_parallel_linear(blk["out"], ctx, tp_axis)
+
+
+def loss_fn_sp(
+    params: dict,
+    input_ids: jax.Array,  # (B, S_local) — sequence sharded over sp_axis
+    attention_mask: Optional[jax.Array],
+    labels: jax.Array,
+    config: BloomConfig,
+    tp_axis: Optional[str] = None,
+    sp_axis: str = "seq",
+) -> jax.Array:
+    """Sequence-parallel causal-LM loss: every activation tensor lives
+    sequence-sharded; attention is the ring; the next-token target at
+    each chunk boundary arrives by one ppermute of the label chunk.
+    Gradients of (seq-replicated) params are partial per rank — sum them
+    over ``sp_axis`` (grad_sync_axes=(("seq","sum"),))."""
+    from pipegoose_tpu.distributed.functional import (
+        reduce_from_tensor_group,
+        shift_left,
+    )
+
+    b, s_local = input_ids.shape
+    if attention_mask is None:
+        attention_mask = jnp.ones((b, s_local), dtype=jnp.int32)
+
+    x = embed_tokens(params, input_ids, config, tp_axis)
+
+    def scan_fn(carry, blk):
+        h = carry
+        ln1 = layer_norm(blk["ln_1"], h, config.layer_norm_epsilon)
+        attn_blk = {"qkv": blk["attn"]["qkv"], "out": blk["attn"]["out"]}
+        h = h + _attention_sp(attn_blk, ln1, config, tp_axis, sp_axis, attention_mask)
+        return h + _mlp(blk, h, config, tp_axis), None
+
+    step = jax.checkpoint(scan_fn) if config.remat else scan_fn
+    x, _ = jax.lax.scan(step, x, params["blocks"])
+    x = layer_norm(params["ln_f"], x, config.layer_norm_epsilon)
+
+    logits = logits_fn(params, x, tp_axis)  # (B, S_local, V/tp)
+
+    # global shift-by-one: within-chunk shift + first element of the NEXT
+    # chunk via ring (the last rank's trailing target is padding-masked)
+    sp = jax.lax.axis_size(sp_axis)
+    rank = jax.lax.axis_index(sp_axis)
+    next_first_label = shift_left(labels[:, :1], sp_axis)  # (B, 1)
+    next_first_w = shift_left(attention_mask[:, :1], sp_axis)
+    shifted_labels = jnp.concatenate([labels[:, 1:], next_first_label], axis=1)
+    shifted_w = jnp.concatenate([attention_mask[:, 1:], next_first_w], axis=1)
+    is_last = rank == sp - 1
+    shifted_w = shifted_w.at[:, -1].multiply(jnp.where(is_last, 0, 1))
+
+    per_tok = vocab_parallel_cross_entropy(logits, shifted_labels, tp_axis)
+    w = shifted_w.astype(per_tok.dtype)
+    total = (per_tok * w).sum()
+    count = jax.lax.psum(w.sum(), sp_axis)
+    # identity-backward combine: each rank's grads stay local and are
+    # psum'd over sp by the train step
+    return reduce_from_tensor_group(total / jnp.maximum(count, 1), sp_axis)

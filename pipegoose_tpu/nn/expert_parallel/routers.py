@@ -60,7 +60,11 @@ class TopKRouter:
     normalize_gates: bool = True  # for k > 1, renormalize kept gates
 
     def capacity(self, n_tokens: int) -> int:
-        return max(1, int(n_tokens * self.top_k * self.capacity_factor) // self.num_experts)
+        # ceil, per the GShard/Switch convention — floor would drop tokens
+        # under perfectly balanced routing despite the headroom factor
+        import math
+
+        return max(1, math.ceil(n_tokens * self.top_k * self.capacity_factor / self.num_experts))
 
     def __call__(
         self,

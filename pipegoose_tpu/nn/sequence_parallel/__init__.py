@@ -1,0 +1,7 @@
+from pipegoose_tpu.nn.sequence_parallel.ring_attention import (
+    make_causal_alibi_bias_fn,
+    ring_attention,
+)
+from pipegoose_tpu.nn.sequence_parallel.ulysses import ulysses_attention
+
+__all__ = ["ring_attention", "make_causal_alibi_bias_fn", "ulysses_attention"]

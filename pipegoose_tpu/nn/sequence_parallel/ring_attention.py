@@ -1,0 +1,135 @@
+"""Ring attention: exact attention over a sequence-sharded axis.
+
+NEW CAPABILITY: the reference advertises sequence parallelism
+(README.md:96) but implements none — no ring/Ulysses/context-parallel
+code exists in its tree, and the reduce_scatter it would need is an
+empty stub (SURVEY.md §5). This module provides the real thing, designed
+for ICI:
+
+- the sequence is sharded over the ``seq`` mesh axis: each device holds
+  a (B, S/sp, H) chunk of Q, K, V;
+- sp ring steps: attend local Q against the resident K/V block with a
+  flash-attention-style online softmax (running max / denominator /
+  accumulator — numerically exact, O(S_local^2) memory), then rotate
+  K/V one hop with ``lax.ppermute``;
+- communication is overlappable K/V block transfers around the ring —
+  total bytes = K+V once around, independent of the attention matrix;
+- backward is reverse-mode AD through the scan (the reverse ring).
+
+Bias (causal mask, padding, ALiBi) is supplied per block via
+``bias_fn(kv_rank, kv_pad_mask)`` so any additive attention bias works;
+block global positions are reconstructed from the rank indices.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from pipegoose_tpu.distributed.functional import shift_right
+
+NEG_INF = -1e9
+
+
+def ring_attention(
+    q: jax.Array,  # (B, Sq_local, nh, hd)
+    k: jax.Array,  # (B, Skv_local, nh, hd)
+    v: jax.Array,  # (B, Skv_local, nh, hd)
+    axis_name: Optional[str],
+    bias_fn: Callable[[jax.Array], jax.Array],
+    kv_side: Optional[jax.Array] = None,  # e.g. (B, Skv_local) pad mask, rides the ring
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Exact softmax(QK^T * scale + bias) V with K/V ring rotation.
+
+    ``bias_fn(kv_rank[, kv_side_block]) -> (B|1, nh|1, Sq, Skv)`` additive
+    bias for the block where the resident K/V originated at ``kv_rank``.
+    With ``axis_name=None`` this is single-device flash-style attention
+    (one step, kv_rank = 0).
+    """
+    b, sq, nh, hd = q.shape
+    if scale is None:
+        scale = hd**-0.5
+    sp = lax.axis_size(axis_name) if axis_name else 1
+    rank = lax.axis_index(axis_name) if axis_name else 0
+
+    qf = q.astype(jnp.float32) * scale
+
+    def block(m, l, o, k_t, v_t, kv_rank, side_t):
+        s = jnp.einsum("bqhd,bkhd->bhqk", qf, k_t.astype(jnp.float32))
+        bias = bias_fn(kv_rank, side_t) if side_t is not None else bias_fn(kv_rank)
+        s = s + bias
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        # fully-masked rows keep m = NEG_INF; avoid inf-inf -> nan
+        p = jnp.exp(s - m_new[..., None])
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + p.sum(axis=-1)
+        pv = jnp.einsum("bhqk,bkhd->bhqd", p, v_t.astype(jnp.float32))
+        o_new = o * alpha[..., None] + pv
+        return m_new, l_new, o_new
+
+    m0 = jnp.full((b, nh, sq), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, nh, sq), jnp.float32)
+    o0 = jnp.zeros((b, nh, sq, hd), jnp.float32)
+
+    if sp == 1:
+        m, l, o = block(m0, l0, o0, k, v, jnp.asarray(0), kv_side)
+    else:
+        # sp-1 (block + rotate) steps, then a final block with NO rotation
+        # — a rotation after the last block would be a dead K+V transfer
+        # every layer (XLA can't DCE a collective feeding the loop carry)
+
+        def scan_fn(carry, t):
+            m, l, o, k_t, v_t, side_t = carry
+            kv_rank = (rank - t) % sp
+            m, l, o = block(m, l, o, k_t, v_t, kv_rank, side_t)
+            # rotate K/V (and side data) to the next rank
+            k_t = shift_right(k_t, axis_name)
+            v_t = shift_right(v_t, axis_name)
+            if side_t is not None:
+                side_t = shift_right(side_t, axis_name)
+            return (m, l, o, k_t, v_t, side_t), None
+
+        (m, l, o, k_t, v_t, side_t), _ = lax.scan(
+            scan_fn, (m0, l0, o0, k, v, kv_side), jnp.arange(sp - 1)
+        )
+        m, l, o = block(m, l, o, k_t, v_t, (rank - (sp - 1)) % sp, side_t)
+
+    out = o / jnp.maximum(l[..., None], 1e-30)
+    return jnp.einsum("bhqd->bqhd", out).astype(q.dtype)
+
+
+def make_causal_alibi_bias_fn(
+    seq_local: int,
+    axis_name: Optional[str],
+    alibi_slopes: Optional[jax.Array] = None,  # (nh,)
+    q_rank: Optional[jax.Array] = None,
+):
+    """Block bias for BLOOM-style attention under sequence sharding:
+    causal mask on GLOBAL positions + ALiBi (slope * global key position)
+    + padding mask from the K/V chunk's attention mask (rides the ring
+    as ``kv_side``)."""
+    rank = (
+        q_rank
+        if q_rank is not None
+        else (lax.axis_index(axis_name) if axis_name else 0)
+    )
+    q_pos = rank * seq_local + jnp.arange(seq_local)  # (Sq,)
+
+    def bias_fn(kv_rank, kv_pad_mask=None):
+        kv_pos = kv_rank * seq_local + jnp.arange(seq_local)  # (Skv,)
+        causal = q_pos[:, None] >= kv_pos[None, :]  # (Sq, Skv)
+        bias = jnp.where(causal, 0.0, NEG_INF)[None, None]  # (1,1,Sq,Skv)
+        if alibi_slopes is not None:
+            # NOTE: mask-aware position (cumsum) needs global context; for
+            # right-padded batches plain positions match HF's alibi
+            bias = bias + alibi_slopes[None, :, None, None] * kv_pos[None, None, None, :].astype(jnp.float32)
+        if kv_pad_mask is not None:
+            keep = kv_pad_mask[:, None, None, :] > 0  # (B,1,1,Skv)
+            bias = bias + jnp.where(keep, 0.0, NEG_INF)
+        return bias
+
+    return bias_fn

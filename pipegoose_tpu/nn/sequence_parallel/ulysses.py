@@ -1,0 +1,43 @@
+"""Ulysses-style (DeepSpeed) sequence parallelism: all_to_all
+head/sequence exchange.
+
+NEW CAPABILITY (absent from the reference — SURVEY.md §5). Where ring
+attention keeps heads whole and rotates K/V blocks, Ulysses transposes
+the sharding: activations enter sharded on SEQUENCE, two ``all_to_all``
+ops re-shard them on HEADS for the attention proper (each device sees
+the full sequence for nh/sp heads), and a final all_to_all restores
+sequence sharding. Exact attention, 4 collectives per layer, best when
+nh >= sp and sequence lengths make ring accumulation latency-bound.
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from pipegoose_tpu.distributed.functional import all_to_all
+
+
+def ulysses_attention(
+    q: jax.Array,  # (B, S_local, nh, hd)
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: Optional[str],
+    attn_fn: Callable[[jax.Array, jax.Array, jax.Array], jax.Array],
+    # attn_fn(q, k, v) -> (B, S_full, nh_local, hd): full-sequence
+    # attention on the local head subset (masks/bias applied inside)
+) -> jax.Array:
+    """seq-sharded -> head-sharded -> attn -> seq-sharded."""
+    if axis_name is None:
+        return attn_fn(q, k, v)
+
+    def seq_to_heads(x):
+        # (B, S/sp, nh, hd) -> (B, S, nh/sp, hd)
+        return all_to_all(x, axis_name, split_dim=2, concat_dim=1)
+
+    def heads_to_seq(x):
+        return all_to_all(x, axis_name, split_dim=1, concat_dim=2)
+
+    out = attn_fn(seq_to_heads(q), seq_to_heads(k), seq_to_heads(v))
+    return heads_to_seq(out)

@@ -53,6 +53,11 @@ def sync_replicated_grads(grads: Any, param_specs: Any, axes: tuple) -> Any:
       EXPERT_DATA routing, data_parallel.py:35-43).
     """
 
+    for entry in axes:
+        _, op = entry if isinstance(entry, tuple) else (entry, "sum")
+        if op not in ("sum", "mean"):
+            raise ValueError(f"grad sync op must be 'sum' or 'mean', got {op!r}")
+
     def f(g, spec):
         for entry in axes:
             ax, op = entry if isinstance(entry, tuple) else (entry, "sum")
