@@ -70,6 +70,18 @@ def sync_replicated_grads(grads: Any, param_specs: Any, axes: tuple) -> Any:
     )
 
 
+def zero_state_spec(
+    optimizer: DistributedOptimizer, params: Any, param_specs: Any, mesh
+) -> ZeroState:
+    """PartitionSpec tree for the ZeRO-1 optimizer state on ``mesh`` —
+    used by the train step's in/out specs and by checkpoint restore
+    (restoring without these would replicate the sharded state)."""
+    dp = optimizer.axis_name and mesh.shape.get(optimizer.axis_name, 1) or 1
+    shapes = jax.eval_shape(optimizer.inner.init, shard_shapes(params, dp))
+    inner_spec = state_specs(shapes, params, param_specs, optimizer.axis_name or "data")
+    return ZeroState(inner_spec)
+
+
 def make_hybrid_train_step(
     loss_fn: Callable[[Any, Any], jax.Array],
     param_specs: Any,
@@ -101,12 +113,9 @@ def make_hybrid_train_step(
     """
     ctx = parallel_context or ParallelContext.get_context()
     mesh = ctx.mesh
-    dp = optimizer.axis_name and mesh.shape.get(optimizer.axis_name, 1) or 1
 
     def _state_spec_for(params):
-        shapes = jax.eval_shape(optimizer.inner.init, shard_shapes(params, dp))
-        inner_spec = state_specs(shapes, params, param_specs, optimizer.axis_name or "data")
-        return ZeroState(inner_spec)
+        return zero_state_spec(optimizer, params, param_specs, mesh)
 
     def init_fn(params):
         spec = _state_spec_for(params)

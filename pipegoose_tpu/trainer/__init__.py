@@ -1,0 +1,14 @@
+from pipegoose_tpu.trainer.callback import Callback, CheckpointCallback, LossLoggerCallback
+from pipegoose_tpu.trainer.logger import DistributedLogger
+from pipegoose_tpu.trainer.state import TrainerState, TrainerStatus
+from pipegoose_tpu.trainer.trainer import Trainer
+
+__all__ = [
+    "Trainer",
+    "Callback",
+    "LossLoggerCallback",
+    "CheckpointCallback",
+    "DistributedLogger",
+    "TrainerState",
+    "TrainerStatus",
+]

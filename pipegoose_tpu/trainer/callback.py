@@ -1,0 +1,82 @@
+"""Trainer callbacks.
+
+Real implementation of the reference's no-op ``Callback``
+(pipegoose/trainer/callback.py:4-14). Hooks mirror and extend its
+on_fit_start/on_fit_end surface with per-step and checkpoint events.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+
+class Callback:
+    order: int = 0
+
+    def on_fit_start(self, trainer: Any) -> None: ...
+
+    def on_fit_end(self, trainer: Any) -> None: ...
+
+    def on_step_start(self, trainer: Any, step: int) -> None: ...
+
+    def on_step_end(self, trainer: Any, step: int, loss: float) -> None: ...
+
+    def on_checkpoint(self, trainer: Any, step: int, path: str) -> None: ...
+
+
+class LossLoggerCallback(Callback):
+    """Periodic loss/throughput logging via the trainer's logger."""
+
+    def __init__(self, every: int = 10):
+        self.every = every
+        self._t0: Optional[float] = None
+        self._tokens = 0
+
+    def on_step_end(self, trainer: Any, step: int, loss: float) -> None:
+        import time
+
+        self._tokens += trainer.tokens_per_step
+        if self._t0 is None:
+            self._t0 = time.perf_counter()
+            self._tokens = 0
+            return
+        if step % self.every == 0:
+            dt = time.perf_counter() - self._t0
+            tps = self._tokens / dt if dt > 0 else float("nan")
+            trainer.logger.info(
+                f"step {step} loss {float(loss):.4f} tokens/s {tps:,.0f}"
+            )
+            self._t0 = time.perf_counter()
+            self._tokens = 0
+
+
+class CheckpointCallback(Callback):
+    """Periodic sharded checkpointing of the full train state."""
+
+    def __init__(self, directory: str, every: int = 1000, save_final: bool = True):
+        self.directory = directory
+        self.every = every
+        self.save_final = save_final
+        self._last_saved = -1
+
+    def _save(self, trainer: Any, step: int) -> None:
+        from pipegoose_tpu.utils.checkpoint import save_train_state
+
+        path = save_train_state(self.directory, step, trainer.params, trainer.opt_state)
+        self._last_saved = step
+        trainer.logger.info(f"checkpointed step {step} -> {path}")
+        for cb in trainer.callbacks:
+            cb.on_checkpoint(trainer, step, path)
+
+    def on_step_end(self, trainer: Any, step: int, loss: float) -> None:
+        if step > 0 and step % self.every == 0:
+            self._save(trainer, step)
+
+    def on_fit_end(self, trainer: Any) -> None:
+        # short runs would otherwise end with NO checkpoint despite the
+        # user configuring a checkpoint directory
+        from pipegoose_tpu.utils.checkpoint import latest_step
+
+        existing = latest_step(self.directory)
+        already = max(self._last_saved, existing if existing is not None else -1)
+        if self.save_final and trainer.state.step > already:
+            self._save(trainer, trainer.state.step)

@@ -1,0 +1,69 @@
+"""Distributed-aware logger.
+
+Real implementation of the reference's empty ``DistributedLogger`` stub
+(pipegoose/trainer/logger.py:4-14): same constructor shape
+(name, rank-filtering), actually logs. In JAX's single-controller model
+"rank" means the host process (``jax.process_index``) — by default only
+process 0 emits, matching the reference's intended rank-0 filtering.
+"""
+from __future__ import annotations
+
+import logging
+import sys
+from typing import Optional
+
+
+class DistributedLogger:
+    def __init__(
+        self,
+        name: str = "pipegoose_tpu",
+        rank: Optional[int] = 0,
+        level: int = logging.INFO,
+        logfile: Optional[str] = None,
+    ):
+        """``rank``: only this process index logs; None = all processes."""
+        self.name = name
+        self.rank = rank
+        self._logger = logging.getLogger(name)
+        self._logger.setLevel(level)
+        self._logger.propagate = False  # avoid duplicate lines via root
+        fmt = logging.Formatter("[%(asctime)s %(name)s %(levelname)s] %(message)s")
+        if not any(
+            isinstance(h, logging.StreamHandler)
+            and not isinstance(h, logging.FileHandler)
+            for h in self._logger.handlers
+        ):
+            h = logging.StreamHandler(sys.stdout)
+            h.setFormatter(fmt)
+            self._logger.addHandler(h)
+        if logfile and not any(
+            isinstance(h, logging.FileHandler)
+            and getattr(h, "baseFilename", None) == __import__("os").path.abspath(logfile)
+            for h in self._logger.handlers
+        ):
+            fh = logging.FileHandler(logfile)
+            fh.setFormatter(fmt)
+            self._logger.addHandler(fh)
+
+    def _should_log(self) -> bool:
+        if self.rank is None:
+            return True
+        import jax
+
+        return jax.process_index() == self.rank
+
+    def info(self, msg: str) -> None:
+        if self._should_log():
+            self._logger.info(msg)
+
+    def warning(self, msg: str) -> None:
+        if self._should_log():
+            self._logger.warning(msg)
+
+    def error(self, msg: str) -> None:
+        if self._should_log():
+            self._logger.error(msg)
+
+    def debug(self, msg: str) -> None:
+        if self._should_log():
+            self._logger.debug(msg)

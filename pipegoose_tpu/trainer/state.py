@@ -1,0 +1,26 @@
+"""Trainer lifecycle state.
+
+Analog of the reference's ``TrainerStatus``/``TrainerState`` enums
+(pipegoose/trainer/state.py:4-19), extended with the actual mutable
+run-state (step, last loss, loss history) the reference never filled in.
+"""
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import List, Optional
+
+
+class TrainerStatus(str, enum.Enum):
+    INITIALIZING = "initializing"
+    RUNNING = "running"
+    FINISHED = "finished"
+    INTERRUPTED = "interrupted"
+
+
+@dataclasses.dataclass
+class TrainerState:
+    status: TrainerStatus = TrainerStatus.INITIALIZING
+    step: int = 0
+    last_loss: Optional[float] = None
+    losses: List[float] = dataclasses.field(default_factory=list)

@@ -1,0 +1,120 @@
+"""Sharded, mesh-aware checkpointing.
+
+TPU-native analog of the reference's checkpoint utils
+(pipegoose/nn/utils.py:11-50), which write one torch state_dict file per
+(tp, pp) coordinate named ``pytorch_model_tp_{tp}_pp_{pp}.bin``
+(constants.py:4-5) — no optimizer state, no resharding on load, no async
+save (SURVEY.md §5 flags this as a capability gap). Here checkpoints are
+orbax/tensorstore: every array is written once in a sharded,
+layout-independent format, and restore RESHARDS onto whatever mesh the
+current run uses (different tp/pp/dp than the run that saved — the thing
+the reference's per-coordinate files cannot do). Optimizer state and
+step counters ride along in the same tree.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from pipegoose_tpu.distributed.parallel_context import ParallelContext
+
+
+def _checkpointer():
+    import orbax.checkpoint as ocp
+
+    return ocp.StandardCheckpointer()
+
+
+def save_pretrained(params: Any, path: str, step: Optional[int] = None) -> str:
+    """Write a sharded checkpoint (reference save_pretrained,
+    nn/utils.py:11-28). Directory layout is orbax-standard; ``step``
+    creates a numbered subdirectory for resumable training runs."""
+    path = os.path.abspath(path)
+    if step is not None:
+        path = os.path.join(path, f"step_{step}")
+    ckpt = _checkpointer()
+    ckpt.save(path, params)
+    ckpt.wait_until_finished()
+    return path
+
+
+def from_pretrained(
+    path: str,
+    like: Any,
+    specs: Any = None,
+    parallel_context: Optional[ParallelContext] = None,
+) -> Any:
+    """Restore onto the CURRENT mesh, resharding as needed (reference
+    from_pretrained, nn/utils.py:31-50, could only reload the exact
+    (tp, pp) layout that saved). ``like`` is a pytree of arrays or
+    ShapeDtypeStructs giving structure/shape/dtype; ``specs`` (optional)
+    a matching PartitionSpec tree for the target sharding."""
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    ctx = parallel_context or ParallelContext.get_context()
+
+    def to_struct(x, spec):
+        shape = x.shape
+        dtype = x.dtype
+        if ctx is not None and spec is not None:
+            sharding = NamedSharding(ctx.mesh, spec)
+        elif ctx is not None:
+            sharding = NamedSharding(ctx.mesh, P())
+        else:
+            sharding = None
+        return jax.ShapeDtypeStruct(shape, dtype, sharding=sharding)
+
+    if specs is None:
+        specs = jax.tree_util.tree_map(lambda _: None, like)
+    target = jax.tree_util.tree_map(
+        to_struct, like, specs, is_leaf=lambda x: hasattr(x, "shape")
+    )
+    return _checkpointer().restore(path, target)
+
+
+def latest_step(path: str) -> Optional[int]:
+    """Largest ``step_N`` subdirectory, for resume."""
+    path = os.path.abspath(path)
+    if not os.path.isdir(path):
+        return None
+    steps = []
+    for name in os.listdir(path):
+        if name.startswith("step_"):
+            try:
+                steps.append(int(name.split("_", 1)[1]))
+            except ValueError:
+                continue
+    return max(steps) if steps else None
+
+
+def save_train_state(
+    path: str, step: int, params: Any, opt_state: Any = None, extra: Any = None
+) -> str:
+    """Checkpoint the full training state (params + optimizer shards +
+    counters) — absent from the reference entirely (SURVEY.md §5)."""
+    tree = {"params": params}
+    if opt_state is not None:
+        tree["opt_state"] = opt_state
+    if extra is not None:
+        tree["extra"] = extra
+    return save_pretrained(tree, path, step=step)
+
+
+def restore_train_state(
+    path: str,
+    step: Optional[int],
+    like: Any,
+    specs: Any = None,
+    parallel_context: Optional[ParallelContext] = None,
+) -> Any:
+    if step is None:
+        step = latest_step(path)
+        if step is None:
+            raise FileNotFoundError(f"no step_N checkpoints under {path}")
+    return from_pretrained(
+        os.path.join(path, f"step_{step}"), like, specs, parallel_context
+    )
