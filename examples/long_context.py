@@ -64,7 +64,8 @@ def main():
         for _ in range(args.steps)
     )
     state = trainer.fit(batches, max_steps=args.steps)
-    print(f"done: {state.step} steps, final loss {state.last_loss:.4f}")
+    last = f"{float(state.last_loss):.4f}" if state.last_loss is not None else "n/a (no new steps)"
+    print(f"done: {state.step} steps, final loss {last}")
 
 
 if __name__ == "__main__":

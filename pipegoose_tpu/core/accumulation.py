@@ -1,0 +1,70 @@
+"""Gradient accumulation.
+
+Occupies the role of the reference's ``core/bucket`` subsystem (Bucket:
+bucket.py:6-88, BucketDistributor: dist.py:26-67 — a fixed-size grad
+buffer meant to batch DP all-reduces, left unfinished and unwired,
+SURVEY.md §2.1). On TPU the *communication* half of bucketing is moot —
+the whole grad pytree is reduced by one fused XLA collective per step —
+so what remains genuinely useful is the *memory* half: accumulating
+gradients over K microbatches to train with large effective batches.
+Here that is a ``lax.scan`` inside the compiled step: the accumulator
+buffer is the scan carry, no host-side bucket bookkeeping.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def accumulate_gradients(
+    loss_fn: Callable[[Any, Any], jax.Array],
+    params: Any,
+    microbatches: Any,  # pytree with leading dim K
+    mean: bool = True,
+):
+    """(mean_loss, accumulated_grads) over the K leading-dim microbatches.
+
+    One compiled scan: grads for microbatch i are formed and folded into
+    the running sum before microbatch i+1's activations exist — the same
+    peak-memory effect the reference's Bucket.add_tensor re-pointing
+    chased (bucket.py:53-55), without mutation.
+    """
+    K = jax.tree_util.tree_leaves(microbatches)[0].shape[0]
+    grad_fn = jax.value_and_grad(loss_fn)
+
+    def body(carry, mb):
+        loss_sum, gsum = carry
+        loss, grads = grad_fn(params, mb)
+        gsum = jax.tree_util.tree_map(jnp.add, gsum, grads)
+        return (loss_sum + loss, gsum), None
+
+    zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
+    (loss_sum, gsum), _ = lax.scan(body, (jnp.zeros(()), zeros), microbatches)
+    if mean:
+        loss_sum = loss_sum / K
+        gsum = jax.tree_util.tree_map(lambda g: g / K, gsum)
+    return loss_sum, gsum
+
+
+def make_accumulating_loss(
+    loss_fn: Callable[[Any, Any], jax.Array], n_accum: int
+) -> Callable[[Any, Any], jax.Array]:
+    """Wrap a per-batch loss into one that splits its batch into
+    ``n_accum`` microbatches and averages — drop-in for
+    make_hybrid_train_step's loss_fn (grads then accumulate through the
+    scan automatically under value_and_grad)."""
+    from pipegoose_tpu.nn.pipeline_parallel.microbatch import split
+
+    def wrapped(params, batch):
+        mbs = split(batch, n_accum)
+
+        def body(loss_sum, mb):
+            return loss_sum + loss_fn(params, mb), None
+
+        total, _ = lax.scan(body, jnp.zeros(()), mbs)
+        return total / n_accum
+
+    return wrapped

@@ -1,0 +1,147 @@
+"""Autoregressive generation with a KV cache for BLOOM.
+
+The reference relies on HF's ``model.generate`` over the wrapped torch
+module (convergence scripts); a standalone framework needs its own
+decode path. TPU-native design: a fixed-size (max_len) cache stacked per
+layer rides a ``lax.scan`` over blocks, prefill and per-token decode are
+two jitted programs with static shapes, and the decode loop is a
+``lax.scan`` over time steps — the whole generation is compiled, no
+per-token Python.
+
+Prompts are assumed unpadded (equal lengths per batch row) in v1; the
+alibi/causal bias uses plain global positions accordingly.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from pipegoose_tpu.models.bloom import (
+    BloomConfig,
+    NEG_INF,
+    alibi_slopes,
+    bloom_gelu,
+    layer_norm,
+    logits_fn,
+)
+from pipegoose_tpu.nn.tensor_parallel.layers import (
+    column_parallel_linear,
+    row_parallel_linear,
+    vocab_parallel_embedding,
+)
+
+
+def init_cache(config: BloomConfig, batch: int, max_len: int) -> dict:
+    L, nh, hd = config.n_layer, config.n_head, config.head_dim
+    shape = (L, batch, max_len, nh, hd)
+    return {
+        "k": jnp.zeros(shape, config.dtype),
+        "v": jnp.zeros(shape, config.dtype),
+    }
+
+
+def _attn_cached(blk, x, k_cache, v_cache, start, config):
+    """Attend S new tokens against cache[:start] + themselves; returns
+    (out, new_k_cache, new_v_cache). ``start`` is the number of tokens
+    already cached (traced scalar)."""
+    b, s, _ = x.shape
+    nh, hd = config.n_head, config.head_dim
+    max_len = k_cache.shape[1]
+
+    fused = column_parallel_linear(blk["qkv"], x, None)
+    fused = fused.reshape(b, s, nh, 3, hd)
+    q, k, v = fused[..., 0, :], fused[..., 1, :], fused[..., 2, :]
+
+    k_cache = lax.dynamic_update_slice(k_cache, k.astype(k_cache.dtype), (0, start, 0, 0))
+    v_cache = lax.dynamic_update_slice(v_cache, v.astype(v_cache.dtype), (0, start, 0, 0))
+
+    key_pos = jnp.arange(max_len)
+    q_pos = start + jnp.arange(s)
+    slopes = jnp.asarray(alibi_slopes(nh))
+    bias = slopes[None, :, None, None] * key_pos[None, None, None, :].astype(jnp.float32)
+    keep = key_pos[None, :] <= q_pos[:, None]  # (S, max_len): causal + not-yet-written
+    bias = bias + jnp.where(keep[None, None], 0.0, NEG_INF)
+
+    scores = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k_cache, preferred_element_type=jnp.float32
+    ) * (hd**-0.5)
+    probs = jax.nn.softmax(scores + bias, axis=-1).astype(x.dtype)
+    ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, v_cache, preferred_element_type=jnp.float32)
+    ctx = ctx.astype(x.dtype).reshape(b, s, nh * hd)
+    return row_parallel_linear(blk["out"], ctx, None), k_cache, v_cache
+
+
+def forward_cached(params, ids, cache, start, config):
+    """Forward S tokens with cache read/write. Returns (logits last
+    position, new cache)."""
+    x = vocab_parallel_embedding(params["embed"], ids, None).astype(config.dtype)
+    x = layer_norm(params["embed_ln"], x, config.layer_norm_epsilon)
+
+    def scan_fn(carry, blk_and_cache):
+        h = carry
+        blk, kc, vc = blk_and_cache
+        ln1 = layer_norm(blk["ln_1"], h, config.layer_norm_epsilon)
+        attn, kc, vc = _attn_cached(
+            {"qkv": blk["attn"]["qkv"], "out": blk["attn"]["out"]},
+            ln1, kc, vc, start, config,
+        )
+        h = h + attn
+        ln2 = layer_norm(blk["ln_2"], h, config.layer_norm_epsilon)
+        up = column_parallel_linear(blk["mlp"]["up"], ln2, None)
+        h = h + row_parallel_linear(blk["mlp"]["down"], bloom_gelu(up), None)
+        return h, (kc, vc)
+
+    x, (k_new, v_new) = lax.scan(scan_fn, x, (params["blocks"], cache["k"], cache["v"]))
+    x = layer_norm(params["ln_f"], x, config.layer_norm_epsilon)
+    logits = logits_fn(params, x[:, -1:], None)[:, 0]  # (B, V)
+    return logits, {"k": k_new, "v": v_new}
+
+
+def generate(
+    params: dict,
+    input_ids: jax.Array,  # (B, S) unpadded prompt
+    config: BloomConfig,
+    max_new_tokens: int,
+    temperature: float = 0.0,
+    rng: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Greedy (temperature=0) or sampled decoding. Returns (B, S+new)."""
+    b, s = input_ids.shape
+    max_len = s + max_new_tokens
+    cache = init_cache(config, b, max_len)
+
+    prefill = jax.jit(partial(forward_cached, config=config))
+    logits, cache = prefill(params, input_ids, cache, 0)
+
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+
+    def pick(logits, key):
+        if temperature <= 0.0:
+            return jnp.argmax(logits, axis=-1)
+        return jax.random.categorical(key, logits / temperature, axis=-1)
+
+    first = pick(logits, rng)
+
+    def decode_step(carry, key):
+        tok, cache, pos = carry
+        logits, cache = forward_cached(params, tok[:, None], cache, pos, config)
+        nxt = pick(logits, key)
+        return (nxt, cache, pos + 1), nxt
+
+    keys = jax.random.split(jax.random.fold_in(rng, 1), max(max_new_tokens - 1, 1))
+
+    @jax.jit
+    def decode_all(first, cache):
+        (_, _, _), toks = lax.scan(decode_step, (first, cache, jnp.asarray(s)), keys)
+        return toks
+
+    if max_new_tokens == 1:
+        return jnp.concatenate([input_ids, first[:, None]], axis=1)
+    rest = decode_all(first, cache)  # (T-1, B)
+    out = jnp.concatenate([first[:, None], rest.T], axis=1)
+    return jnp.concatenate([input_ids, out], axis=1)

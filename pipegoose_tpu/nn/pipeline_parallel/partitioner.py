@@ -1,0 +1,80 @@
+"""Pipeline stage partitioning.
+
+TPU-native analog of the reference's ``UniformPartitioner``
+(pipegoose/nn/pipeline_parallel/partitioner.py:29-219), which
+symbolically traces the HF model with torch.fx, counts params per graph
+node, and rebuilds per-shard GraphModules. With stacked-layer params
+(models/bloom.py) no graph surgery is needed: a partition is a
+contiguous LAYER RANGE, and for the common equal-layers case simply a
+PartitionSpec over the ``pipe`` axis (pipeline.py:pipe_stage_specs).
+
+This module covers the general, non-uniform case: given per-layer costs
+(param counts — the reference's metric, partitioner.py:73-99 — or FLOPs
+from the profiler), compute the contiguous assignment minimizing the
+bottleneck stage cost (exact interval-partition DP, not the reference's
+greedy running-total heuristic, partitioner.py:101-144).
+"""
+from __future__ import annotations
+
+from typing import Any, List, Sequence
+
+import jax
+import numpy as np
+
+
+def layer_param_counts(stacked_params: Any) -> np.ndarray:
+    """Per-layer parameter counts from a stacked-blocks pytree (leading
+    dim = n_layer on every leaf) — the reference's per-node param
+    counting (partitioner.py:73-99) without tracing."""
+    leaves = jax.tree_util.tree_leaves(stacked_params)
+    n_layer = leaves[0].shape[0]
+    per_layer = sum(int(np.prod(x.shape[1:])) for x in leaves)
+    return np.full(n_layer, per_layer, dtype=np.int64)
+
+
+def partition_costs(costs: Sequence[float], n_partitions: int) -> List[range]:
+    """Contiguous ranges minimizing the max per-partition cost (exact DP).
+
+    The reference assigns shards greedily when the running total passes
+    total/n (partitioner.py:101-144), which can overload the last stage;
+    the DP is optimal for the same contiguity constraint.
+    """
+    costs = list(costs)
+    L, P = len(costs), n_partitions
+    if P < 1 or P > L:
+        raise ValueError(f"need 1 <= n_partitions <= n_layers, got {P} of {L}")
+    prefix = np.concatenate([[0.0], np.cumsum(costs)])
+
+    # dp[p][i] = minimal bottleneck for first i layers in p partitions
+    dp = np.full((P + 1, L + 1), np.inf)
+    cut = np.zeros((P + 1, L + 1), dtype=int)
+    dp[0][0] = 0.0
+    for p in range(1, P + 1):
+        for i in range(p, L + 1):
+            for j in range(p - 1, i):
+                cand = max(dp[p - 1][j], prefix[i] - prefix[j])
+                if cand < dp[p][i]:
+                    dp[p][i] = cand
+                    cut[p][i] = j
+    bounds = [L]
+    for p in range(P, 0, -1):
+        bounds.append(cut[p][bounds[-1]])
+    bounds.reverse()
+    return [range(bounds[i], bounds[i + 1]) for i in range(P)]
+
+
+class UniformPartitioner:
+    """API-parity wrapper (reference partitioner.py:29-57): split a model
+    of ``n_layer`` layers into ``n_partitions`` contiguous stages by cost."""
+
+    def __init__(self, n_partitions: int):
+        self.n_partitions = n_partitions
+
+    def split(self, costs: Sequence[float]) -> List[range]:
+        return partition_costs(costs, self.n_partitions)
+
+    def split_even(self, n_layer: int) -> List[range]:
+        if n_layer % self.n_partitions != 0:
+            return self.split([1.0] * n_layer)
+        k = n_layer // self.n_partitions
+        return [range(i * k, (i + 1) * k) for i in range(self.n_partitions)]

@@ -1,0 +1,131 @@
+"""DiLoCo: distributed low-communication training (outer/inner loop).
+
+The reference only aspires to DiLoCo (README.md:9-10 cites the paper; no
+code — SURVEY.md §2.2). Implemented here because it shapes multi-slice
+TPU training: inner workers (pod slices connected over DCN) each run H
+local AdamW-style steps with NO cross-worker communication; every H
+steps an OUTER optimizer (SGD + Nesterov momentum, per the paper)
+updates the shared anchor from the averaged worker delta:
+
+    outer_grad = anchor - mean_w(worker_params)
+    anchor     = outer_opt(anchor, outer_grad)
+    workers    = anchor                      (re-broadcast)
+
+Workers map onto a mesh axis (default ``data``): worker-divergent params
+carry a leading worker dim sharded over that axis, so "no communication
+during inner steps" is literal — the compiled inner step contains zero
+cross-worker collectives; only the sync step touches the axis (one
+pmean riding DCN).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from pipegoose_tpu.distributed.parallel_context import ParallelContext
+
+try:
+    from jax import shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+
+def outer_optimizer(lr: float = 0.7, momentum: float = 0.9) -> optax.GradientTransformation:
+    """The DiLoCo paper's outer optimizer: SGD with Nesterov momentum."""
+    return optax.sgd(lr, momentum=momentum, nesterov=True)
+
+
+class DiLoCo:
+    def __init__(
+        self,
+        loss_fn: Callable[[Any, Any], jax.Array],
+        inner_opt: optax.GradientTransformation,
+        outer_opt: Optional[optax.GradientTransformation] = None,
+        sync_every: int = 8,
+        worker_axis: str = "data",
+        parallel_context: Optional[ParallelContext] = None,
+    ):
+        self.loss_fn = loss_fn
+        self.inner_opt = inner_opt
+        self.outer_opt = outer_opt or outer_optimizer()
+        self.sync_every = sync_every
+        self.axis = worker_axis
+        self.ctx = parallel_context or ParallelContext.get_context()
+        self.W = self.ctx.mesh.shape[worker_axis]
+
+    # -- state layout -------------------------------------------------------
+
+    def _wspec(self, base: P = P()) -> P:
+        return P(self.axis, *base)
+
+    def init(self, params: Any):
+        """(worker_params, inner_states, outer_state): workers start as W
+        copies of the anchor (leading worker dim); divergence happens in
+        the inner steps."""
+        W = self.W
+        worker_params = jax.tree_util.tree_map(
+            lambda x: jnp.broadcast_to(x[None], (W,) + x.shape), params
+        )
+        inner = jax.vmap(self.inner_opt.init)(worker_params)
+        outer = self.outer_opt.init(params)
+        return worker_params, inner, outer
+
+    # -- compiled steps -----------------------------------------------------
+
+    def make_inner_step(self, worker_params: Any):
+        """jit(step)(worker_params, inner_state, batch) — per-worker local
+        update, zero cross-worker collectives."""
+        mesh = self.ctx.mesh
+        wspecs = jax.tree_util.tree_map(lambda _: self._wspec(), worker_params)
+        inner_state_shape = jax.eval_shape(
+            lambda wp: jax.vmap(self.inner_opt.init)(wp), worker_params
+        )
+        sspecs = jax.tree_util.tree_map(lambda _: self._wspec(), inner_state_shape)
+
+        def local(wp, state, batch):
+            p = jax.tree_util.tree_map(lambda x: x[0], wp)
+            s = jax.tree_util.tree_map(lambda x: x[0], state)
+            loss, grads = jax.value_and_grad(self.loss_fn)(p, batch)
+            updates, s2 = self.inner_opt.update(grads, s, p)
+            p2 = optax.apply_updates(p, updates)
+            expand = lambda t: jax.tree_util.tree_map(lambda x: x[None], t)
+            return expand(p2), expand(s2), loss
+
+        f = shard_map(
+            local,
+            mesh=mesh,
+            in_specs=(wspecs, sspecs, P(self.axis)),
+            out_specs=(wspecs, sspecs, P()),
+            check_vma=False,
+        )
+        return jax.jit(f, donate_argnums=(0, 1))
+
+    def make_sync_step(self, params_template: Any):
+        """jit(sync)(anchor, worker_params, outer_state) -> new anchor,
+        reset worker params, new outer state. One pmean over the worker
+        axis — the only DCN traffic DiLoCo pays."""
+        mesh = self.ctx.mesh
+        wspecs = jax.tree_util.tree_map(lambda _: self._wspec(), params_template)
+
+        def local(anchor, wp, outer_state):
+            p = jax.tree_util.tree_map(lambda x: x[0], wp)
+            avg = jax.tree_util.tree_map(lambda x: lax.pmean(x, self.axis), p)
+            outer_grad = jax.tree_util.tree_map(lambda a, m: a - m, anchor, avg)
+            updates, outer2 = self.outer_opt.update(outer_grad, outer_state, anchor)
+            new_anchor = optax.apply_updates(anchor, updates)
+            new_wp = jax.tree_util.tree_map(lambda x: x[None], new_anchor)
+            return new_anchor, new_wp, outer2
+
+        f = shard_map(
+            local,
+            mesh=mesh,
+            in_specs=(P(), wspecs, P()),
+            out_specs=(P(), wspecs, P()),
+            check_vma=False,
+        )
+        return jax.jit(f)

@@ -56,22 +56,39 @@ class Trainer:
         )
         self.param_specs = param_specs
         self.optimizer = optimizer
-        self.params = params
-        self.opt_state = init_fn(params)
+        # place params on the mesh in FRESH buffers: the jitted step
+        # donates its params argument, and donating the caller's arrays
+        # would invalidate them (device_put can alias, a jitted identity
+        # can't)
+        from jax.sharding import NamedSharding
+
+        out_shardings = jax.tree_util.tree_map(
+            lambda s: NamedSharding(self.parallel_context.mesh, s),
+            param_specs,
+            is_leaf=lambda x: isinstance(x, P),
+        )
+        self.params = jax.jit(lambda t: t, out_shardings=out_shardings)(params)
         self._step_fn = make_step(params)
 
+        resumed = False
         if resume_dir is not None:
-            self._try_resume(resume_dir)
+            # shapes only — materializing a full ZeRO state just to
+            # overwrite it from the checkpoint would waste a compile +
+            # the whole optimizer memory
+            state_shapes = jax.eval_shape(init_fn, params)
+            resumed = self._try_resume(resume_dir, state_shapes)
+        if not resumed:
+            self.opt_state = init_fn(params)
 
-    def _try_resume(self, directory: str) -> None:
+    def _try_resume(self, directory: str, opt_state_shapes) -> bool:
         from pipegoose_tpu.parallel.hybrid import zero_state_spec
         from pipegoose_tpu.utils.checkpoint import latest_step, restore_train_state
 
         step = latest_step(directory)
         if step is None:
             self.logger.info(f"no checkpoint under {directory}; starting fresh")
-            return
-        like = {"params": self.params, "opt_state": self.opt_state}
+            return False
+        like = {"params": self.params, "opt_state": opt_state_shapes}
         # restore SHARDED onto this mesh — without specs every leaf (incl.
         # the ZeRO state, which exists precisely because it can't live
         # replicated) would materialize on all devices
@@ -89,6 +106,7 @@ class Trainer:
         self.opt_state = restored["opt_state"]
         self.state.step = step
         self.logger.info(f"resumed from {directory} at step {step}")
+        return True
 
     def fit(
         self,
@@ -105,9 +123,16 @@ class Trainer:
             cb.on_fit_start(self)
         if rng is None:
             rng = jax.random.PRNGKey(0)
+        it = iter(batches)
         try:
-            for batch in batches:
+            while True:
+                # check BEFORE pulling: a pull consumes the caller's
+                # iterator (and may tokenize a whole batch) for nothing
                 if max_steps is not None and self.state.step >= max_steps:
+                    break
+                try:
+                    batch = next(it)
+                except StopIteration:
                     break
                 step = self.state.step
                 for cb in self.callbacks:

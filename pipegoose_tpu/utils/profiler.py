@@ -1,0 +1,76 @@
+"""Profiling & cost analysis.
+
+TPU-native analog (and superset) of the reference's ``ProfileByMemory``
+(pipegoose/partitioning/profile.py:19-49), which ran layers sequentially
+on CUDA measuring ``memory_allocated`` deltas to feed non-uniform PP
+partitioning. Here:
+
+- ``estimate_block_costs``: analytic FLOPs/bytes per transformer block
+  from shapes (what actually drives partitioning on TPU — deterministic,
+  no warm-up runs);
+- ``compiled_cost``: XLA's own cost analysis of any jitted function
+  (flops, bytes accessed) — the compiler's ground truth;
+- ``device_memory_stats``: live HBM usage per device;
+- ``trace``: context manager around ``jax.profiler`` for timeline traces
+  viewable in TensorBoard/Perfetto (the reference has no timeline
+  tracing at all, SURVEY.md §5).
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Callable, Optional
+
+import jax
+import numpy as np
+
+
+def estimate_block_costs(
+    hidden: int, seq: int, batch: int, ffn_mult: int = 4, causal: bool = True
+):
+    """FLOPs and activation bytes for ONE transformer block at the given
+    shapes (per microbatch). Attention term is 2*(2*B*S^2*H) matmul FLOPs
+    (halved if causal), dense term 2*B*S*(qkv + out + mlp) MACs."""
+    dense_params = hidden * 3 * hidden + hidden * hidden + 2 * ffn_mult * hidden * hidden
+    dense_flops = 2 * batch * seq * dense_params
+    attn_flops = 2 * 2 * batch * seq * seq * hidden
+    if causal:
+        attn_flops //= 2
+    act_bytes = 2 * batch * seq * hidden * (4 + 2 * ffn_mult)  # bf16, rough
+    return {"flops": dense_flops + attn_flops, "bytes": act_bytes}
+
+
+def compiled_cost(fn: Callable, *args, **kwargs) -> dict:
+    """XLA cost analysis of ``jit(fn)`` at these arg shapes: returns at
+    least ``flops`` and ``bytes accessed`` where the backend reports them."""
+    lowered = jax.jit(fn).lower(*args, **kwargs)
+    compiled = lowered.compile()
+    ca = compiled.cost_analysis()
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    return dict(ca or {})
+
+
+def device_memory_stats(device: Optional[Any] = None) -> dict:
+    """Live HBM statistics (reference measured CUDA memory_allocated,
+    profile.py:30-42)."""
+    device = device or jax.devices()[0]
+    stats = getattr(device, "memory_stats", lambda: None)()
+    return dict(stats) if stats else {}
+
+
+@contextlib.contextmanager
+def trace(logdir: str):
+    """jax.profiler timeline trace (TensorBoard/Perfetto viewable)."""
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def tree_size_bytes(tree: Any) -> int:
+    return sum(x.size * x.dtype.itemsize for x in jax.tree_util.tree_leaves(tree))
+
+
+def count_params(tree: Any) -> int:
+    return sum(int(np.prod(x.shape)) for x in jax.tree_util.tree_leaves(tree))

@@ -1,0 +1,33 @@
+"""Gradient accumulation (the compiled replacement for the reference's
+unfinished core/bucket subsystem, SURVEY.md §2.1)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pipegoose_tpu.core.accumulation import accumulate_gradients, make_accumulating_loss
+
+
+def _loss(params, batch):
+    return ((batch @ params["w"] - batch.sum(-1, keepdims=True)) ** 2).mean()
+
+
+def test_accumulated_grads_match_full_batch():
+    params = {"w": jax.random.normal(jax.random.PRNGKey(0), (8, 1))}
+    big = jax.random.normal(jax.random.PRNGKey(1), (16, 8))
+
+    full_loss, full_grads = jax.value_and_grad(_loss)(params, big)
+    mbs = big.reshape(4, 4, 8)
+    acc_loss, acc_grads = accumulate_gradients(_loss, params, mbs)
+    np.testing.assert_allclose(float(acc_loss), float(full_loss), rtol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(acc_grads["w"]), np.asarray(full_grads["w"]), rtol=1e-5
+    )
+
+
+def test_accumulating_loss_wrapper():
+    params = {"w": jax.random.normal(jax.random.PRNGKey(0), (8, 1))}
+    big = jax.random.normal(jax.random.PRNGKey(1), (16, 8))
+    wrapped = make_accumulating_loss(_loss, 4)
+    g1 = jax.grad(wrapped)(params, big)
+    g2 = jax.grad(_loss)(params, big)
+    np.testing.assert_allclose(np.asarray(g1["w"]), np.asarray(g2["w"]), rtol=1e-5)
