@@ -61,6 +61,10 @@ def make_accumulating_loss(
     def wrapped(params, batch):
         mbs = split(batch, n_accum)
 
+        # remat each microbatch: without it, differentiating through the
+        # scan stores every microbatch's residuals and peak activation
+        # memory equals the full batch — no accumulation benefit
+        @jax.checkpoint
         def body(loss_sum, mb):
             return loss_sum + loss_fn(params, mb), None
 

@@ -64,6 +64,9 @@ class BloomConfig:
     dtype: Any = jnp.float32
     # rematerialize each block's activations in backward (HBM for FLOPs)
     remat: bool = False
+    # fused Pallas flash attention (ops/flash_attention.py): causal+alibi
+    # only — requires unpadded batches (attention_mask None or all ones)
+    use_flash: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -195,6 +198,19 @@ def _attention(
     fused = column_parallel_linear(blk["qkv"], x, tp_axis)  # (B,S,3H/tp)
     fused = fused.reshape(b, s, local_heads, 3, hd)
     q, k, v = fused[..., 0, :], fused[..., 1, :], fused[..., 2, :]
+
+    if config.use_flash:
+        # fused kernel path: alibi from static slopes, causal mask inside
+        # the kernel; padding masks are NOT applied (unpadded batches)
+        from pipegoose_tpu.ops.flash_attention import flash_attention
+
+        slopes = jnp.asarray(alibi_slopes(config.n_head))
+        if tp_axis:
+            h0 = jax.lax.axis_index(tp_axis) * local_heads
+            slopes = jax.lax.dynamic_slice_in_dim(slopes, h0, local_heads, 0)
+        ctx = flash_attention(q, k, v, slopes, causal=True)
+        ctx = ctx.astype(x.dtype).reshape(b, s, local_heads * hd)
+        return row_parallel_linear(blk["out"], ctx, tp_axis)
 
     # local head slice of the alibi bias
     if tp_axis:

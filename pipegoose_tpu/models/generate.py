@@ -101,6 +101,43 @@ def forward_cached(params, ids, cache, start, config):
     return logits, {"k": k_new, "v": v_new}
 
 
+# compiled prefill/decode programs, keyed by (config, shapes, sampling) —
+# a fresh jit per generate() call would recompile everything and bake the
+# weight pytree into the program as constants
+_JIT_CACHE: dict = {}
+
+
+def _compiled_fns(config: BloomConfig, prompt_len: int, temperature: float):
+    key = (config, prompt_len, temperature > 0.0)
+    if key in _JIT_CACHE:
+        return _JIT_CACHE[key]
+
+    def pick(logits, k):
+        if temperature <= 0.0:
+            return jnp.argmax(logits, axis=-1)
+        return jax.random.categorical(k, logits / temperature, axis=-1)
+
+    @jax.jit
+    def prefill(params, ids, cache, rng):
+        logits, cache = forward_cached(params, ids, cache, 0, config)
+        return pick(logits, rng), cache
+
+    @jax.jit
+    def decode_all(params, first, cache, keys):
+        def decode_step(carry, k):
+            tok, cache, pos = carry
+            logits, cache = forward_cached(params, tok[:, None], cache, pos, config)
+            nxt = pick(logits, k)
+            return (nxt, cache, pos + 1), nxt
+
+        init = (first, cache, jnp.asarray(prompt_len))
+        _, toks = lax.scan(decode_step, init, keys)
+        return toks
+
+    _JIT_CACHE[key] = (prefill, decode_all)
+    return _JIT_CACHE[key]
+
+
 def generate(
     params: dict,
     input_ids: jax.Array,  # (B, S) unpadded prompt
@@ -110,38 +147,20 @@ def generate(
     rng: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Greedy (temperature=0) or sampled decoding. Returns (B, S+new)."""
+    if max_new_tokens <= 0:
+        return input_ids
     b, s = input_ids.shape
     max_len = s + max_new_tokens
     cache = init_cache(config, b, max_len)
-
-    prefill = jax.jit(partial(forward_cached, config=config))
-    logits, cache = prefill(params, input_ids, cache, 0)
-
     if rng is None:
         rng = jax.random.PRNGKey(0)
 
-    def pick(logits, key):
-        if temperature <= 0.0:
-            return jnp.argmax(logits, axis=-1)
-        return jax.random.categorical(key, logits / temperature, axis=-1)
-
-    first = pick(logits, rng)
-
-    def decode_step(carry, key):
-        tok, cache, pos = carry
-        logits, cache = forward_cached(params, tok[:, None], cache, pos, config)
-        nxt = pick(logits, key)
-        return (nxt, cache, pos + 1), nxt
-
-    keys = jax.random.split(jax.random.fold_in(rng, 1), max(max_new_tokens - 1, 1))
-
-    @jax.jit
-    def decode_all(first, cache):
-        (_, _, _), toks = lax.scan(decode_step, (first, cache, jnp.asarray(s)), keys)
-        return toks
+    prefill, decode_all = _compiled_fns(config, s, temperature)
+    first, cache = prefill(params, input_ids, cache, rng)
 
     if max_new_tokens == 1:
         return jnp.concatenate([input_ids, first[:, None]], axis=1)
-    rest = decode_all(first, cache)  # (T-1, B)
+    keys = jax.random.split(jax.random.fold_in(rng, 1), max_new_tokens - 1)
+    rest = decode_all(params, first, cache, keys)  # (T-1, B)
     out = jnp.concatenate([first[:, None], rest.T], axis=1)
     return jnp.concatenate([input_ids, out], axis=1)

@@ -1,0 +1,188 @@
+"""Fused flash attention (Pallas, TPU).
+
+The hot op of every model here is causal self-attention with an additive
+ALiBi bias. XLA's default lowering materializes the (S, S) score matrix
+in HBM; this kernel computes softmax(QK^T * scale + alibi + causal) V
+blockwise in VMEM with the online-softmax recurrence — O(S) memory, MXU
+matmuls, one pass over K/V per Q block.
+
+Kernel structure (canonical TPU flash attention):
+- grid = (batch*heads, n_q_blocks, n_kv_blocks); the kv dimension is
+  sequential ("arbitrary") so the (m, l, acc) scratch carries across kv
+  steps for a fixed (bh, q) program;
+- per-head ALiBi slope arrives via scalar prefetch (SMEM);
+- fully-masked kv blocks (entirely above the causal diagonal) are
+  skipped with pl.when — ~2x fewer FLOPs for causal attention;
+- backward: custom_vjp falls back to the XLA attention expression with
+  rematerialization (correct gradients; a fused backward kernel is a
+  planned optimization).
+
+Reference framework has no kernels at all (its README advertises "fused
+kernels"; grep finds none — SURVEY.md, "Scale/completeness caveat").
+"""
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+NEG_INF = -1e9
+
+
+def _pick_block(n: int, target: int = 128) -> int:
+    for b in (target, 64, 32, 16, 8):
+        if n % b == 0:
+            return b
+    return n
+
+
+def _flash_fwd_pallas(q, k, v, slopes, scale, causal, block_q, block_k, interpret):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    bh, s, hd = q.shape  # (batch*heads, seq, head_dim)
+    nq, nk = s // block_q, s // block_k
+
+    def kernel(slope_ref, q_ref, k_ref, v_ref, o_ref, m_sc, l_sc, acc_sc):
+        qi = pl.program_id(1)
+        ki = pl.program_id(2)
+
+        @pl.when(ki == 0)
+        def _init():
+            m_sc[:] = jnp.full_like(m_sc, NEG_INF)
+            l_sc[:] = jnp.zeros_like(l_sc)
+            acc_sc[:] = jnp.zeros_like(acc_sc)
+
+        q_start = qi * block_q
+        k_start = ki * block_k
+
+        # skip blocks fully above the causal diagonal
+        @pl.when(k_start <= q_start + block_q - 1 if causal else True)
+        def _compute():
+            qb = q_ref[0].astype(jnp.float32)  # (BQ, hd)
+            kb = k_ref[0].astype(jnp.float32)  # (BK, hd)
+            vb = v_ref[0].astype(jnp.float32)
+            s_blk = jax.lax.dot_general(
+                qb, kb, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * scale  # (BQ, BK)
+
+            k_pos = k_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            slope = slope_ref[0]
+            s_blk = s_blk + slope * k_pos.astype(jnp.float32)
+            if causal:
+                q_pos = q_start + jax.lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 0
+                )
+                s_blk = jnp.where(k_pos <= q_pos, s_blk, NEG_INF)
+
+            m_prev = m_sc[:, 0]
+            m_new = jnp.maximum(m_prev, s_blk.max(axis=1))
+            p = jnp.exp(s_blk - m_new[:, None])
+            alpha = jnp.exp(m_prev - m_new)
+            l_sc[:, 0] = l_sc[:, 0] * alpha + p.sum(axis=1)
+            acc_sc[:] = acc_sc[:] * alpha[:, None] + jax.lax.dot_general(
+                p, vb, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            m_sc[:, 0] = m_new
+
+        @pl.when(ki == nk - 1)
+        def _finish():
+            denom = jnp.maximum(l_sc[:, 0], 1e-30)
+            o_ref[0] = (acc_sc[:] / denom[:, None]).astype(o_ref.dtype)
+
+    grid = (bh, nq, nk)
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=0,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1,), lambda b, i, j: (b,), memory_space=pltpu.SMEM),
+                pl.BlockSpec((1, block_q, hd), lambda b, i, j: (b, i, 0)),
+                pl.BlockSpec((1, block_k, hd), lambda b, i, j: (b, j, 0)),
+                pl.BlockSpec((1, block_k, hd), lambda b, i, j: (b, j, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, block_q, hd), lambda b, i, j: (b, i, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((block_q, 1), jnp.float32),
+                pltpu.VMEM((block_q, 1), jnp.float32),
+                pltpu.VMEM((block_q, hd), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(slopes, q, k, v)
+    return out
+
+
+def _xla_reference(q, k, v, slopes, scale, causal):
+    """Plain XLA attention with the same semantics (used for backward and
+    as the non-TPU fallback)."""
+    bh, s, hd = q.shape
+    scores = jnp.einsum(
+        "bqd,bkd->bqk", q.astype(jnp.float32), k.astype(jnp.float32)
+    ) * scale
+    k_pos = jnp.arange(s)
+    scores = scores + slopes[:, None, None] * k_pos[None, None, :].astype(jnp.float32)
+    if causal:
+        keep = k_pos[None, :] <= jnp.arange(s)[:, None]
+        scores = jnp.where(keep[None], scores, NEG_INF)
+    p = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bqk,bkd->bqd", p, v.astype(jnp.float32)).astype(q.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _flash(q, k, v, slopes, scale, causal, interpret):
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    s = q.shape[1]
+    bq, bk = _pick_block(s), _pick_block(s)
+    return _flash_fwd_pallas(q, k, v, slopes, scale, causal, bq, bk, interpret)
+
+
+def _flash_fwd(q, k, v, slopes, scale, causal, interpret):
+    return _flash(q, k, v, slopes, scale, causal, interpret), (q, k, v, slopes)
+
+
+def _flash_bwd(scale, causal, interpret, res, g):
+    q, k, v, slopes = res
+    _, vjp = jax.vjp(lambda q, k, v: _xla_reference(q, k, v, slopes, scale, causal), q, k, v)
+    dq, dk, dv = vjp(g)
+    return dq, dk, dv, jnp.zeros_like(slopes)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(
+    q: jax.Array,  # (B, S, nh, hd)
+    k: jax.Array,
+    v: jax.Array,
+    alibi_slopes: Optional[jax.Array] = None,  # (nh,)
+    causal: bool = True,
+    scale: Optional[float] = None,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """BLOOM-shaped fused attention. Returns (B, S, nh, hd)."""
+    b, s, nh, hd = q.shape
+    if scale is None:
+        scale = hd**-0.5
+    if alibi_slopes is None:
+        alibi_slopes = jnp.zeros((nh,), jnp.float32)
+    slopes = jnp.broadcast_to(alibi_slopes[None], (b, nh)).reshape(b * nh)
+
+    def flat(x):
+        return x.transpose(0, 2, 1, 3).reshape(b * nh, s, hd)
+
+    out = _flash(flat(q), flat(k), flat(v), slopes.astype(jnp.float32),
+                 float(scale), causal, interpret)
+    return out.reshape(b, nh, s, hd).transpose(0, 2, 1, 3)

@@ -94,7 +94,9 @@ class DiLoCo:
             updates, s2 = self.inner_opt.update(grads, s, p)
             p2 = optax.apply_updates(p, updates)
             expand = lambda t: jax.tree_util.tree_map(lambda x: x[None], t)
-            return expand(p2), expand(s2), loss
+            # global metric: without the pmean the P() out-spec would
+            # surface one arbitrary worker's loss
+            return expand(p2), expand(s2), lax.pmean(loss, self.axis)
 
         f = shard_map(
             local,

@@ -17,7 +17,6 @@ partitioning. Here:
 """
 from __future__ import annotations
 
-import contextlib
 from typing import Any, Callable, Optional
 
 import jax
@@ -58,14 +57,10 @@ def device_memory_stats(device: Optional[Any] = None) -> dict:
     return dict(stats) if stats else {}
 
 
-@contextlib.contextmanager
-def trace(logdir: str):
-    """jax.profiler timeline trace (TensorBoard/Perfetto viewable)."""
-    jax.profiler.start_trace(logdir)
-    try:
-        yield
-    finally:
-        jax.profiler.stop_trace()
+def trace(logdir: str, **kwargs):
+    """jax.profiler timeline trace (TensorBoard/Perfetto viewable) —
+    thin re-export of jax.profiler.trace for API discoverability."""
+    return jax.profiler.trace(logdir, **kwargs)
 
 
 def tree_size_bytes(tree: Any) -> int:

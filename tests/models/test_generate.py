@@ -63,3 +63,12 @@ def test_sampled_generation_shape(hf_model):
                    rng=jax.random.PRNGKey(5))
     assert out.shape == (1, 7)
     assert int(out.max()) < cfg.vocab_size
+
+
+def test_zero_new_tokens(hf_model):
+    from pipegoose_tpu.models.hf import bloom_params_from_hf
+
+    cfg, params = bloom_params_from_hf(hf_model)
+    ids = jnp.asarray(np.random.RandomState(4).randint(0, 96, (2, 5)))
+    out = generate(params, ids, cfg, max_new_tokens=0)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ids))
