@@ -1,4 +1,6 @@
-from pipegoose_tpu.models import bloom
+from pipegoose_tpu.models import bloom, bloom_moe, mixtral
 from pipegoose_tpu.models.bloom import BloomConfig
+from pipegoose_tpu.models.bloom_moe import BloomMoEConfig
+from pipegoose_tpu.models.mixtral import MixtralConfig
 
-__all__ = ["bloom", "BloomConfig"]
+__all__ = ["bloom", "bloom_moe", "mixtral", "BloomConfig", "BloomMoEConfig", "MixtralConfig"]

@@ -147,3 +147,81 @@ def bloom_params_to_hf_state_dict(params: dict) -> dict:
     out["transformer.ln_f.bias"] = np.asarray(params["ln_f"]["bias"])
     out["lm_head.weight"] = out["transformer.word_embeddings.weight"]
     return out
+
+
+# -- Mixtral ----------------------------------------------------------------
+
+def mixtral_config_from_hf(hf_config, **overrides):
+    from pipegoose_tpu.models.mixtral import MixtralConfig
+
+    if getattr(hf_config, "sliding_window", None):
+        raise NotImplementedError("sliding-window attention not supported yet")
+    return MixtralConfig(
+        vocab_size=hf_config.vocab_size,
+        hidden_size=hf_config.hidden_size,
+        intermediate_size=hf_config.intermediate_size,
+        n_layer=hf_config.num_hidden_layers,
+        n_head=hf_config.num_attention_heads,
+        n_kv_head=hf_config.num_key_value_heads,
+        num_experts=hf_config.num_local_experts,
+        top_k=hf_config.num_experts_per_tok,
+        rope_theta=hf_config.rope_theta,
+        rms_eps=hf_config.rms_norm_eps,
+        router_jitter=getattr(hf_config, "router_jitter_noise", 0.0) or 0.0,
+        aux_loss_weight=getattr(hf_config, "router_aux_loss_coef", 0.02),
+        **overrides,
+    )
+
+
+def mixtral_params_from_hf(model: Any, dtype=jnp.float32) -> tuple:
+    """Convert HF ``MixtralForCausalLM`` to the stacked pytree (experts
+    gathered into (L, E, in, out) stacks)."""
+    sd = model.state_dict()
+    cfg = mixtral_config_from_hf(model.config, dtype=dtype)
+    L, E = cfg.n_layer, cfg.num_experts
+
+    def get(name):
+        return _t(sd[name])
+
+    def stack(fmt, transpose=True):
+        mats = [get(fmt.format(i)) for i in range(L)]
+        if transpose:
+            mats = [m.T for m in mats]
+        return jnp.asarray(np.stack(mats), dtype=dtype)
+
+    def stack_experts(fmt):
+        # (L, E, in, out), torch stores (out, in)
+        return jnp.asarray(
+            np.stack(
+                [np.stack([get(fmt.format(i, e)).T for e in range(E)]) for i in range(L)]
+            ),
+            dtype=dtype,
+        )
+
+    pre = "model."
+    params = {
+        "embed": {"weight": jnp.asarray(get(pre + "embed_tokens.weight"), dtype=dtype)},
+        "blocks": {
+            "ln_1": {"scale": stack(pre + "layers.{}.input_layernorm.weight", transpose=False)},
+            "attn": {
+                "q": {"kernel": stack(pre + "layers.{}.self_attn.q_proj.weight")},
+                "k": {"kernel": stack(pre + "layers.{}.self_attn.k_proj.weight")},
+                "v": {"kernel": stack(pre + "layers.{}.self_attn.v_proj.weight")},
+                "o": {"kernel": stack(pre + "layers.{}.self_attn.o_proj.weight")},
+            },
+            "ln_2": {
+                "scale": stack(pre + "layers.{}.post_attention_layernorm.weight", transpose=False)
+            },
+            "router": {
+                "gate": {"kernel": stack(pre + "layers.{}.block_sparse_moe.gate.weight")}
+            },
+            "moe": {
+                "w1": {"kernel": stack_experts(pre + "layers.{}.block_sparse_moe.experts.{}.w1.weight")},
+                "w3": {"kernel": stack_experts(pre + "layers.{}.block_sparse_moe.experts.{}.w3.weight")},
+                "w2": {"kernel": stack_experts(pre + "layers.{}.block_sparse_moe.experts.{}.w2.weight")},
+            },
+        },
+        "ln_f": {"scale": jnp.asarray(get(pre + "norm.weight"), dtype=dtype)},
+        "lm_head": {"kernel": jnp.asarray(get("lm_head.weight").T, dtype=dtype)},
+    }
+    return cfg, params

@@ -1,0 +1,307 @@
+"""Mixtral: sparse-MoE transformer (RMSNorm, RoPE, GQA, SwiGLU experts).
+
+Second model family, targeting BASELINE.json config 5 (Mixtral-8x7B 4D
+TP x PP x DP x EP + DiLoCo). The reference supports only BLOOM
+(README.md:19); this model is built on the same framework primitives —
+stacked-layer scan, TP layer functions, static-shape MoE dispatch — so
+every parallel form (TP/DP/EP/ZeRO/PP-ready stacked layout) applies.
+
+Semantics match HF ``modeling_mixtral`` for checkpoint parity:
+- RMSNorm (no bias, f32 stats), rotate-half RoPE (theta from config),
+  GQA via kv-head repetition, scaling = head_dim**-0.5;
+- SwiGLU experts: w2(silu(w1(x)) * w3(x)); router = softmax over f32
+  logits -> top-k -> renormalize (HF MixtralSparseMoeBlock:112-118) —
+  exactly our TopKRouter with normalize_gates=True and ample capacity.
+Parity is tested against HF in tests/models/test_mixtral.py.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from pipegoose_tpu.nn.expert_parallel.experts import moe_layer
+from pipegoose_tpu.nn.expert_parallel.loss import ExpertLoss
+from pipegoose_tpu.nn.expert_parallel.routers import SwitchNoisePolicy, TopKRouter
+from pipegoose_tpu.nn.tensor_parallel.layers import (
+    column_parallel_linear,
+    row_parallel_linear,
+    vocab_parallel_cross_entropy,
+    vocab_parallel_embedding,
+)
+
+NEG_INF = -1e9
+
+
+@dataclasses.dataclass(frozen=True)
+class MixtralConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 14336
+    n_layer: int = 32
+    n_head: int = 32
+    n_kv_head: int = 8
+    num_experts: int = 8
+    top_k: int = 2
+    rope_theta: float = 1e6
+    rms_eps: float = 1e-5
+    initializer_range: float = 0.02
+    router_jitter: float = 0.0
+    aux_loss_weight: float = 0.001  # HF MixtralConfig router_aux_loss_coef default
+    z_loss_weight: float = 0.0
+    # None -> no-drop capacity (= num_experts/top_k, i.e. C = n_tokens):
+    # HF's MixtralSparseMoeBlock never drops, so checkpoint parity needs
+    # this; set a real factor (e.g. 1.25-2.0) for capacity-bound training
+    capacity_factor: Optional[float] = None
+    dtype: Any = jnp.float32
+    remat: bool = False
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.n_head
+
+    @classmethod
+    def mixtral_8x7b(cls, **kw) -> "MixtralConfig":
+        return cls(vocab_size=32000, hidden_size=4096, intermediate_size=14336,
+                   n_layer=32, n_head=32, n_kv_head=8, **kw)
+
+    def router(self) -> TopKRouter:
+        noise = SwitchNoisePolicy(self.router_jitter) if self.router_jitter else None
+        cf = (
+            self.capacity_factor
+            if self.capacity_factor is not None
+            else self.num_experts / self.top_k  # C = n_tokens: no drops
+        )
+        return TopKRouter(
+            num_experts=self.num_experts,
+            top_k=self.top_k,
+            capacity_factor=cf,
+            noise=noise,
+            normalize_gates=True,
+        )
+
+
+# -- init ------------------------------------------------------------------
+
+def init_params(config: MixtralConfig, key: jax.Array) -> dict:
+    h, v, L = config.hidden_size, config.vocab_size, config.n_layer
+    hd, nh, nkv = config.head_dim, config.n_head, config.n_kv_head
+    f, E = config.intermediate_size, config.num_experts
+    std, dt = config.initializer_range, config.dtype
+    ks = jax.random.split(key, 10)
+
+    def dense(k, shape):
+        return (jax.random.normal(k, shape) * std).astype(dt)
+
+    def rms_stack():
+        return {"scale": jnp.ones((L, h), dt)}
+
+    return {
+        "embed": {"weight": dense(ks[0], (v, h))},
+        "blocks": {
+            "ln_1": rms_stack(),
+            "attn": {
+                "q": {"kernel": dense(ks[1], (L, h, nh * hd))},
+                "k": {"kernel": dense(ks[2], (L, h, nkv * hd))},
+                "v": {"kernel": dense(ks[3], (L, h, nkv * hd))},
+                "o": {"kernel": dense(ks[4], (L, nh * hd, h))},
+            },
+            "ln_2": rms_stack(),
+            "router": {"gate": {"kernel": dense(ks[5], (L, h, E))}},
+            "moe": {
+                "w1": {"kernel": dense(ks[6], (L, E, h, f))},  # gate proj
+                "w3": {"kernel": dense(ks[7], (L, E, h, f))},  # up proj
+                "w2": {"kernel": dense(ks[8], (L, E, f, h))},  # down proj
+            },
+        },
+        "ln_f": {"scale": jnp.ones(h, dt)},
+        "lm_head": {"kernel": dense(ks[9], (h, v))},
+    }
+
+
+# -- ops -------------------------------------------------------------------
+
+def rms_norm(params: dict, x: jax.Array, eps: float) -> jax.Array:
+    dt = x.dtype
+    xf = x.astype(jnp.float32)
+    y = xf * jax.lax.rsqrt((xf * xf).mean(-1, keepdims=True) + eps)
+    return (y * params["scale"]).astype(dt)
+
+
+def rope_cos_sin(seq: int, head_dim: int, theta: float):
+    inv = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    t = jnp.arange(seq, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv)  # (S, hd/2)
+    emb = jnp.concatenate([freqs, freqs], axis=-1)  # (S, hd)
+    return jnp.cos(emb), jnp.sin(emb)
+
+
+def _rotate_half(x):
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    return jnp.concatenate([-x2, x1], axis=-1)
+
+
+def apply_rope(q, k, cos, sin):
+    """q,k: (B, S, h, hd); cos/sin: (S, hd)."""
+    cos = cos[None, :, None, :]
+    sin = sin[None, :, None, :]
+    return q * cos + _rotate_half(q) * sin, k * cos + _rotate_half(k) * sin
+
+
+def _swiglu_experts(moe_params: dict, x: jax.Array, tp_axis: Optional[str]) -> jax.Array:
+    """(E_local, C, H) -> (E_local, C, H): w2(silu(w1 x) * w3 x), with the
+    FFN dim Megatron-sharded over tensor (w1/w3 column, w2 row+reduce)."""
+    from pipegoose_tpu.distributed.functional import (
+        copy_to_tensor_group,
+        reduce_from_tensor_group,
+    )
+
+    if tp_axis is not None:
+        # f-operator (see expert_mlp): completes the input cotangent's
+        # psum across tensor ranks in backward
+        x = copy_to_tensor_group(x, tp_axis)
+    g = jnp.einsum("ech,ehf->ecf", x, moe_params["w1"]["kernel"],
+                   preferred_element_type=jnp.float32).astype(x.dtype)
+    u = jnp.einsum("ech,ehf->ecf", x, moe_params["w3"]["kernel"],
+                   preferred_element_type=jnp.float32).astype(x.dtype)
+    h = jax.nn.silu(g) * u
+    out = jnp.einsum("ecf,efh->ech", h, moe_params["w2"]["kernel"],
+                     preferred_element_type=jnp.float32).astype(x.dtype)
+    if tp_axis is not None:
+        out = reduce_from_tensor_group(out, tp_axis)
+    return out
+
+
+def _attention(blk, x, cos, sin, mask_bias, config, tp_axis):
+    b, s, _ = x.shape
+    hd = config.head_dim
+    tp = jax.lax.axis_size(tp_axis) if tp_axis else 1
+    if config.n_head % tp or config.n_kv_head % tp:
+        raise ValueError(
+            f"n_head={config.n_head}/n_kv_head={config.n_kv_head} must divide "
+            f"tensor axis size {tp}"
+        )
+    nh_l, nkv_l = config.n_head // tp, config.n_kv_head // tp
+    groups = nh_l // nkv_l
+
+    q = column_parallel_linear(blk["q"], x, tp_axis).reshape(b, s, nh_l, hd)
+    k = column_parallel_linear(blk["k"], x, tp_axis).reshape(b, s, nkv_l, hd)
+    v = column_parallel_linear(blk["v"], x, tp_axis).reshape(b, s, nkv_l, hd)
+    q, k = apply_rope(q, k, cos, sin)
+    # GQA: repeat kv heads
+    k = jnp.repeat(k, groups, axis=2)
+    v = jnp.repeat(v, groups, axis=2)
+
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
+    scores = scores * (hd**-0.5) + mask_bias
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(x.dtype)
+    ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, v, preferred_element_type=jnp.float32)
+    ctx = ctx.astype(x.dtype).reshape(b, s, nh_l * hd)
+    return row_parallel_linear(blk["o"], ctx, tp_axis)
+
+
+def _block(blk, x, cos, sin, mask_bias, key, config, tp_axis, ep_axis, train):
+    h = rms_norm(blk["ln_1"], x, config.rms_eps)
+    x = x + _attention(blk["attn"], h, cos, sin, mask_bias, config, tp_axis)
+    h = rms_norm(blk["ln_2"], x, config.rms_eps)
+
+    router = config.router()
+    flat = h.reshape(-1, h.shape[-1])
+    routing = router(blk["router"], flat, key=key, train=train)
+    y = moe_layer(
+        blk["moe"], h, routing, axis_name=ep_axis,
+        tp_axis=tp_axis, act=None, mlp_fn=_swiglu_experts,
+    )
+    return x + y, routing.aux_loss, routing.z_loss
+
+
+def forward_hidden(
+    params, input_ids, attention_mask, config,
+    tp_axis=None, ep_axis=None, rng=None, train=False,
+):
+    b, s = input_ids.shape
+    if attention_mask is None:
+        attention_mask = jnp.ones((b, s), jnp.int32)
+    x = vocab_parallel_embedding(params["embed"], input_ids, tp_axis).astype(config.dtype)
+
+    cos, sin = rope_cos_sin(s, config.head_dim, config.rope_theta)
+    causal = jnp.tril(jnp.ones((s, s), bool))
+    keep = causal[None, None] & (attention_mask[:, None, None, :] > 0)
+    mask_bias = jnp.where(keep, 0.0, NEG_INF).astype(jnp.float32)
+
+    if rng is None:
+        if train and config.router_jitter:
+            raise ValueError("train=True with router jitter needs an explicit rng")
+        rng = jax.random.PRNGKey(0)
+    layer_keys = jax.random.split(rng, config.n_layer)
+
+    def scan_fn(carry, blk_and_key):
+        blk, key = blk_and_key
+        out, aux, z = _block(
+            blk, carry, cos, sin, mask_bias, key, config, tp_axis, ep_axis, train
+        )
+        return out, (aux, z)
+
+    step = jax.checkpoint(scan_fn) if config.remat else scan_fn
+    x, (aux, z) = jax.lax.scan(step, x, (params["blocks"], layer_keys))
+    return rms_norm(params["ln_f"], x, config.rms_eps), aux, z
+
+
+def forward(params, input_ids, attention_mask, config,
+            tp_axis=None, ep_axis=None, rng=None, train=False):
+    """Logits (B, S, V/tp) — lm_head is column-parallel over tensor."""
+    hidden, aux, z = forward_hidden(
+        params, input_ids, attention_mask, config, tp_axis, ep_axis, rng, train
+    )
+    return column_parallel_linear(params["lm_head"], hidden, tp_axis), aux, z
+
+
+def loss_fn(params, input_ids, attention_mask, labels, config,
+            tp_axis=None, ep_axis=None, rng=None, train=True):
+    logits, aux, z = forward(
+        params, input_ids, attention_mask, config, tp_axis, ep_axis, rng, train
+    )
+    per_tok = vocab_parallel_cross_entropy(logits[:, :-1], labels[:, 1:], tp_axis)
+    if attention_mask is not None:
+        w = attention_mask[:, 1:].astype(per_tok.dtype)
+        task = (per_tok * w).sum() / jnp.maximum(w.sum(), 1)
+    else:
+        task = per_tok.mean()
+    # HF computes ONE load-balancing loss over all layers' gates jointly
+    # (~O(1) when balanced); our scan yields per-layer losses, so take the
+    # layer MEAN to keep router_aux_loss_coef on HF's scale
+    return ExpertLoss(config.aux_loss_weight, config.z_loss_weight)(
+        task, aux.mean(), z.mean()
+    )
+
+
+def specs(params: dict, tp_axis: str = "tensor", ep_axis: str = "expert") -> dict:
+    """4D PartitionSpecs: attention q/k/v column + o row over tensor,
+    experts over expert with FFN over tensor, lm_head column, embedding
+    vocab-sharded; stacked n_layer dim free for the pipe axis."""
+    from jax.sharding import PartitionSpec as P
+
+    from pipegoose_tpu.nn.parallel import spec_tree
+
+    t, e = tp_axis, ep_axis
+
+    def spec_fn(path, x):
+        if "attn/q" in path or "attn/k" in path or "attn/v" in path:
+            return P(None, None, t)
+        if "attn/o" in path:
+            return P(None, t, None)
+        if "moe/w1" in path or "moe/w3" in path:
+            return P(None, e, None, t)
+        if "moe/w2" in path:
+            return P(None, e, t, None)
+        if "router" in path:
+            return P()
+        if "embed/weight" in path:
+            return P(t, None)
+        if "lm_head" in path:
+            return P(None, t)
+        return P()
+
+    return spec_tree(params, spec_fn)

@@ -77,8 +77,16 @@ def expert_mlp(
     sharded over the tensor axis (up column / down row + reduce) — the
     4D interaction the reference only gestures at via its
     num_experts % tp == 0 assert (expert_parallel.py:34)."""
-    from pipegoose_tpu.distributed.functional import reduce_from_tensor_group
+    from pipegoose_tpu.distributed.functional import (
+        copy_to_tensor_group,
+        reduce_from_tensor_group,
+    )
 
+    if tp_axis is not None:
+        # f-operator: identity fwd, psum bwd — without it each tensor
+        # rank's input cotangent is only its local FFN-shard partial and
+        # every grad upstream of the MoE layer de-syncs across ranks
+        x = copy_to_tensor_group(x, tp_axis)
     h = jnp.einsum("esh,ehf->esf", x, params["up"]["kernel"],
                    preferred_element_type=jnp.float32).astype(x.dtype)
     h = act(h + params["up"]["bias"][:, None, :])
@@ -94,8 +102,9 @@ def moe_layer(
     x: jax.Array,  # (..., H) local tokens
     routing: RouterOutput,
     axis_name: Optional[str],
-    act: Callable = jax.nn.gelu,
+    act: Optional[Callable] = jax.nn.gelu,
     tp_axis: Optional[str] = None,
+    mlp_fn: Optional[Callable] = None,
 ) -> jax.Array:
     """Dispatch -> expert MLP -> combine. ``expert_params`` hold this
     rank's E_local experts (stacked leading dim); ``routing`` covers the
@@ -116,7 +125,12 @@ def moe_layer(
     if axis_name is not None and ep > 1:
         # each rank keeps its E_local experts, gains every rank's C slots
         buckets = all_to_all(buckets, axis_name, split_dim=0, concat_dim=1)
-    out = expert_mlp(expert_params, buckets, act, tp_axis=tp_axis)
+    if mlp_fn is not None:
+        # custom per-expert computation, e.g. Mixtral's SwiGLU
+        # (models/mixtral.py:_swiglu_experts)
+        out = mlp_fn(expert_params, buckets, tp_axis)
+    else:
+        out = expert_mlp(expert_params, buckets, act, tp_axis=tp_axis)
     if axis_name is not None and ep > 1:
         out = all_to_all(out, axis_name, split_dim=1, concat_dim=0)
     # (E, C, H) -> (T, H), gate-weighted
