@@ -1,0 +1,156 @@
+// Native host-side token data loader.
+//
+// The TPU compute path is JAX/XLA; the runtime AROUND it is native where
+// it matters. Feeding a pod slice is a host-side job — tokenized corpora
+// are flat binary token files, and the loader must assemble (batch, seq)
+// windows fast enough to stay ahead of the accelerator. The reference
+// delegates this to torch's DataLoader + DistributedSampler
+// (examples/hybrid_parallelism.py); this is the standalone equivalent:
+//
+// - mmap the token file (zero-copy reads, OS page cache does the IO);
+// - a background thread assembles batches into a ring of pinned buffers
+//   (double-buffering: the next batch is ready before the host asks);
+// - deterministic sharded sampling: rank r of R takes window i where
+//   hash(seed, epoch, i) % R == r is NOT used — instead windows are
+//   strided (i*R + r), the same disjoint-coverage guarantee as
+//   torch's DistributedSampler, cheap and exactly reproducible.
+//
+// Exposed as a C ABI for ctypes (no pybind11 in this image).
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <fcntl.h>
+#include <mutex>
+#include <random>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <thread>
+#include <unistd.h>
+#include <vector>
+
+namespace {
+
+struct Loader {
+  // mmap'd token file
+  const uint32_t* tokens = nullptr;
+  size_t n_tokens = 0;
+  int fd = -1;
+  size_t map_bytes = 0;
+
+  // batch geometry + sharding
+  size_t batch = 0, seq = 0;
+  size_t rank = 0, world = 0;
+  uint64_t seed = 0;
+  std::atomic<uint64_t> epoch{0};
+
+  // ring of prefetched batches
+  static constexpr size_t RING = 4;
+  std::vector<std::vector<uint32_t>> ring;
+  std::atomic<uint64_t> produced{0}, consumed{0};
+  std::mutex mu;
+  std::condition_variable cv_prod, cv_cons;
+  std::thread worker;
+  std::atomic<bool> stop{false};
+
+  size_t windows_per_epoch() const {
+    size_t w = n_tokens / seq;            // non-overlapping seq windows
+    return (w / world) / batch * batch;   // full batches per rank
+  }
+
+  void fill(uint64_t step, uint32_t* out) {
+    // deterministic shuffle of window order per epoch
+    const size_t per_rank = windows_per_epoch();
+    const uint64_t ep = epoch.load();
+    std::mt19937_64 rng(seed ^ (ep * 0x9e3779b97f4a7c15ULL));
+    // sample `batch` window indices for this step without materializing
+    // a permutation: splitmix-style hash of (step, slot)
+    for (size_t b = 0; b < batch; ++b) {
+      uint64_t h = (step * batch + b) * 0xbf58476d1ce4e5b9ULL + rng();
+      h ^= h >> 31;
+      size_t widx = (h % per_rank);                 // window for this rank
+      size_t global_window = widx * world + rank;   // strided disjoint shard
+      const uint32_t* src = tokens + global_window * seq;
+      std::memcpy(out + b * seq, src, seq * sizeof(uint32_t));
+    }
+  }
+
+  void run() {
+    uint64_t step = 0;
+    while (!stop.load()) {
+      {
+        std::unique_lock<std::mutex> lk(mu);
+        cv_prod.wait(lk, [&] {
+          return stop.load() || produced.load() - consumed.load() < RING;
+        });
+      }
+      if (stop.load()) break;
+      fill(step, ring[produced.load() % RING].data());
+      ++step;
+      produced.fetch_add(1);
+      cv_cons.notify_one();
+    }
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* pgt_loader_open(const char* path, uint64_t batch, uint64_t seq,
+                      uint64_t rank, uint64_t world, uint64_t seed) {
+  auto* L = new Loader();
+  L->fd = ::open(path, O_RDONLY);
+  if (L->fd < 0) { delete L; return nullptr; }
+  struct stat st;
+  if (fstat(L->fd, &st) != 0) { ::close(L->fd); delete L; return nullptr; }
+  L->map_bytes = static_cast<size_t>(st.st_size);
+  void* p = mmap(nullptr, L->map_bytes, PROT_READ, MAP_PRIVATE, L->fd, 0);
+  if (p == MAP_FAILED) { ::close(L->fd); delete L; return nullptr; }
+  madvise(p, L->map_bytes, MADV_SEQUENTIAL);
+  L->tokens = static_cast<const uint32_t*>(p);
+  L->n_tokens = L->map_bytes / sizeof(uint32_t);
+  L->batch = batch; L->seq = seq; L->rank = rank; L->world = world;
+  L->seed = seed;
+  if (L->windows_per_epoch() == 0) {
+    munmap(p, L->map_bytes); ::close(L->fd); delete L; return nullptr;
+  }
+  L->ring.assign(Loader::RING, std::vector<uint32_t>(batch * seq));
+  L->worker = std::thread([L] { L->run(); });
+  return L;
+}
+
+uint64_t pgt_loader_windows(void* h) {
+  return static_cast<Loader*>(h)->windows_per_epoch();
+}
+
+// blocks until the next prefetched batch is ready, copies it to `out`
+// (batch*seq uint32)
+void pgt_loader_next(void* h, uint32_t* out) {
+  auto* L = static_cast<Loader*>(h);
+  {
+    std::unique_lock<std::mutex> lk(L->mu);
+    L->cv_cons.wait(lk, [&] { return L->produced.load() > L->consumed.load(); });
+  }
+  const auto& buf = L->ring[L->consumed.load() % Loader::RING];
+  std::memcpy(out, buf.data(), buf.size() * sizeof(uint32_t));
+  L->consumed.fetch_add(1);
+  L->cv_prod.notify_one();
+}
+
+void pgt_loader_set_epoch(void* h, uint64_t epoch) {
+  static_cast<Loader*>(h)->epoch.store(epoch);
+}
+
+void pgt_loader_close(void* h) {
+  auto* L = static_cast<Loader*>(h);
+  L->stop.store(true);
+  L->cv_prod.notify_all();
+  L->cv_cons.notify_all();
+  if (L->worker.joinable()) L->worker.join();
+  if (L->tokens) munmap(const_cast<uint32_t*>(L->tokens), L->map_bytes);
+  if (L->fd >= 0) ::close(L->fd);
+  delete L;
+}
+
+}  // extern "C"

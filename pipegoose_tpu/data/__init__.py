@@ -1,0 +1,3 @@
+from pipegoose_tpu.data.dataloader import TokenDataset, write_token_file
+
+__all__ = ["TokenDataset", "write_token_file"]

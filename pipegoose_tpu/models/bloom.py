@@ -67,6 +67,9 @@ class BloomConfig:
     # fused Pallas flash attention (ops/flash_attention.py): causal+alibi
     # only — requires unpadded batches (attention_mask None or all ones)
     use_flash: bool = False
+    # set when the embedding was padded for TP divisibility (pad_for_tp):
+    # the true vocab size; padded logit slots are masked out of the CE
+    valid_vocab_size: Optional[int] = None
 
     @property
     def head_dim(self) -> int:
@@ -338,7 +341,9 @@ def loss_fn(
     logits = forward(params, input_ids, attention_mask, config, tp_axis)
     shift_logits = logits[:, :-1]
     shift_labels = labels[:, 1:]
-    per_tok = vocab_parallel_cross_entropy(shift_logits, shift_labels, tp_axis)
+    per_tok = vocab_parallel_cross_entropy(
+        shift_logits, shift_labels, tp_axis, valid_size=config.valid_vocab_size
+    )
     if attention_mask is not None:
         w = attention_mask[:, 1:].astype(per_tok.dtype)
         return (per_tok * w).sum() / jnp.maximum(w.sum(), 1)
@@ -346,6 +351,28 @@ def loss_fn(
 
 
 # -- TP policy -------------------------------------------------------------
+
+def pad_for_tp(params: dict, config: BloomConfig, tp: int):
+    """Pad the (tied) embedding so vocab divides the tensor axis —
+    returns (params, config) with ``valid_vocab_size`` recording the true
+    vocab so the CE masks padded slots (reference
+    EmbeddingParallelizer._resize_vocab_size semantics,
+    parallelizer.py:125-141, plus the loss masking it lacked)."""
+    import dataclasses as _dc
+
+    from pipegoose_tpu.nn.tensor_parallel.tensor_parallel import pad_vocab
+
+    v = params["embed"]["weight"].shape[0]
+    padded = pad_vocab(params["embed"]["weight"], tp)
+    if padded.shape[0] == v:
+        return params, config
+    params = dict(params)
+    params["embed"] = {"weight": padded}
+    config = _dc.replace(
+        config, vocab_size=padded.shape[0], valid_vocab_size=config.valid_vocab_size or v
+    )
+    return params, config
+
 
 def tp_mapping(axis: str = "tensor") -> ParallelMapping:
     """Partition policy for the BLOOM params tree — the analog of the
@@ -444,7 +471,9 @@ def loss_fn_pp(
     def head_one(h, ids, mask, labels):
         h = layer_norm(params["ln_f"], h, config.layer_norm_epsilon)
         logits = logits_fn(params, h, tp_axis)
-        per_tok = vocab_parallel_cross_entropy(logits[:, :-1], labels[:, 1:], tp_axis)
+        per_tok = vocab_parallel_cross_entropy(
+            logits[:, :-1], labels[:, 1:], tp_axis, valid_size=config.valid_vocab_size
+        )
         w = mask[:, 1:].astype(per_tok.dtype)
         return (per_tok * w).sum(), w.sum()
 
@@ -553,7 +582,9 @@ def loss_fn_sp(
     is_last = rank == sp - 1
     shifted_w = shifted_w.at[:, -1].multiply(jnp.where(is_last, 0, 1))
 
-    per_tok = vocab_parallel_cross_entropy(logits, shifted_labels, tp_axis)
+    per_tok = vocab_parallel_cross_entropy(
+        logits, shifted_labels, tp_axis, valid_size=config.valid_vocab_size
+    )
     w = shifted_w.astype(per_tok.dtype)
     total = (per_tok * w).sum()
     count = jax.lax.psum(w.sum(), sp_axis)

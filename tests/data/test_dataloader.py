@@ -1,0 +1,73 @@
+"""Native + fallback token loader: sharding disjointness, determinism,
+prefetch liveness."""
+import numpy as np
+import pytest
+
+from pipegoose_tpu.data import TokenDataset, write_token_file
+
+
+@pytest.fixture(scope="module")
+def token_file(tmp_path_factory):
+    path = str(tmp_path_factory.mktemp("data") / "tokens.bin")
+    rng = np.random.RandomState(0)
+    # windows are identifiable: token value encodes its global position
+    write_token_file(np.arange(64 * 128, dtype=np.uint32), path)
+    return path
+
+
+def test_native_loader_builds_and_yields(token_file):
+    ds = TokenDataset(token_file, batch=4, seq=16, native=None)
+    native = ds._handle is not None
+    batches = ds.take(3)
+    ds.close()
+    assert all(b.shape == (4, 16) for b in batches)
+    # each row is a contiguous window starting at a multiple of seq
+    for b in batches:
+        starts = b[:, 0]
+        assert (starts % 16 == 0).all()
+        np.testing.assert_array_equal(b[0], np.arange(b[0, 0], b[0, 0] + 16))
+    assert native, "native loader should compile in this image"
+
+
+def test_native_deterministic(token_file):
+    a = TokenDataset(token_file, batch=2, seq=16, seed=7)
+    b = TokenDataset(token_file, batch=2, seq=16, seed=7)
+    xa, xb = a.take(5), b.take(5)
+    a.close(); b.close()
+    for x, y in zip(xa, xb):
+        np.testing.assert_array_equal(x, y)
+
+
+def test_shards_are_disjoint(token_file):
+    """Rank r of world W only ever sees windows w with w % W == r
+    (DistributedSampler-style strided coverage)."""
+    for rank in range(2):
+        ds = TokenDataset(token_file, batch=4, seq=16, rank=rank, world=2)
+        for b in ds.take(10):
+            windows = b[:, 0] // 16
+            assert (windows % 2 == rank).all(), (rank, windows)
+        ds.close()
+
+
+def test_fallback_matches_geometry(token_file):
+    ds = TokenDataset(token_file, batch=4, seq=16, native=False)
+    assert ds._handle is None
+    b = ds.take(2)
+    assert all(x.shape == (4, 16) for x in b)
+    # deterministic within the fallback
+    ds2 = TokenDataset(token_file, batch=4, seq=16, native=False)
+    for x, y in zip(ds.take(3), ds2.take(5)[2:]):
+        pass  # offsets differ by construction; just ensure iteration works
+    ds3 = TokenDataset(token_file, batch=4, seq=16, native=False)
+    np.testing.assert_array_equal(ds3.take(1)[0], TokenDataset(token_file, 4, 16, native=False).take(1)[0])
+
+
+def test_epoch_reshuffles(token_file):
+    ds = TokenDataset(token_file, batch=4, seq=16, seed=1)
+    e0 = ds.take(1)[0]
+    ds.close()
+    ds = TokenDataset(token_file, batch=4, seq=16, seed=1)
+    ds.set_epoch(1)
+    e1 = ds.take(1)[0]
+    ds.close()
+    assert not np.array_equal(e0, e1)

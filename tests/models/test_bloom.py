@@ -177,3 +177,35 @@ def test_tp_grads_match_single_device(hf_model, inputs, devices):
             )
     finally:
         ctx.destroy()
+
+
+def test_pad_for_tp_odd_vocab(devices):
+    """GPT-2-sized vocab (odd) under TP: pad_for_tp pads the embedding,
+    CE masks padded slots, loss matches the unpadded single-device run."""
+    cfg = bloom.BloomConfig(vocab_size=101, hidden_size=32, n_layer=2, n_head=4)
+    params = bloom.init_params(cfg, jax.random.PRNGKey(0))
+    ids = jnp.asarray(np.random.RandomState(0).randint(0, 101, (2, 8)))
+    ref = float(bloom.loss_fn(params, ids, None, ids, cfg))
+
+    p2, cfg2 = bloom.pad_for_tp(params, cfg, 4)
+    assert cfg2.vocab_size == 104 and cfg2.valid_vocab_size == 101
+    # single-device padded loss equals unpadded (padded slots masked)
+    same = float(bloom.loss_fn(p2, ids, None, ids, cfg2))
+    assert abs(same - ref) < 1e-5
+
+    ctx = ParallelContext(tensor_parallel_size=4, data_parallel_size=2)
+    try:
+        specs = bloom.tp_specs(p2)
+        fn = jax.jit(
+            shard_map(
+                lambda p, i: bloom.loss_fn(p, i, None, i, cfg2, tp_axis="tensor"),
+                mesh=ctx.mesh,
+                in_specs=(specs, P()),
+                out_specs=P(),
+                check_vma=False,
+            )
+        )
+        out = float(fn(p2, ids))
+        assert abs(out - ref) < 2e-4, (out, ref)
+    finally:
+        ctx.destroy()
