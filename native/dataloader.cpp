@@ -8,21 +8,21 @@
 // (examples/hybrid_parallelism.py); this is the standalone equivalent:
 //
 // - mmap the token file (zero-copy reads, OS page cache does the IO);
-// - a background thread assembles batches into a ring of pinned buffers
+// - a background thread assembles batches into a ring of buffers
 //   (double-buffering: the next batch is ready before the host asks);
-// - deterministic sharded sampling: rank r of R takes window i where
-//   hash(seed, epoch, i) % R == r is NOT used — instead windows are
-//   strided (i*R + r), the same disjoint-coverage guarantee as
-//   torch's DistributedSampler, cheap and exactly reproducible.
-//
-// Exposed as a C ABI for ctypes (no pybind11 in this image).
+// - sampling is a STATELESS PERMUTATION: window order per epoch is an
+//   affine bijection (odd multiplier mod 2^k, cycle-walked onto
+//   [0, per_rank)) keyed by splitmix64(seed, epoch) — every window
+//   visited exactly once per epoch (DistributedSampler semantics), and
+//   the arithmetic is integer-exact so the Python fallback
+//   (pipegoose_tpu/data/dataloader.py) reproduces identical batches;
+// - ranks shard windows disjointly by striding (global = local*W + r).
 #include <atomic>
 #include <condition_variable>
 #include <cstdint>
 #include <cstring>
 #include <fcntl.h>
 #include <mutex>
-#include <random>
 #include <sys/mman.h>
 #include <sys/stat.h>
 #include <thread>
@@ -31,23 +31,47 @@
 
 namespace {
 
+inline uint64_t splitmix64(uint64_t x) {
+  x += 0x9e3779b97f4a7c15ULL;
+  x = (x ^ (x >> 30)) * 0xbf58476d1ce4e5b9ULL;
+  x = (x ^ (x >> 27)) * 0x94d049bb133111ebULL;
+  return x ^ (x >> 31);
+}
+
+inline uint64_t pow2mask(uint64_t n) {
+  uint64_t m = 1;
+  while (m < n) m <<= 1;
+  return m - 1;
+}
+
+// bijection on [0, n): affine map mod 2^k (odd multiplier => bijective),
+// cycle-walked back into range. Identical in the Python fallback.
+inline uint64_t permute(uint64_t idx, uint64_t n, uint64_t key) {
+  const uint64_t mask = pow2mask(n);
+  const uint64_t a = splitmix64(key) | 1ULL;
+  const uint64_t b = splitmix64(key ^ 0xda3e39cb94b95bdbULL);
+  uint64_t x = idx;
+  do {
+    x = (a * x + b) & mask;
+  } while (x >= n);
+  return x;
+}
+
 struct Loader {
-  // mmap'd token file
   const uint32_t* tokens = nullptr;
   size_t n_tokens = 0;
   int fd = -1;
   size_t map_bytes = 0;
 
-  // batch geometry + sharding
   size_t batch = 0, seq = 0;
   size_t rank = 0, world = 0;
   uint64_t seed = 0;
-  std::atomic<uint64_t> epoch{0};
+  uint64_t epoch = 0;
 
-  // ring of prefetched batches
   static constexpr size_t RING = 4;
   std::vector<std::vector<uint32_t>> ring;
   std::atomic<uint64_t> produced{0}, consumed{0};
+  uint64_t step = 0;  // worker-local, reset by set_epoch
   std::mutex mu;
   std::condition_variable cv_prod, cv_cons;
   std::thread worker;
@@ -59,24 +83,18 @@ struct Loader {
   }
 
   void fill(uint64_t step, uint32_t* out) {
-    // deterministic shuffle of window order per epoch
-    const size_t per_rank = windows_per_epoch();
-    const uint64_t ep = epoch.load();
-    std::mt19937_64 rng(seed ^ (ep * 0x9e3779b97f4a7c15ULL));
-    // sample `batch` window indices for this step without materializing
-    // a permutation: splitmix-style hash of (step, slot)
+    const uint64_t per_rank = windows_per_epoch();
+    const uint64_t key = splitmix64(seed) ^ splitmix64(epoch + 1);
     for (size_t b = 0; b < batch; ++b) {
-      uint64_t h = (step * batch + b) * 0xbf58476d1ce4e5b9ULL + rng();
-      h ^= h >> 31;
-      size_t widx = (h % per_rank);                 // window for this rank
-      size_t global_window = widx * world + rank;   // strided disjoint shard
+      uint64_t linear = (step * batch + b) % per_rank;
+      uint64_t widx = permute(linear, per_rank, key);
+      size_t global_window = widx * world + rank;  // strided disjoint shard
       const uint32_t* src = tokens + global_window * seq;
       std::memcpy(out + b * seq, src, seq * sizeof(uint32_t));
     }
   }
 
   void run() {
-    uint64_t step = 0;
     while (!stop.load()) {
       {
         std::unique_lock<std::mutex> lk(mu);
@@ -90,6 +108,18 @@ struct Loader {
       produced.fetch_add(1);
       cv_cons.notify_one();
     }
+  }
+
+  void start_worker() {
+    stop.store(false);
+    worker = std::thread([this] { run(); });
+  }
+
+  void stop_worker() {
+    stop.store(true);
+    cv_prod.notify_all();
+    cv_cons.notify_all();
+    if (worker.joinable()) worker.join();
   }
 };
 
@@ -107,7 +137,7 @@ void* pgt_loader_open(const char* path, uint64_t batch, uint64_t seq,
   L->map_bytes = static_cast<size_t>(st.st_size);
   void* p = mmap(nullptr, L->map_bytes, PROT_READ, MAP_PRIVATE, L->fd, 0);
   if (p == MAP_FAILED) { ::close(L->fd); delete L; return nullptr; }
-  madvise(p, L->map_bytes, MADV_SEQUENTIAL);
+  madvise(p, L->map_bytes, MADV_WILLNEED);
   L->tokens = static_cast<const uint32_t*>(p);
   L->n_tokens = L->map_bytes / sizeof(uint32_t);
   L->batch = batch; L->seq = seq; L->rank = rank; L->world = world;
@@ -116,7 +146,7 @@ void* pgt_loader_open(const char* path, uint64_t batch, uint64_t seq,
     munmap(p, L->map_bytes); ::close(L->fd); delete L; return nullptr;
   }
   L->ring.assign(Loader::RING, std::vector<uint32_t>(batch * seq));
-  L->worker = std::thread([L] { L->run(); });
+  L->start_worker();
   return L;
 }
 
@@ -138,16 +168,21 @@ void pgt_loader_next(void* h, uint32_t* out) {
   L->cv_prod.notify_one();
 }
 
+// quiesces the worker and DISCARDS any prefetched old-epoch batches —
+// the next pgt_loader_next returns epoch `epoch`, step 0.
 void pgt_loader_set_epoch(void* h, uint64_t epoch) {
-  static_cast<Loader*>(h)->epoch.store(epoch);
+  auto* L = static_cast<Loader*>(h);
+  L->stop_worker();
+  L->epoch = epoch;
+  L->step = 0;
+  L->produced.store(0);
+  L->consumed.store(0);
+  L->start_worker();
 }
 
 void pgt_loader_close(void* h) {
   auto* L = static_cast<Loader*>(h);
-  L->stop.store(true);
-  L->cv_prod.notify_all();
-  L->cv_cons.notify_all();
-  if (L->worker.joinable()) L->worker.join();
+  L->stop_worker();
   if (L->tokens) munmap(const_cast<uint32_t*>(L->tokens), L->map_bytes);
   if (L->fd >= 0) ::close(L->fd);
   delete L;

@@ -41,12 +41,17 @@ def _load_native() -> Optional[ctypes.CDLL]:
         if not os.path.exists(_NATIVE_SO) or os.path.getmtime(
             _NATIVE_SO
         ) < os.path.getmtime(_NATIVE_SRC):
+            # compile to a private tmp path + atomic rename: concurrent
+            # data-parallel rank processes racing g++ on the shared path
+            # would otherwise dlopen a half-written file
+            tmp = f"{_NATIVE_SO}.{os.getpid()}.tmp"
             subprocess.run(
                 ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
-                 _NATIVE_SRC, "-o", _NATIVE_SO],
+                 _NATIVE_SRC, "-o", tmp],
                 check=True,
                 capture_output=True,
             )
+            os.replace(tmp, _NATIVE_SO)
         lib = ctypes.CDLL(_NATIVE_SO)
         lib.pgt_loader_open.restype = ctypes.c_void_p
         lib.pgt_loader_open.argtypes = [
@@ -64,6 +69,31 @@ def _load_native() -> Optional[ctypes.CDLL]:
     except Exception:
         _lib = None
     return _lib
+
+
+def _splitmix64(x: int) -> int:
+    M = (1 << 64) - 1
+    x = (x + 0x9E3779B97F4A7C15) & M
+    x = ((x ^ (x >> 30)) * 0xBF58476D1CE4E5B9) & M
+    x = ((x ^ (x >> 27)) * 0x94D049BB133111EB) & M
+    return x ^ (x >> 31)
+
+
+def _permute(idx: int, n: int, key: int) -> int:
+    """Bijection on [0, n): affine map mod 2^k cycle-walked into range —
+    bit-identical to native/dataloader.cpp:permute, so native and
+    fallback loaders yield the SAME batches."""
+    mask = 1
+    while mask < n:
+        mask <<= 1
+    mask -= 1
+    a = _splitmix64(key) | 1
+    b = _splitmix64(key ^ 0xDA3E39CB94B95BDB)
+    x = idx
+    while True:
+        x = (a * x + b) & mask
+        if x < n:
+            return x
 
 
 def write_token_file(tokens: np.ndarray, path: str) -> None:
@@ -92,6 +122,8 @@ class TokenDataset:
         self.path, self.batch, self.seq = path, batch, seq
         self.rank, self.world, self.seed = rank, world, seed
         self.epoch = 0
+        self._fallback_step = 0
+        self._closed = False
         self._handle = None
         self._lib = _load_native() if native in (None, True) else None
         if native is True and self._lib is None:
@@ -109,6 +141,8 @@ class TokenDataset:
 
     @property
     def windows_per_epoch(self) -> int:
+        if self._closed:
+            raise RuntimeError("TokenDataset is closed")
         if self._handle:
             return int(self._lib.pgt_loader_windows(self._handle))
         w = self._tokens.size // self.seq
@@ -118,45 +152,42 @@ class TokenDataset:
         return self.windows_per_epoch // self.batch
 
     def set_epoch(self, epoch: int) -> None:
+        """Reshuffle for a new epoch; the native loader discards any
+        prefetched old-epoch batches and restarts at step 0 (so does the
+        fallback via its per-iterator step counter)."""
         self.epoch = epoch
+        self._fallback_step = 0
         if self._handle:
             self._lib.pgt_loader_set_epoch(self._handle, epoch)
 
     # -- iteration ----------------------------------------------------------
 
     def _fill_numpy(self, step: int) -> np.ndarray:
-        """Pure-python mirror of the native fill() (same hash, so native
-        and fallback loaders yield identical batches)."""
+        """Bit-identical mirror of the native fill() (same permutation,
+        pinned by tests/data/test_dataloader.py::test_native_matches_fallback)."""
         per_rank = self.windows_per_epoch
-        rng = np.random.Generator(
-            np.random.SFC64(self.seed ^ (self.epoch * 0x9E3779B97F4A7C15 & (2**64 - 1)))
-        )
-        # NOTE: the native path uses mt19937_64 + splitmix hashing; exact
-        # cross-implementation equality is pinned by the native test, the
-        # fallback only guarantees determinism within itself
+        key = _splitmix64(self.seed) ^ _splitmix64(self.epoch + 1)
         out = np.empty((self.batch, self.seq), np.uint32)
         for b in range(self.batch):
-            h = ((step * self.batch + b) * 0xBF58476D1CE4E5B9 + int(rng.integers(2**63))) % (
-                2**64
-            )
-            h ^= h >> 31
-            widx = h % per_rank
+            linear = (step * self.batch + b) % per_rank
+            widx = _permute(linear, per_rank, key)
             gw = widx * self.world + self.rank
             out[b] = self._tokens[gw * self.seq : (gw + 1) * self.seq]
         return out
 
     def __iter__(self) -> Iterator[np.ndarray]:
-        step = 0
         buf = np.empty(self.batch * self.seq, np.uint32)
         while True:
+            if self._closed:
+                raise RuntimeError("TokenDataset is closed")
             if self._handle:
                 self._lib.pgt_loader_next(
                     self._handle, buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32))
                 )
                 yield buf.reshape(self.batch, self.seq).copy()
             else:
-                yield self._fill_numpy(step)
-            step += 1
+                yield self._fill_numpy(self._fallback_step)
+                self._fallback_step += 1
 
     def take(self, n: int):
         it = iter(self)
@@ -166,6 +197,7 @@ class TokenDataset:
         if self._handle:
             self._lib.pgt_loader_close(self._handle)
             self._handle = None
+        self._closed = True
 
     def __del__(self):  # pragma: no cover
         try:

@@ -113,6 +113,12 @@ def _compiled_fns(config: BloomConfig, prompt_len: int, temperature: float):
         return _JIT_CACHE[key]
 
     def pick(logits, k):
+        if config.valid_vocab_size is not None:
+            # pad_for_tp zero-rows give padded slots logit 0.0 exactly —
+            # they must never win a decode step
+            from pipegoose_tpu.nn.tensor_parallel.layers import mask_padded_vocab
+
+            logits = mask_padded_vocab(logits, None, config.valid_vocab_size)
         if temperature <= 0.0:
             return jnp.argmax(logits, axis=-1)
         return jax.random.categorical(k, logits / temperature, axis=-1)
