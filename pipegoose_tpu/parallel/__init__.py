@@ -1,3 +1,13 @@
-from pipegoose_tpu.parallel.hybrid import make_hybrid_train_step
+from pipegoose_tpu.parallel.auto import make_auto_train_step
+from pipegoose_tpu.parallel.hybrid import (
+    make_hybrid_train_step,
+    sync_replicated_grads,
+    zero_state_spec,
+)
 
-__all__ = ["make_hybrid_train_step"]
+__all__ = [
+    "make_hybrid_train_step",
+    "make_auto_train_step",
+    "sync_replicated_grads",
+    "zero_state_spec",
+]

@@ -1,0 +1,83 @@
+"""GSPMD auto-parallel train step (the pjit path).
+
+The manual path (parallel/hybrid.py) expresses every collective
+explicitly under ``shard_map`` — required for pipeline scans, ring
+attention, and MoE all_to_all. For plain TP x DP, XLA's GSPMD partitioner
+can derive the collectives itself from array shardings (the
+Mesh-TensorFlow/GSPMD lineage — PAPERS.md): write the model as
+SINGLE-DEVICE code (``tp_axis=None``), put PartitionSpecs on params and
+batch, and ``jit`` inserts the all-reduces/gathers.
+
+This module provides that alternative front end. It is the direct analog
+of BASELINE.json's north-star phrasing ("ParallelMode mesh maps onto a
+jax.sharding.Mesh ... dispatch to XLA collectives"), and doubles as an
+oracle: tests assert manual and auto paths produce the same training
+trajectory.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Optional
+
+import jax
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from pipegoose_tpu.distributed.parallel_context import ParallelContext
+
+
+def _shardings(tree_specs: Any, mesh) -> Any:
+    return jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s),
+        tree_specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def make_auto_train_step(
+    loss_fn: Callable[[Any, Any], jax.Array],
+    param_specs: Any,
+    optimizer: optax.GradientTransformation,
+    parallel_context: Optional[ParallelContext] = None,
+    batch_spec: P = P("data"),
+):
+    """(init_fn, step_fn) with GSPMD-derived parallelism.
+
+    ``loss_fn(params, batch) -> scalar`` must be plain single-device
+    model code (no axis names / collectives) — e.g.
+    ``bloom.loss_fn(..., tp_axis=None)``. Optimizer state inherits each
+    param's sharding (replicate over data; ZeRO-style state sharding is
+    the manual path's job). step_fn donates its params/opt_state buffers
+    — keep only the returned arrays.
+    """
+    ctx = parallel_context or ParallelContext.get_context()
+    if ctx is None:
+        raise ValueError("no ParallelContext; construct one first")
+    mesh = ctx.mesh
+    p_sh = _shardings(param_specs, mesh)
+    b_sh = NamedSharding(mesh, batch_spec)
+    rep = NamedSharding(mesh, P())
+
+    def init_fn(params):
+        from pipegoose_tpu.nn.parallel import shard_tree
+
+        params = shard_tree(params, param_specs, ctx)
+        # let GSPMD choose optimizer-state layouts: momentum-like leaves
+        # inherit their param's sharding through the init computation
+        opt_state = jax.jit(optimizer.init)(params)
+        return params, opt_state
+
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def step(params, opt_state, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        # pin param shardings so they don't drift across steps
+        params = jax.lax.with_sharding_constraint(params, p_sh)
+        return params, opt_state, loss
+
+    def step_fn(params, opt_state, batch):
+        batch = jax.device_put(batch, b_sh)
+        return step(params, opt_state, batch)
+
+    return init_fn, step_fn

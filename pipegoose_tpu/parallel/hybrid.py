@@ -112,6 +112,8 @@ def make_hybrid_train_step(
     which SURVEY.md §7 flags as wrong for router noise).
     """
     ctx = parallel_context or ParallelContext.get_context()
+    if ctx is None:
+        raise ValueError("no ParallelContext; construct one first")
     mesh = ctx.mesh
 
     def _state_spec_for(params):
