@@ -1,0 +1,66 @@
+"""Policy-registry unit tests (reference tests for ParallelMapping
+predicates, nn/parallel_mapping.py:40-74 analogs)."""
+import jax.numpy as jnp
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from pipegoose_tpu.nn import Column, Expert, ParallelMapping, Replicate, Row, Vocab
+from pipegoose_tpu.nn.parallel import path_str, spec_tree
+
+
+@pytest.fixture()
+def mapping():
+    return ParallelMapping(
+        [
+            (r"attn/qkv", Column()),
+            (r"attn/out", Row()),
+            (r"embed", Vocab()),
+            (r"experts", Expert()),
+            (r"norm", Replicate()),
+        ]
+    )
+
+
+def test_predicates(mapping):
+    assert mapping.is_column_parallel("blocks/attn/qkv/kernel")
+    assert mapping.is_row_parallel("blocks/attn/out/kernel")
+    assert mapping.is_vocab_parallel("embed/weight")
+    assert mapping.is_expert("moe/experts/up")
+    assert not mapping.is_column_parallel("embed/weight")
+    assert mapping.search("unmatched/path") is None
+
+
+def test_first_match_wins():
+    m = ParallelMapping([(r"w", Column()), (r"w2", Row())])
+    assert m.search("w2").role == "column"  # 'w' matches first
+
+
+def test_rank_aware_bias_specs(mapping):
+    # column bias shards, row bias replicates (reference parallelizer rules)
+    assert mapping.spec_for("attn/qkv/bias", ndim=1) == P("tensor")
+    assert mapping.spec_for("attn/out/bias", ndim=1) == P()
+    assert mapping.spec_for("attn/qkv/kernel", ndim=2) == P(None, "tensor")
+    assert mapping.spec_for("nothing", ndim=2) == P()
+
+
+def test_spec_tree_paths():
+    params = {"a": {"b": jnp.zeros((2, 2))}, "c": [jnp.zeros(3)]}
+    seen = []
+    spec_tree(params, lambda p, x: seen.append(p) or P())
+    assert sorted(seen) == ["a/b", "c/0"]
+
+
+def test_logger_file_output(tmp_path):
+    import logging
+
+    from pipegoose_tpu.trainer import DistributedLogger
+
+    logfile = str(tmp_path / "train.log")
+    # a prior logger already installed a stream handler on this name —
+    # the logfile must still attach (regression)
+    DistributedLogger(name="pgt-test-log")
+    lg = DistributedLogger(name="pgt-test-log", logfile=logfile)
+    lg.info("hello-metric")
+    for h in logging.getLogger("pgt-test-log").handlers:
+        h.flush()
+    assert "hello-metric" in open(logfile).read()
