@@ -1,0 +1,86 @@
+"""Shared autoregressive decode driver.
+
+One compiled prefill + scan-decode loop, parameterized by a model's
+``forward_cached(params, ids, cache, start, config) -> (logits, cache)``
+— used by both BLOOM (models/generate.py) and Mixtral
+(models/mixtral.py) so EOS semantics, sampling, and jit caching cannot
+drift between model families.
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_JIT_CACHE: dict = {}
+
+
+def autoregressive_generate(
+    forward_cached: Callable,
+    init_cache: Callable,
+    params,
+    input_ids: jax.Array,
+    config,
+    max_new_tokens: int,
+    temperature: float = 0.0,
+    rng: Optional[jax.Array] = None,
+    eos_token_id: Optional[int] = None,
+    logits_mask: Optional[Callable] = None,
+) -> jax.Array:
+    """Greedy (temperature=0) or sampled decoding with a KV cache.
+
+    - ``eos_token_id``: finished sequences emit eos from then on (HF
+      generate's pad-with-eos behavior);
+    - ``logits_mask(logits) -> logits``: e.g. padded-vocab masking;
+    - compiled programs cached per (model fwd, config, prompt len, exact
+      temperature, eos) — params stay runtime arguments.
+    """
+    if max_new_tokens <= 0:
+        return input_ids
+    b, s = input_ids.shape
+    cache = init_cache(config, b, s + max_new_tokens)
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+    eos = -1 if eos_token_id is None else int(eos_token_id)
+
+    key = (forward_cached, config, s, float(temperature), eos, logits_mask)
+    if key not in _JIT_CACHE:
+
+        def pick(logits, k):
+            if logits_mask is not None:
+                logits = logits_mask(logits)
+            if temperature <= 0.0:
+                return jnp.argmax(logits, axis=-1)
+            return jax.random.categorical(k, logits / temperature, axis=-1)
+
+        @jax.jit
+        def prefill(params, ids, cache, k):
+            logits, cache = forward_cached(params, ids, cache, 0, config)
+            return pick(logits, k), cache
+
+        @jax.jit
+        def decode_all(params, first, cache, keys):
+            def step(carry, k):
+                tok, done, cache, pos = carry
+                logits, cache = forward_cached(params, tok[:, None], cache, pos, config)
+                nxt = pick(logits, k)
+                nxt = jnp.where(done, eos, nxt)
+                done = done | (nxt == eos)
+                return (nxt, done, cache, pos + 1), nxt
+
+            init = (first, first == eos, cache, jnp.asarray(s))
+            _, toks = lax.scan(step, init, keys)
+            return toks
+
+        _JIT_CACHE[key] = (prefill, decode_all)
+    prefill, decode_all = _JIT_CACHE[key]
+
+    first, cache = prefill(params, input_ids, cache, rng)
+    if max_new_tokens == 1:
+        return jnp.concatenate([input_ids, first[:, None]], axis=1)
+    keys = jax.random.split(jax.random.fold_in(rng, 1), max_new_tokens - 1)
+    rest = decode_all(params, first, cache, keys)
+    out = jnp.concatenate([first[:, None], rest.T], axis=1)
+    return jnp.concatenate([input_ids, out], axis=1)

@@ -101,47 +101,29 @@ def forward_cached(params, ids, cache, start, config):
     return logits, {"k": k_new, "v": v_new}
 
 
-# compiled prefill/decode programs, keyed by (config, shapes, sampling) —
-# a fresh jit per generate() call would recompile everything and bake the
-# weight pytree into the program as constants
-_JIT_CACHE: dict = {}
+def _bloom_init_cache(config, batch, max_len):
+    return init_cache(config, batch, max_len)
 
 
-def _compiled_fns(config: BloomConfig, prompt_len: int, temperature: float):
-    key = (config, prompt_len, temperature > 0.0)
-    if key in _JIT_CACHE:
-        return _JIT_CACHE[key]
+_MASKS: dict = {}
 
-    def pick(logits, k):
-        if config.valid_vocab_size is not None:
+
+def _bloom_vocab_mask(config):
+    """Memoized per valid size: the mask closure participates in the
+    decode driver's jit-cache key, so it must be a stable object."""
+    if config.valid_vocab_size is None:
+        return None
+    valid = config.valid_vocab_size
+    if valid not in _MASKS:
+        from pipegoose_tpu.nn.tensor_parallel.layers import mask_padded_vocab
+
+        def mask(logits, _valid=valid):
             # pad_for_tp zero-rows give padded slots logit 0.0 exactly —
             # they must never win a decode step
-            from pipegoose_tpu.nn.tensor_parallel.layers import mask_padded_vocab
+            return mask_padded_vocab(logits, None, _valid)
 
-            logits = mask_padded_vocab(logits, None, config.valid_vocab_size)
-        if temperature <= 0.0:
-            return jnp.argmax(logits, axis=-1)
-        return jax.random.categorical(k, logits / temperature, axis=-1)
-
-    @jax.jit
-    def prefill(params, ids, cache, rng):
-        logits, cache = forward_cached(params, ids, cache, 0, config)
-        return pick(logits, rng), cache
-
-    @jax.jit
-    def decode_all(params, first, cache, keys):
-        def decode_step(carry, k):
-            tok, cache, pos = carry
-            logits, cache = forward_cached(params, tok[:, None], cache, pos, config)
-            nxt = pick(logits, k)
-            return (nxt, cache, pos + 1), nxt
-
-        init = (first, cache, jnp.asarray(prompt_len))
-        _, toks = lax.scan(decode_step, init, keys)
-        return toks
-
-    _JIT_CACHE[key] = (prefill, decode_all)
-    return _JIT_CACHE[key]
+        _MASKS[valid] = mask
+    return _MASKS[valid]
 
 
 def generate(
@@ -151,22 +133,15 @@ def generate(
     max_new_tokens: int,
     temperature: float = 0.0,
     rng: Optional[jax.Array] = None,
+    eos_token_id: Optional[int] = None,
 ) -> jax.Array:
-    """Greedy (temperature=0) or sampled decoding. Returns (B, S+new)."""
-    if max_new_tokens <= 0:
-        return input_ids
-    b, s = input_ids.shape
-    max_len = s + max_new_tokens
-    cache = init_cache(config, b, max_len)
-    if rng is None:
-        rng = jax.random.PRNGKey(0)
+    """Greedy (temperature=0) or sampled decoding. Returns (B, S+new).
+    ``eos_token_id``: finished sequences emit eos from then on (HF
+    generate's pad-with-eos behavior)."""
+    from pipegoose_tpu.models._decode import autoregressive_generate
 
-    prefill, decode_all = _compiled_fns(config, s, temperature)
-    first, cache = prefill(params, input_ids, cache, rng)
-
-    if max_new_tokens == 1:
-        return jnp.concatenate([input_ids, first[:, None]], axis=1)
-    keys = jax.random.split(jax.random.fold_in(rng, 1), max_new_tokens - 1)
-    rest = decode_all(params, first, cache, keys)  # (T-1, B)
-    out = jnp.concatenate([first[:, None], rest.T], axis=1)
-    return jnp.concatenate([input_ids, out], axis=1)
+    return autoregressive_generate(
+        forward_cached, _bloom_init_cache, params, input_ids, config,
+        max_new_tokens, temperature, rng, eos_token_id,
+        logits_mask=_bloom_vocab_mask(config),
+    )

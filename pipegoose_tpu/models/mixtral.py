@@ -305,3 +305,96 @@ def specs(params: dict, tp_axis: str = "tensor", ep_axis: str = "expert") -> dic
         return P()
 
     return spec_tree(params, spec_fn)
+
+
+# -- generation (KV cache) ---------------------------------------------------
+
+def init_cache(config: MixtralConfig, batch: int, max_len: int) -> dict:
+    L, nkv, hd = config.n_layer, config.n_kv_head, config.head_dim
+    shape = (L, batch, max_len, nkv, hd)
+    return {"k": jnp.zeros(shape, config.dtype), "v": jnp.zeros(shape, config.dtype)}
+
+
+def _attn_cached(blk, x, k_cache, v_cache, start, cos_full, sin_full, config):
+    """S new tokens against cache[:start]+selves (GQA, RoPE at absolute
+    positions). Returns (out, k_cache, v_cache)."""
+    b, s, _ = x.shape
+    hd = config.head_dim
+    nh, nkv = config.n_head, config.n_kv_head
+    groups = nh // nkv
+    max_len = k_cache.shape[1]
+
+    q = column_parallel_linear(blk["q"], x, None).reshape(b, s, nh, hd)
+    k = column_parallel_linear(blk["k"], x, None).reshape(b, s, nkv, hd)
+    v = column_parallel_linear(blk["v"], x, None).reshape(b, s, nkv, hd)
+
+    cos = jax.lax.dynamic_slice_in_dim(cos_full, start, s, 0)
+    sin = jax.lax.dynamic_slice_in_dim(sin_full, start, s, 0)
+    q, k = apply_rope(q, k, cos, sin)
+
+    k_cache = jax.lax.dynamic_update_slice(k_cache, k.astype(k_cache.dtype), (0, start, 0, 0))
+    v_cache = jax.lax.dynamic_update_slice(v_cache, v.astype(v_cache.dtype), (0, start, 0, 0))
+
+    key_pos = jnp.arange(max_len)
+    q_pos = start + jnp.arange(s)
+    keep = key_pos[None, :] <= q_pos[:, None]
+    bias = jnp.where(keep[None, None, None], 0.0, NEG_INF)  # (1,1,1,S,max_len)
+
+    # grouped einsum against the nkv-wide cache: no group-repeated K/V
+    # copies in the decode hot loop (GQA's whole point)
+    qg = q.reshape(b, s, nkv, groups, hd)
+    scores = jnp.einsum("bqkgd,bmkd->bkgqm", qg, k_cache,
+                        preferred_element_type=jnp.float32)
+    probs = jax.nn.softmax(scores * (hd**-0.5) + bias, axis=-1).astype(x.dtype)
+    ctx = jnp.einsum("bkgqm,bmkd->bqkgd", probs, v_cache,
+                     preferred_element_type=jnp.float32)
+    ctx = ctx.astype(x.dtype).reshape(b, s, nh * hd)
+    return row_parallel_linear(blk["o"], ctx, None), k_cache, v_cache
+
+
+def forward_cached(params, ids, cache, start, config):
+    """(logits at last position, new cache); deterministic routing
+    (no-drop capacity, no jitter — inference)."""
+    x = vocab_parallel_embedding(params["embed"], ids, None).astype(config.dtype)
+    max_len = cache["k"].shape[2]
+    cos_full, sin_full = rope_cos_sin(max_len, config.head_dim, config.rope_theta)
+
+    def scan_fn(carry, blk_and_cache):
+        h = carry
+        blk, kc, vc = blk_and_cache
+        ln1 = rms_norm(blk["ln_1"], h, config.rms_eps)
+        attn, kc, vc = _attn_cached(
+            blk["attn"], ln1, kc, vc, start, cos_full, sin_full, config
+        )
+        h = h + attn
+        ln2 = rms_norm(blk["ln_2"], h, config.rms_eps)
+        router = config.router()
+        flat = ln2.reshape(-1, ln2.shape[-1])
+        routing = router(blk["router"], flat, train=False)
+        h = h + moe_layer(blk["moe"], ln2, routing, axis_name=None,
+                          tp_axis=None, mlp_fn=_swiglu_experts)
+        return h, (kc, vc)
+
+    x, (k_new, v_new) = jax.lax.scan(scan_fn, x, (params["blocks"], cache["k"], cache["v"]))
+    x = rms_norm(params["ln_f"], x, config.rms_eps)
+    logits = column_parallel_linear(params["lm_head"], x[:, -1:], None)[:, 0]
+    return logits, {"k": k_new, "v": v_new}
+
+
+def generate(
+    params: dict,
+    input_ids: jax.Array,
+    config: MixtralConfig,
+    max_new_tokens: int,
+    temperature: float = 0.0,
+    rng=None,
+    eos_token_id=None,
+) -> jax.Array:
+    """Greedy/sampled decoding with a GQA KV cache — shared decode
+    driver (models/_decode.py), same EOS semantics as BLOOM's generate."""
+    from pipegoose_tpu.models._decode import autoregressive_generate
+
+    return autoregressive_generate(
+        forward_cached, init_cache, params, input_ids, config,
+        max_new_tokens, temperature, rng, eos_token_id,
+    )

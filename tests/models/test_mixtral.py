@@ -161,3 +161,19 @@ def test_tp_grads_consistent_across_tensor_ranks(hf_model, inputs, devices):
                 )
     finally:
         ctx.destroy()
+
+
+def test_generate_matches_hf(hf_model):
+    import torch
+
+    cfg, params = mixtral_params_from_hf(hf_model)
+    ids = np.random.RandomState(21).randint(0, 128, (2, 5))
+    with torch.no_grad():
+        hf_out = hf_model.generate(
+            torch.tensor(ids), max_new_tokens=5, do_sample=False
+        ).numpy()
+    # HF pads finished (eos=2) sequences with eos — match that semantics
+    ours = np.asarray(
+        mixtral.generate(params, jnp.asarray(ids), cfg, max_new_tokens=5, eos_token_id=2)
+    )
+    np.testing.assert_array_equal(ours, hf_out)
