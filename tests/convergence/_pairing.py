@@ -1,0 +1,27 @@
+"""Shared paired-loss comparison gate for the convergence scripts."""
+from __future__ import annotations
+
+import math
+import sys
+
+
+def run_paired(batches, ref_step, par_step, tol: float, names=("ref", "par")):
+    """Run both steps over the batches, print a paired-loss CSV, and exit
+    nonzero if relative divergence exceeds ``tol`` — or if ANY loss goes
+    non-finite (a NaN must fail the gate, not sail past a max())."""
+    print(f"step,{names[0]}_loss,{names[1]}_loss,abs_diff")
+    worst = 0.0
+    for i, ids in enumerate(batches):
+        ref_loss = float(ref_step(ids))
+        loss = float(par_step(ids))
+        d = abs(loss - ref_loss)
+        rel = d / max(abs(ref_loss), 1e-6)
+        if not (math.isfinite(ref_loss) and math.isfinite(loss)):
+            worst = float("inf")
+        else:
+            worst = max(worst, rel)
+        print(f"{i},{ref_loss:.6f},{loss:.6f},{d:.2e}")
+    ok = worst <= tol
+    print(f"max relative divergence: {worst:.2e} (tol {tol}) -> "
+          f"{'PASS' if ok else 'FAIL'}")
+    sys.exit(0 if ok else 1)

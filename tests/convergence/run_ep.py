@@ -1,0 +1,93 @@
+"""MoE convergence side-by-side: EP x TP x DP BLOOM-MoE vs single device
+(the reference's run_ep.py:107-246 workflow, compiled + paired-loss CSV).
+
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python tests/convergence/run_ep.py --steps 20
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import PartitionSpec as P
+
+from pipegoose_tpu.distributed import ParallelContext
+from pipegoose_tpu.models import bloom_moe
+from pipegoose_tpu.optim.zero import DistributedOptimizer
+from pipegoose_tpu.parallel import make_hybrid_train_step
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--tol", type=float, default=1e-2)
+    args = ap.parse_args()
+
+    cfg = bloom_moe.BloomMoEConfig(
+        vocab_size=512, hidden_size=128, n_layer=2, n_head=8,
+        num_experts=4, top_k=1, capacity_factor=4.0, router_noise_eps=0.0,
+        aux_loss_weight=0.0,  # per-device aux is nonlinear across shards
+    )
+    params = bloom_moe.init_params(cfg, jax.random.PRNGKey(0))
+    rng = np.random.RandomState(1)
+    batches = [
+        jnp.asarray(rng.randint(0, cfg.vocab_size, (8, 32)))
+        for _ in range(args.steps)
+    ]
+
+    opt = optax.sgd(0.05)
+    st = opt.init(params)
+    p_ref = params
+
+    @jax.jit
+    def ref_step(p, s, ids):
+        loss, grads = jax.value_and_grad(
+            lambda p: bloom_moe.loss_fn(p, ids, None, ids, cfg, train=False)
+        )(p)
+        u, s2 = opt.update(grads, s, p)
+        return optax.apply_updates(p, u), s2, loss
+
+    ctx = ParallelContext(
+        tensor_parallel_size=2, expert_parallel_size=2, data_parallel_size=2
+    )
+    init_fn, make_step = make_hybrid_train_step(
+        lambda p, ids: bloom_moe.loss_fn(
+            p, ids, None, ids, cfg, tp_axis="tensor", ep_axis="expert", train=False
+        ),
+        bloom_moe.moe_specs(params),
+        DistributedOptimizer(optax.sgd(0.05), axis_name="data"),
+        ctx,
+        batch_spec=P(("data", "expert")),
+        loss_axis=("data", "expert"),
+        grad_sync_axes=(("expert", "mean"),),
+    )
+    opt_state = init_fn(params)
+    step = make_step(params)
+    p = params
+
+    state = {"ref": (p_ref, st), "par": (p, opt_state)}
+
+    def ref_fn(ids):
+        p, s = state["ref"]
+        p, s, loss = ref_step(p, s, ids)
+        state["ref"] = (p, s)
+        return loss
+
+    def par_fn(ids):
+        p, s = state["par"]
+        p, s, loss = step(p, s, ids)
+        state["par"] = (p, s)
+        return loss
+
+    sys.path.insert(0, __import__("os").path.dirname(__import__("os").path.abspath(__file__)))
+    from _pairing import run_paired
+
+    run_paired(batches, ref_fn, par_fn, args.tol, names=("ref", "moe"))
+
+
+if __name__ == "__main__":
+    main()
