@@ -5,95 +5,227 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 The reference publishes no throughput numbers (BASELINE.md) — its
 acceptance bar is convergence only. ``vs_baseline`` therefore reports
 achieved MFU / 0.40, the north-star MFU threshold from BASELINE.json.
+
+Robustness: the TPU backend in this environment can wedge (single-client
+tunnel). The parent process therefore NEVER touches the accelerator
+backend itself: the full bench runs in ONE child process that prints a
+``BENCH_READY <platform>`` sentinel right after backend init. The parent
+enforces a short deadline for the sentinel (wedged-backend bound) and a
+longer one for the measurement, terminating gracefully (SIGTERM first —
+a SIGKILLed attached client wedges the tunnel). Any child failure or
+timeout falls back to a CPU smoke run reported with
+``"device": "cpu-fallback"`` instead of rc=1.
 """
 from __future__ import annotations
 
 import json
+import os
+import subprocess
+import sys
+import threading
 import time
-
-import jax
-import jax.numpy as jnp
-import numpy as np
-import optax
 
 # per-chip peak bf16 FLOP/s
 PEAK_FLOPS = {
     "v5 lite": 197e12,  # v5e
     "v5e": 197e12,
     "v5p": 459e12,
+    "v6 lite": 918e12,  # v6e (Trillium)
+    "v6e": 918e12,
     "v4": 275e12,
     "cpu": 1e12,  # nominal, CPU fallback only
 }
 
+PROBE_TIMEOUT_S = int(os.environ.get("BENCH_PROBE_TIMEOUT_S", "120"))
+TPU_BENCH_TIMEOUT_S = int(os.environ.get("BENCH_TPU_TIMEOUT_S", "1200"))
 
-def _peak_flops(device) -> float:
-    kind = getattr(device, "device_kind", "cpu").lower()
+
+def _peak_flops(device_kind: str) -> float:
+    kind = device_kind.lower()
     for k, v in PEAK_FLOPS.items():
         if k in kind:
             return v
     return 1e12
 
 
-def main() -> None:
+def _run_bench_child():
+    """Run the bench in ONE child process (single backend attach).
+
+    The child prints ``BENCH_READY <platform>`` right after backend init
+    and its JSON result line at the end. Deadlines: PROBE_TIMEOUT_S until
+    the sentinel, TPU_BENCH_TIMEOUT_S after it. Termination is graceful
+    (SIGTERM, then SIGKILL after 15s) — the axon tunnel is single-client
+    and a SIGKILLed attached client wedges it for the session.
+
+    Returns the JSON line, or None if the child failed or timed out.
+    """
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__)],
+        env={**os.environ, "BENCH_CHILD": "1"},
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+    )
+    lines: list[str] = []
+    ready = threading.Event()
+    done = threading.Event()
+
+    def reader():
+        for line in proc.stdout:
+            lines.append(line.rstrip("\n"))
+            if line.startswith("BENCH_READY"):
+                ready.set()
+        done.set()
+
+    t = threading.Thread(target=reader, daemon=True)
+    t.start()
+
+    def wait_for(ev: threading.Event, timeout_s: float) -> bool:
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if ev.wait(min(2.0, max(0.0, deadline - time.monotonic()))):
+                return True
+            if proc.poll() is not None:  # child already exited
+                return ev.wait(2.0)
+        return False
+
+    ok = wait_for(ready, PROBE_TIMEOUT_S) and wait_for(done, TPU_BENCH_TIMEOUT_S)
+    if not ok:
+        proc.terminate()
+        try:
+            proc.wait(timeout=15)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+    rc = proc.wait()
+    done.wait(5)  # let the reader drain
+    err = proc.stderr.read() if proc.stderr else ""
+    json_lines = [ln for ln in lines if ln.startswith("{")]
+    if ok and rc == 0 and json_lines:
+        return json_lines[-1]
+    sys.stderr.write(
+        f"bench child failed rc={rc} ready={ready.is_set()}:\n"
+        + err[-2000:] + "\n"
+    )
+    return None
+
+
+def run_bench(force_cpu: bool) -> None:
+    if force_cpu:
+        # Force CPU BEFORE the first backend touch — the axon sitecustomize
+        # ignores JAX_PLATFORMS, only the config update works.
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
     from pipegoose_tpu.models import bloom
 
     dev = jax.devices()[0]
-    on_tpu = "tpu" in getattr(dev, "platform", "").lower() or "lite" in getattr(
-        dev, "device_kind", ""
-    ).lower()
+    if os.environ.get("BENCH_CHILD"):
+        print("BENCH_READY", dev.platform, flush=True)
+    on_tpu = dev.platform.lower() != "cpu"
+    device_kind = getattr(dev, "device_kind", "cpu") if on_tpu else (
+        "cpu-fallback" if force_cpu else "cpu"
+    )
 
     if on_tpu:
-        cfg = bloom.BloomConfig.bloom_560m(dtype=jnp.bfloat16, remat=True)
         batch, seq, steps = 8, 1024, 10
+        variants = {
+            "xla": bloom.BloomConfig.bloom_560m(dtype=jnp.bfloat16, remat=True),
+            "flash": bloom.BloomConfig.bloom_560m(
+                dtype=jnp.bfloat16, remat=True, use_flash=True
+            ),
+        }
     else:  # CPU smoke fallback
-        cfg = bloom.BloomConfig(
-            vocab_size=1024, hidden_size=256, n_layer=4, n_head=8, dtype=jnp.float32
-        )
         batch, seq, steps = 2, 128, 3
+        variants = {
+            "xla": bloom.BloomConfig(
+                vocab_size=1024, hidden_size=256, n_layer=4, n_head=8,
+                dtype=jnp.float32,
+            )
+        }
 
-    params = bloom.init_params(cfg, jax.random.PRNGKey(0))
-    opt = optax.adam(1e-4)
-    opt_state = opt.init(params)
-    ids = jnp.asarray(np.random.RandomState(0).randint(0, cfg.vocab_size, (batch, seq)))
+    def measure(cfg):
+        params = bloom.init_params(cfg, jax.random.PRNGKey(0))
+        opt = optax.adam(1e-4)
+        opt_state = opt.init(params)
+        ids = jnp.asarray(
+            np.random.RandomState(0).randint(0, cfg.vocab_size, (batch, seq))
+        )
 
-    @jax.jit
-    def step(params, opt_state, ids):
-        loss, grads = jax.value_and_grad(bloom.loss_fn)(params, ids, None, ids, cfg)
-        updates, opt_state = opt.update(grads, opt_state, params)
-        return optax.apply_updates(params, updates), opt_state, loss
+        @jax.jit
+        def step(params, opt_state, ids):
+            loss, grads = jax.value_and_grad(bloom.loss_fn)(params, ids, None, ids, cfg)
+            updates, opt_state = opt.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, loss
 
-    # warmup/compile
-    params, opt_state, loss = step(params, opt_state, ids)
-    jax.block_until_ready(loss)
-
-    t0 = time.perf_counter()
-    for _ in range(steps):
+        # warmup/compile
         params, opt_state, loss = step(params, opt_state, ids)
-    jax.block_until_ready(loss)
-    dt = time.perf_counter() - t0
+        jax.block_until_ready(loss)
 
-    tokens_per_sec = batch * seq * steps / dt
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            params, opt_state, loss = step(params, opt_state, ids)
+        jax.block_until_ready(loss)
+        dt = time.perf_counter() - t0
 
-    # model FLOPs per token: 6*N for dense matmuls + 12*L*H*seq attention
-    n_params = sum(int(np.prod(p.shape)) for p in jax.tree_util.tree_leaves(params))
-    flops_per_token = 6 * n_params + 12 * cfg.n_layer * cfg.hidden_size * seq
-    mfu = tokens_per_sec * flops_per_token / _peak_flops(dev)
+        tokens_per_sec = batch * seq * steps / dt
+        # model FLOPs per token: 6*N for dense matmuls + 12*L*H*seq attention
+        n_params = sum(
+            int(np.prod(p.shape)) for p in jax.tree_util.tree_leaves(params)
+        )
+        flops_per_token = 6 * n_params + 12 * cfg.n_layer * cfg.hidden_size * seq
+        mfu = tokens_per_sec * flops_per_token / _peak_flops(device_kind)
+        return {
+            "tokens_per_sec": round(tokens_per_sec, 1),
+            "mfu": round(mfu, 4),
+            "loss": float(loss),
+        }
 
+    results = {}
+    for name, cfg in variants.items():
+        # a failing variant (e.g. an experimental kernel) must not discard
+        # the other variants' measurements
+        try:
+            results[name] = measure(cfg)
+        except Exception as e:  # noqa: BLE001
+            results[name] = {"error": f"{type(e).__name__}: {e}"[:500]}
+
+    ok = {k: v for k, v in results.items() if "error" not in v}
+    if not ok:
+        raise RuntimeError(f"all bench variants failed: {results}")
+    best = max(ok, key=lambda k: ok[k]["tokens_per_sec"])
+    r = results[best]
     print(
         json.dumps(
             {
                 "metric": "bloom-560m train tokens/sec/chip"
                 if on_tpu
                 else "bloom-tiny train tokens/sec (cpu smoke)",
-                "value": round(tokens_per_sec, 1),
+                "value": r["tokens_per_sec"],
                 "unit": "tokens/sec/chip",
-                "vs_baseline": round(mfu / 0.40, 4),
-                "mfu": round(mfu, 4),
-                "device": getattr(dev, "device_kind", str(dev)),
-                "loss": float(loss),
+                "vs_baseline": round(r["mfu"] / 0.40, 4),
+                "mfu": r["mfu"],
+                "device": device_kind,
+                "best_variant": best,
+                "variants": results,
+                "loss": r["loss"],
             }
         )
     )
+
+
+def main() -> None:
+    if os.environ.get("BENCH_CHILD"):
+        run_bench(force_cpu=False)
+        return
+    if not os.environ.get("BENCH_FORCE_CPU"):
+        line = _run_bench_child()
+        if line is not None:
+            print(line)
+            return
+    run_bench(force_cpu=True)
 
 
 if __name__ == "__main__":
