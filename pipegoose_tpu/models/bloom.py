@@ -64,8 +64,8 @@ class BloomConfig:
     dtype: Any = jnp.float32
     # rematerialize each block's activations in backward (HBM for FLOPs)
     remat: bool = False
-    # fused Pallas flash attention (ops/flash_attention.py): causal+alibi
-    # only — requires unpadded batches (attention_mask None or all ones)
+    # fused Pallas flash attention (ops/flash_attention.py): causal+alibi,
+    # padding masks supported via the kernel's kv_pos/kv_neg bias inputs
     use_flash: bool = False
     # set when the embedding was padded for TP divisibility (pad_for_tp):
     # the true vocab size; padded logit slots are masked out of the CE
@@ -184,15 +184,15 @@ def _mlp(blk: dict, x: jax.Array, config: BloomConfig, tp_axis) -> jax.Array:
 def _attention(
     blk: dict,
     x: jax.Array,
-    alibi: jax.Array,
-    mask_bias: jax.Array,
+    bias: dict,
     config: BloomConfig,
     tp_axis: Optional[str],
 ) -> jax.Array:
     """Self-attention with heads sharded over ``tp_axis``. qkv is
     column-parallel, the output projection row-parallel — the Megatron
     pattern the reference applies by module surgery
-    (tensor_parallel/parallel_mapping.py:23-31)."""
+    (tensor_parallel/parallel_mapping.py:23-31). ``bias`` is the dict
+    from :func:`attention_bias`."""
     b, s, _ = x.shape
     hd = config.head_dim
     tp = jax.lax.axis_size(tp_axis) if tp_axis else 1
@@ -203,25 +203,29 @@ def _attention(
     q, k, v = fused[..., 0, :], fused[..., 1, :], fused[..., 2, :]
 
     if config.use_flash:
-        # fused kernel path: alibi from static slopes, causal mask inside
-        # the kernel; padding masks are NOT applied (unpadded batches)
+        # fused kernel path: alibi from static slopes; causal + padding
+        # masks applied inside the kernel via kv_pos/kv_neg
         from pipegoose_tpu.ops.flash_attention import flash_attention
 
         slopes = jnp.asarray(alibi_slopes(config.n_head))
         if tp_axis:
             h0 = jax.lax.axis_index(tp_axis) * local_heads
             slopes = jax.lax.dynamic_slice_in_dim(slopes, h0, local_heads, 0)
-        ctx = flash_attention(q, k, v, slopes, causal=True)
+        ctx = flash_attention(
+            q, k, v, slopes,
+            kv_pos=bias["kv_pos"], kv_neg=bias["kv_neg"], causal=True,
+        )
         ctx = ctx.astype(x.dtype).reshape(b, s, local_heads * hd)
         return row_parallel_linear(blk["out"], ctx, tp_axis)
 
     # local head slice of the alibi bias
+    alibi = bias["alibi"]
     if tp_axis:
         h0 = jax.lax.axis_index(tp_axis) * local_heads
         alibi = jax.lax.dynamic_slice_in_dim(alibi, h0, local_heads, axis=1)
 
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
-    scores = scores * (1.0 / math.sqrt(hd)) + alibi + mask_bias
+    scores = scores * (1.0 / math.sqrt(hd)) + alibi + bias["mask_bias"]
     probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(x.dtype)
     ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, v, preferred_element_type=jnp.float32)
     ctx = ctx.astype(x.dtype).reshape(b, s, local_heads * hd)
@@ -231,8 +235,7 @@ def _attention(
 def _block(
     blk: dict,
     x: jax.Array,
-    alibi: jax.Array,
-    mask_bias: jax.Array,
+    bias: dict,
     config: BloomConfig,
     tp_axis: Optional[str],
 ) -> jax.Array:
@@ -240,7 +243,7 @@ def _block(
     from the un-normalized stream)."""
     eps = config.layer_norm_epsilon
     ln1 = layer_norm(blk["ln_1"], x, eps)
-    x = x + _attention(blk["attn"], ln1, alibi, mask_bias, config, tp_axis)
+    x = x + _attention(blk["attn"], ln1, bias, config, tp_axis)
     return x + _mlp(blk, x, config, tp_axis)
 
 
@@ -256,14 +259,21 @@ def embed_tokens(
 
 def attention_bias(attention_mask: jax.Array, config: BloomConfig) -> dict:
     """ALiBi + combined causal/padding mask bias (single source for the
-    plain and pipeline forward paths)."""
+    plain and pipeline forward paths). Also carries the flash-kernel
+    form of the same information: per-key mask-aware ALiBi position
+    ``kv_pos`` and validity bias ``kv_neg`` (B, S)."""
+    from pipegoose_tpu.ops.flash_attention import mask_to_kv_bias
+
     s = attention_mask.shape[-1]
     alibi = build_alibi(attention_mask, config.n_head)
     causal = jnp.tril(jnp.ones((s, s), dtype=bool))
     keep = causal[None, None] & (attention_mask[:, None, None, :] > 0)
+    kv_pos, kv_neg = mask_to_kv_bias(attention_mask)
     return {
         "alibi": alibi,
         "mask_bias": jnp.where(keep, 0.0, NEG_INF).astype(jnp.float32),
+        "kv_pos": kv_pos,
+        "kv_neg": kv_neg,
     }
 
 
@@ -281,14 +291,13 @@ def forward_hidden(
 
     x = embed_tokens(params, input_ids, config, tp_axis)
     bias = attention_bias(attention_mask, config)
-    alibi, mask_bias = bias["alibi"], bias["mask_bias"]
 
     block = partial(_block, config=config, tp_axis=tp_axis)
     if config.remat:
         block = jax.checkpoint(block)
 
     def scan_fn(carry, blk):
-        return block(blk, carry, alibi, mask_bias), None
+        return block(blk, carry, bias), None
 
     x, _ = jax.lax.scan(scan_fn, x, params["blocks"])
     return layer_norm(params["ln_f"], x, config.layer_norm_epsilon)
@@ -450,10 +459,7 @@ def loss_fn_pp(
 
     def stage_fn(blocks, h, side):
         def scan_fn(carry, blk):
-            return (
-                _block(blk, carry, side["alibi"], side["mask_bias"], config, tp_axis),
-                None,
-            )
+            return _block(blk, carry, side, config, tp_axis), None
 
         h, _ = jax.lax.scan(scan_fn, h, blocks)
         return h

@@ -91,8 +91,7 @@ def init_params(config: BloomMoEConfig, key: jax.Array) -> dict:
 def _moe_block(
     blk: dict,
     x: jax.Array,
-    alibi: jax.Array,
-    mask_bias: jax.Array,
+    bias: dict,
     key: Optional[jax.Array],
     config: BloomMoEConfig,
     tp_axis: Optional[str],
@@ -101,7 +100,7 @@ def _moe_block(
 ):
     eps = config.layer_norm_epsilon
     ln1 = layer_norm(blk["ln_1"], x, eps)
-    x = x + _bloom._attention(blk["attn"], ln1, alibi, mask_bias, config, tp_axis)
+    x = x + _bloom._attention(blk["attn"], ln1, bias, config, tp_axis)
     ln2 = layer_norm(blk["ln_2"], x, eps)
 
     router = config.router()
@@ -148,8 +147,7 @@ def forward_hidden(
     def scan_fn(carry, blk_and_key):
         blk, key = blk_and_key
         out, aux, z = _moe_block(
-            blk, carry, bias["alibi"], bias["mask_bias"], key,
-            config, tp_axis, ep_axis, train,
+            blk, carry, bias, key, config, tp_axis, ep_axis, train,
         )
         return out, (aux, z)
 

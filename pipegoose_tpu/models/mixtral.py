@@ -277,6 +277,125 @@ def loss_fn(params, input_ids, attention_mask, labels, config,
     )
 
 
+def loss_fn_pp(
+    params: dict,
+    input_ids: jax.Array,
+    attention_mask: Optional[jax.Array],
+    labels: jax.Array,
+    config: MixtralConfig,
+    n_microbatches: int,
+    tp_axis: Optional[str] = None,
+    pipe_axis: str = "pipe",
+    ep_axis: Optional[str] = None,
+    rng: Optional[jax.Array] = None,
+    train: bool = True,
+) -> jax.Array:
+    """Pipeline-parallel Mixtral loss: the 4D TP x PP x DP x EP
+    composition (BASELINE config 5 shape; the reference's group layout
+    supports it at parallel_context.py:173-198 but never demonstrates it
+    end-to-end).
+
+    Structure mirrors bloom.loss_fn_pp (vectorized embed -> compiled
+    GPipe over the pipe-sharded block stack -> vectorized head) plus the
+    MoE-specific parts:
+    - per-stage router aux/z losses ride gpipe's ``with_aux``
+      accumulator (valid microbatches only) and are combined across the
+      pipe axis with an identity-backward psum — each rank's router
+      gradients stay local;
+    - per-layer router RNG: every rank derives the full L-layer key
+      array from ``rng`` and slices its own stage's rows, so routing
+      matches the dense path exactly regardless of pp size;
+    - aux/z are averaged over layers x microbatches, keeping
+      ``router_aux_loss_coef`` on HF's scale (dense ``loss_fn`` takes
+      the layer mean; with M=1 the two coincide exactly).
+    """
+    from pipegoose_tpu.distributed.functional import reduce_from_tensor_group
+    from pipegoose_tpu.nn.pipeline_parallel import microbatch as mb
+    from pipegoose_tpu.nn.pipeline_parallel.pipeline import gpipe, last_stage_value
+
+    b, s = input_ids.shape
+    if attention_mask is None:
+        attention_mask = jnp.ones((b, s), jnp.int32)
+
+    P_pipe = jax.lax.axis_size(pipe_axis)
+    L = config.n_layer
+    if L % P_pipe:
+        raise ValueError(
+            f"n_layer={L} must be divisible by the pipe axis size {P_pipe}"
+        )
+    L_local = L // P_pipe
+    stage = jax.lax.axis_index(pipe_axis)
+
+    if rng is None:
+        if train and config.router_jitter:
+            raise ValueError("train=True with router jitter needs an explicit rng")
+        rng = jax.random.PRNGKey(0)
+    layer_keys = jax.random.split(rng, L)  # (L, 2) — same keys as dense
+    local_keys = jax.lax.dynamic_slice_in_dim(layer_keys, stage * L_local, L_local, 0)
+
+    mbs = mb.split(
+        {"ids": input_ids, "mask": attention_mask, "labels": labels}, n_microbatches
+    )
+    M = n_microbatches
+
+    h0 = jax.vmap(
+        lambda ids: vocab_parallel_embedding(params["embed"], ids, tp_axis).astype(
+            config.dtype
+        )
+    )(mbs["ids"])
+
+    cos, sin = rope_cos_sin(s, config.head_dim, config.rope_theta)
+    causal = jnp.tril(jnp.ones((s, s), bool))
+
+    def mk_bias(m):
+        keep = causal[None, None] & (m[:, None, None, :] > 0)
+        return jnp.where(keep, 0.0, NEG_INF).astype(jnp.float32)
+
+    side = {"mask_bias": jax.vmap(mk_bias)(mbs["mask"])}
+
+    def stage_fn(blocks_and_keys, h, side):
+        blocks, keys = blocks_and_keys
+
+        def scan_fn(carry, blk_key):
+            blk, key = blk_key
+            out, aux, z = _block(
+                blk, carry, cos, sin, side["mask_bias"], key,
+                config, tp_axis, ep_axis, train,
+            )
+            return out, (aux, z)
+
+        h, (aux, z) = jax.lax.scan(scan_fn, h, (blocks, keys))
+        return h, (aux.sum(), z.sum())
+
+    outs, (aux_sum, z_sum) = gpipe(
+        stage_fn,
+        (params["blocks"], local_keys),
+        h0,
+        side_inputs=side,
+        axis_name=pipe_axis,
+        remat=config.remat,
+        with_aux=True,
+    )
+
+    def head_one(h, mask, labels):
+        h = rms_norm(params["ln_f"], h, config.rms_eps)
+        logits = column_parallel_linear(params["lm_head"], h, tp_axis)
+        per_tok = vocab_parallel_cross_entropy(logits[:, :-1], labels[:, 1:], tp_axis)
+        w = mask[:, 1:].astype(per_tok.dtype)
+        return (per_tok * w).sum(), w.sum()
+
+    tot, cnt = jax.vmap(head_one)(outs, mbs["mask"], mbs["labels"])
+    task = last_stage_value(tot.sum() / jnp.maximum(cnt.sum(), 1), pipe_axis)
+
+    # identity-backward psum over pipe: forward-replicated totals, local
+    # gradients per rank (the psum-transpose hazard)
+    aux_mean = reduce_from_tensor_group(aux_sum, pipe_axis) / (L * M)
+    z_mean = reduce_from_tensor_group(z_sum, pipe_axis) / (L * M)
+    return ExpertLoss(config.aux_loss_weight, config.z_loss_weight)(
+        task, aux_mean, z_mean
+    )
+
+
 def specs(params: dict, tp_axis: str = "tensor", ep_axis: str = "expert") -> dict:
     """4D PartitionSpecs: attention q/k/v column + o row over tensor,
     experts over expert with FFN over tensor, lm_head column, embedding
@@ -305,6 +424,21 @@ def specs(params: dict, tp_axis: str = "tensor", ep_axis: str = "expert") -> dic
         return P()
 
     return spec_tree(params, spec_fn)
+
+
+def pp_specs(
+    params: dict,
+    tp_axis: str = "tensor",
+    ep_axis: str = "expert",
+    pipe_axis: str = "pipe",
+) -> dict:
+    """4D specs with the stacked n_layer dim of blocks sharded over the
+    pipe axis (stage assignment as a PartitionSpec, like bloom.pp_specs)."""
+    from pipegoose_tpu.nn.pipeline_parallel.pipeline import pipe_stage_specs
+
+    sp = specs(params, tp_axis, ep_axis)
+    sp["blocks"] = pipe_stage_specs(sp["blocks"], pipe_axis)
+    return sp
 
 
 # -- generation (KV cache) ---------------------------------------------------

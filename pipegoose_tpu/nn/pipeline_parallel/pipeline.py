@@ -57,6 +57,7 @@ def gpipe(
     side_inputs: Optional[Any] = None,
     axis_name: str = "pipe",
     remat: bool = True,
+    with_aux: bool = False,
 ) -> Any:
     """Run ``inputs`` (a pytree with leading microbatch dim M, the
     pipeline-entry activations, replicated over the pipe axis but only
@@ -70,9 +71,16 @@ def gpipe(
     microbatch (m = clock - stage) instead of shipping them around the
     ring — for seq-length masks this avoids O(S^2) ppermute traffic.
 
+    With ``with_aux=True``, ``stage_fn`` returns ``(h, aux)`` where
+    ``aux`` is a pytree of per-stage values (e.g. MoE router losses);
+    aux is summed over this stage's VALID microbatches only (bubble
+    clocks contribute zero) and returned per rank — combine over the
+    pipe axis with an identity-backward psum.
+
     Returns the last stage's outputs, shape like ``inputs``, valid on
     the last pipe rank (garbage elsewhere — combine with
-    ``last_stage_value`` or mask downstream).
+    ``last_stage_value`` or mask downstream); with aux, returns
+    ``(outputs, aux_sums)``.
 
     Clock-cycle semantics match GPipeScheduler: task (m, p) runs at
     clock m + p; n_clock = M + P - 1 (reference scheduler.py:66-80).
@@ -89,8 +97,19 @@ def gpipe(
     is_first = stage == 0
     is_last = stage == P - 1
 
+    if with_aux:
+        args = (stage_params, template) + (
+            (_tree_index(side_inputs, 0),) if side_inputs is not None else ()
+        )
+        _, aux_shape = jax.eval_shape(stage_fn, *args)
+        aux_acc0 = jax.tree_util.tree_map(
+            lambda s: jnp.zeros(s.shape, s.dtype), aux_shape
+        )
+    else:
+        aux_acc0 = ()
+
     def clock_step(carry, c):
-        recv, out_buf = carry
+        recv, out_buf, aux_acc = carry
         # stage 0 consumes microbatch c (clamped; garbage past M never
         # reaches a valid output slot within n_clock clocks)
         m_in = jnp.clip(c, 0, M - 1)
@@ -101,19 +120,32 @@ def gpipe(
         if side_inputs is not None:
             m_mine = jnp.clip(c - stage, 0, M - 1)  # this stage's microbatch
             side = _tree_index(side_inputs, m_mine)
-            h_out = fn(stage_params, h_in, side)
+            res = fn(stage_params, h_in, side)
         else:
-            h_out = fn(stage_params, h_in)
+            res = fn(stage_params, h_in)
+        if with_aux:
+            h_out, aux = res
+            # this stage computes microbatch c - stage; clocks outside
+            # [0, M) are bubble garbage and must not pollute the sums
+            valid = (c >= stage) & (c - stage <= M - 1)
+            aux_acc = jax.tree_util.tree_map(
+                lambda acc, a: acc + jnp.where(valid, a, jnp.zeros_like(a)),
+                aux_acc, aux,
+            )
+        else:
+            h_out = res
         # last stage completed microbatch m = c - (P - 1)
         m_out = jnp.clip(c - (P - 1), 0, M - 1)
         write = is_last & (c >= P - 1)
         out_buf = _tree_update(out_buf, h_out, m_out, write)
         # hand to the next stage (ring; last->first carries garbage)
         sent = jax.tree_util.tree_map(lambda a: shift_right(a, axis_name), h_out)
-        return (sent, out_buf), None
+        return (sent, out_buf, aux_acc), None
 
-    (_, out_buf), _ = lax.scan(clock_step, (template, out_buf), jnp.arange(n_clock))
-    return out_buf
+    (_, out_buf, aux_acc), _ = lax.scan(
+        clock_step, (template, out_buf, aux_acc0), jnp.arange(n_clock)
+    )
+    return (out_buf, aux_acc) if with_aux else out_buf
 
 
 def last_stage_value(x: jax.Array, axis_name: str = "pipe") -> jax.Array:

@@ -1,21 +1,29 @@
-"""Fused flash attention (Pallas, TPU).
+"""Fused flash attention (Pallas, TPU) — forward AND backward.
 
 The hot op of every model here is causal self-attention with an additive
 ALiBi bias. XLA's default lowering materializes the (S, S) score matrix
-in HBM; this kernel computes softmax(QK^T * scale + alibi + causal) V
-blockwise in VMEM with the online-softmax recurrence — O(S) memory, MXU
-matmuls, one pass over K/V per Q block.
+in HBM; these kernels compute softmax(QK^T * scale + bias) V blockwise
+in VMEM with the online-softmax recurrence — O(S) memory, MXU matmuls,
+one pass over K/V per Q block.
 
 Kernel structure (canonical TPU flash attention):
-- grid = (batch*heads, n_q_blocks, n_kv_blocks); the kv dimension is
-  sequential ("arbitrary") so the (m, l, acc) scratch carries across kv
-  steps for a fixed (bh, q) program;
+- forward: grid = (batch*heads, n_q_blocks, n_kv_blocks); the kv
+  dimension is sequential ("arbitrary") so the (m, l, acc) scratch
+  carries across kv steps for a fixed (bh, q) program. Also emits the
+  per-row logsumexp for the backward.
+- backward: two kernels recomputing the probabilities from the saved
+  logsumexp (no (S,S) materialization):
+  dq:  grid (bh, nq, nk), kv sequential, accumulates dS @ K;
+  dkv: grid (bh, nk, nq), q sequential, accumulates dS^T @ Q and P^T @ dO;
+  with delta = rowsum(dO * O) computed in plain XLA.
 - per-head ALiBi slope arrives via scalar prefetch (SMEM);
-- fully-masked kv blocks (entirely above the causal diagonal) are
-  skipped with pl.when — ~2x fewer FLOPs for causal attention;
-- backward: custom_vjp falls back to the XLA attention expression with
-  rematerialization (correct gradients; a fused backward kernel is a
-  planned optimization).
+- padding masks are supported via two per-key arrays: ``kv_pos`` (the
+  mask-aware ALiBi position, matching BLOOM's (cumsum(mask)-1)*mask)
+  and ``kv_neg`` (0 for valid keys, NEG_INF for padded ones). The
+  finite NEG_INF keeps fully-masked rows NaN-free (uniform garbage
+  probs; those rows are masked out of the loss downstream).
+- blocks fully above the causal diagonal are skipped with pl.when —
+  ~2x fewer FLOPs for causal attention.
 
 Reference framework has no kernels at all (its README advertises "fused
 kernels"; grep finds none — SURVEY.md, "Scale/completeness caveat").
@@ -23,12 +31,10 @@ kernels"; grep finds none — SURVEY.md, "Scale/completeness caveat").
 from __future__ import annotations
 
 import functools
-import math
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 NEG_INF = -1e9
 
@@ -40,14 +46,38 @@ def _pick_block(n: int, target: int = 128) -> int:
     return n
 
 
-def _flash_fwd_pallas(q, k, v, slopes, scale, causal, block_q, block_k, interpret):
+def mask_to_kv_bias(attention_mask: jax.Array):
+    """(B, S) 1/0 mask -> (kv_pos, kv_neg) f32 kernel bias inputs:
+    mask-aware ALiBi position (BLOOM's (cumsum(mask)-1)*mask) and 0 /
+    NEG_INF key validity. Single source for the kernel and the models."""
+    m = attention_mask.astype(jnp.float32)
+    kv_pos = (jnp.cumsum(m, axis=-1) - 1.0) * m
+    kv_neg = (1.0 - m) * NEG_INF
+    return kv_pos, kv_neg
+
+
+def _bias_block(slope, kpos_ref, kneg_ref, q_start, k_start, block_q, block_k, causal):
+    """Additive bias for one (BQ, BK) score block: ALiBi + padding + causal."""
+    kp = kpos_ref[0].astype(jnp.float32)  # (BK,)
+    kn = kneg_ref[0].astype(jnp.float32)
+    bias = slope * kp[None, :] + kn[None, :]
+    if causal:
+        q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+        k_idx = k_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+        bias = jnp.where(k_idx <= q_pos, bias, NEG_INF)
+    return bias
+
+
+def _flash_fwd_pallas(q, k, v, slopes, kpos, kneg, scale, causal,
+                      block_q, block_k, interpret):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     bh, s, hd = q.shape  # (batch*heads, seq, head_dim)
     nq, nk = s // block_q, s // block_k
 
-    def kernel(slope_ref, q_ref, k_ref, v_ref, o_ref, m_sc, l_sc, acc_sc):
+    def kernel(slope_ref, q_ref, k_ref, v_ref, kpos_ref, kneg_ref,
+               o_ref, lse_ref, m_sc, l_sc, acc_sc):
         qi = pl.program_id(1)
         ki = pl.program_id(2)
 
@@ -70,15 +100,10 @@ def _flash_fwd_pallas(q, k, v, slopes, scale, causal, block_q, block_k, interpre
                 qb, kb, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32,
             ) * scale  # (BQ, BK)
-
-            k_pos = k_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-            slope = slope_ref[0]
-            s_blk = s_blk + slope * k_pos.astype(jnp.float32)
-            if causal:
-                q_pos = q_start + jax.lax.broadcasted_iota(
-                    jnp.int32, (block_q, block_k), 0
-                )
-                s_blk = jnp.where(k_pos <= q_pos, s_blk, NEG_INF)
+            s_blk = s_blk + _bias_block(
+                slope_ref[0], kpos_ref, kneg_ref,
+                q_start, k_start, block_q, block_k, causal,
+            )
 
             m_prev = m_sc[:, 0]
             m_new = jnp.maximum(m_prev, s_blk.max(axis=1))
@@ -93,11 +118,12 @@ def _flash_fwd_pallas(q, k, v, slopes, scale, causal, block_q, block_k, interpre
 
         @pl.when(ki == nk - 1)
         def _finish():
-            denom = jnp.maximum(l_sc[:, 0], 1e-30)
-            o_ref[0] = (acc_sc[:] / denom[:, None]).astype(o_ref.dtype)
+            l = jnp.maximum(l_sc[:, 0], 1e-30)
+            o_ref[0] = (acc_sc[:] / l[:, None]).astype(o_ref.dtype)
+            lse_ref[0] = m_sc[:, 0] + jnp.log(l)
 
     grid = (bh, nq, nk)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=0,
@@ -107,57 +133,256 @@ def _flash_fwd_pallas(q, k, v, slopes, scale, causal, block_q, block_k, interpre
                 pl.BlockSpec((1, block_q, hd), lambda b, i, j: (b, i, 0)),
                 pl.BlockSpec((1, block_k, hd), lambda b, i, j: (b, j, 0)),
                 pl.BlockSpec((1, block_k, hd), lambda b, i, j: (b, j, 0)),
+                pl.BlockSpec((1, block_k), lambda b, i, j: (b, j)),
+                pl.BlockSpec((1, block_k), lambda b, i, j: (b, j)),
             ],
-            out_specs=pl.BlockSpec((1, block_q, hd), lambda b, i, j: (b, i, 0)),
+            out_specs=[
+                pl.BlockSpec((1, block_q, hd), lambda b, i, j: (b, i, 0)),
+                pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+            ],
             scratch_shapes=[
                 pltpu.VMEM((block_q, 1), jnp.float32),
                 pltpu.VMEM((block_q, 1), jnp.float32),
                 pltpu.VMEM((block_q, hd), jnp.float32),
             ],
         ),
+        out_shape=[
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct((bh, s), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(slopes, q, k, v, kpos, kneg)
+    return out, lse
+
+
+def _flash_dq_pallas(q, k, v, do, lse, delta, slopes, kpos, kneg,
+                     scale, causal, block_q, block_k, interpret):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    bh, s, hd = q.shape
+    nq, nk = s // block_q, s // block_k
+
+    def kernel(slope_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+               kpos_ref, kneg_ref, dq_ref, dq_sc):
+        qi = pl.program_id(1)
+        ki = pl.program_id(2)
+
+        @pl.when(ki == 0)
+        def _init():
+            dq_sc[:] = jnp.zeros_like(dq_sc)
+
+        q_start = qi * block_q
+        k_start = ki * block_k
+
+        @pl.when(k_start <= q_start + block_q - 1 if causal else True)
+        def _compute():
+            qb = q_ref[0].astype(jnp.float32)
+            kb = k_ref[0].astype(jnp.float32)
+            vb = v_ref[0].astype(jnp.float32)
+            dob = do_ref[0].astype(jnp.float32)
+            s_blk = jax.lax.dot_general(
+                qb, kb, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * scale
+            s_blk = s_blk + _bias_block(
+                slope_ref[0], kpos_ref, kneg_ref,
+                q_start, k_start, block_q, block_k, causal,
+            )
+            p = jnp.exp(s_blk - lse_ref[0][:, None])  # (BQ, BK)
+            dp = jax.lax.dot_general(
+                dob, vb, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )  # (BQ, BK)
+            ds = p * (dp - delta_ref[0][:, None])
+            dq_sc[:] += scale * jax.lax.dot_general(
+                ds, kb, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+
+        @pl.when(ki == nk - 1)
+        def _finish():
+            dq_ref[0] = dq_sc[:].astype(dq_ref.dtype)
+
+    grid = (bh, nq, nk)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=0,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1,), lambda b, i, j: (b,), memory_space=pltpu.SMEM),
+                pl.BlockSpec((1, block_q, hd), lambda b, i, j: (b, i, 0)),
+                pl.BlockSpec((1, block_k, hd), lambda b, i, j: (b, j, 0)),
+                pl.BlockSpec((1, block_k, hd), lambda b, i, j: (b, j, 0)),
+                pl.BlockSpec((1, block_q, hd), lambda b, i, j: (b, i, 0)),
+                pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+                pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+                pl.BlockSpec((1, block_k), lambda b, i, j: (b, j)),
+                pl.BlockSpec((1, block_k), lambda b, i, j: (b, j)),
+            ],
+            out_specs=pl.BlockSpec((1, block_q, hd), lambda b, i, j: (b, i, 0)),
+            scratch_shapes=[pltpu.VMEM((block_q, hd), jnp.float32)],
+        ),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
-    )(slopes, q, k, v)
-    return out
+    )(slopes, q, k, v, do, lse, delta, kpos, kneg)
 
 
-def _xla_reference(q, k, v, slopes, scale, causal):
-    """Plain XLA attention with the same semantics (used for backward and
-    as the non-TPU fallback)."""
+def _flash_dkv_pallas(q, k, v, do, lse, delta, slopes, kpos, kneg,
+                      scale, causal, block_q, block_k, interpret):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    bh, s, hd = q.shape
+    nq, nk = s // block_q, s // block_k
+
+    def kernel(slope_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+               kpos_ref, kneg_ref, dk_ref, dv_ref, dk_sc, dv_sc):
+        kj = pl.program_id(1)
+        qi = pl.program_id(2)
+
+        @pl.when(qi == 0)
+        def _init():
+            dk_sc[:] = jnp.zeros_like(dk_sc)
+            dv_sc[:] = jnp.zeros_like(dv_sc)
+
+        q_start = qi * block_q
+        k_start = kj * block_k
+
+        @pl.when(k_start <= q_start + block_q - 1 if causal else True)
+        def _compute():
+            qb = q_ref[0].astype(jnp.float32)
+            kb = k_ref[0].astype(jnp.float32)
+            vb = v_ref[0].astype(jnp.float32)
+            dob = do_ref[0].astype(jnp.float32)
+            s_blk = jax.lax.dot_general(
+                qb, kb, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * scale
+            s_blk = s_blk + _bias_block(
+                slope_ref[0], kpos_ref, kneg_ref,
+                q_start, k_start, block_q, block_k, causal,
+            )
+            p = jnp.exp(s_blk - lse_ref[0][:, None])  # (BQ, BK)
+            dv_sc[:] += jax.lax.dot_general(
+                p, dob, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )  # P^T @ dO -> (BK, hd)
+            dp = jax.lax.dot_general(
+                dob, vb, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            ds = p * (dp - delta_ref[0][:, None])
+            dk_sc[:] += scale * jax.lax.dot_general(
+                ds, qb, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )  # dS^T @ Q -> (BK, hd)
+
+        @pl.when(qi == nq - 1)
+        def _finish():
+            dk_ref[0] = dk_sc[:].astype(dk_ref.dtype)
+            dv_ref[0] = dv_sc[:].astype(dv_ref.dtype)
+
+    grid = (bh, nk, nq)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=0,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1,), lambda b, j, i: (b,), memory_space=pltpu.SMEM),
+                pl.BlockSpec((1, block_q, hd), lambda b, j, i: (b, i, 0)),
+                pl.BlockSpec((1, block_k, hd), lambda b, j, i: (b, j, 0)),
+                pl.BlockSpec((1, block_k, hd), lambda b, j, i: (b, j, 0)),
+                pl.BlockSpec((1, block_q, hd), lambda b, j, i: (b, i, 0)),
+                pl.BlockSpec((1, block_q), lambda b, j, i: (b, i)),
+                pl.BlockSpec((1, block_q), lambda b, j, i: (b, i)),
+                pl.BlockSpec((1, block_k), lambda b, j, i: (b, j)),
+                pl.BlockSpec((1, block_k), lambda b, j, i: (b, j)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, block_k, hd), lambda b, j, i: (b, j, 0)),
+                pl.BlockSpec((1, block_k, hd), lambda b, j, i: (b, j, 0)),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((block_k, hd), jnp.float32),
+                pltpu.VMEM((block_k, hd), jnp.float32),
+            ],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct(k.shape, k.dtype),
+            jax.ShapeDtypeStruct(v.shape, v.dtype),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(slopes, q, k, v, do, lse, delta, kpos, kneg)
+
+
+def _xla_reference(q, k, v, slopes, scale, causal, kpos=None, kneg=None):
+    """Plain XLA attention with the same semantics (non-TPU fallback and
+    the reference the kernels are tested against)."""
     bh, s, hd = q.shape
     scores = jnp.einsum(
         "bqd,bkd->bqk", q.astype(jnp.float32), k.astype(jnp.float32)
     ) * scale
-    k_pos = jnp.arange(s)
-    scores = scores + slopes[:, None, None] * k_pos[None, None, :].astype(jnp.float32)
+    if kpos is None:
+        kpos = jnp.broadcast_to(jnp.arange(s, dtype=jnp.float32)[None], (bh, s))
+    if kneg is None:
+        kneg = jnp.zeros((bh, s), jnp.float32)
+    scores = scores + slopes[:, None, None] * kpos[:, None, :] + kneg[:, None, :]
     if causal:
-        keep = k_pos[None, :] <= jnp.arange(s)[:, None]
+        keep = jnp.arange(s)[None, :] <= jnp.arange(s)[:, None]
         scores = jnp.where(keep[None], scores, NEG_INF)
     p = jax.nn.softmax(scores, axis=-1)
     return jnp.einsum("bqk,bkd->bqd", p, v.astype(jnp.float32)).astype(q.dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
-def _flash(q, k, v, slopes, scale, causal, interpret):
+def _resolve_interpret(interpret):
     if interpret is None:
-        interpret = jax.default_backend() != "tpu"
-    s = q.shape[1]
-    bq, bk = _pick_block(s), _pick_block(s)
-    return _flash_fwd_pallas(q, k, v, slopes, scale, causal, bq, bk, interpret)
+        return jax.default_backend() != "tpu"
+    return interpret
 
 
-def _flash_fwd(q, k, v, slopes, scale, causal, interpret):
-    return _flash(q, k, v, slopes, scale, causal, interpret), (q, k, v, slopes)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8))
+def _flash(q, k, v, slopes, kpos, kneg, scale, causal, interpret):
+    out, _ = _flash_fwd_pallas(
+        q, k, v, slopes, kpos, kneg, scale, causal,
+        _pick_block(q.shape[1]), _pick_block(q.shape[1]),
+        _resolve_interpret(interpret),
+    )
+    return out
+
+
+def _flash_fwd(q, k, v, slopes, kpos, kneg, scale, causal, interpret):
+    out, lse = _flash_fwd_pallas(
+        q, k, v, slopes, kpos, kneg, scale, causal,
+        _pick_block(q.shape[1]), _pick_block(q.shape[1]),
+        _resolve_interpret(interpret),
+    )
+    return out, (q, k, v, slopes, kpos, kneg, out, lse)
 
 
 def _flash_bwd(scale, causal, interpret, res, g):
-    q, k, v, slopes = res
-    _, vjp = jax.vjp(lambda q, k, v: _xla_reference(q, k, v, slopes, scale, causal), q, k, v)
-    dq, dk, dv = vjp(g)
-    return dq, dk, dv, jnp.zeros_like(slopes)
+    q, k, v, slopes, kpos, kneg, out, lse = res
+    interpret = _resolve_interpret(interpret)
+    bq, bk = _pick_block(q.shape[1]), _pick_block(q.shape[1])
+    delta = (g.astype(jnp.float32) * out.astype(jnp.float32)).sum(-1)  # (bh, s)
+    dq = _flash_dq_pallas(
+        q, k, v, g, lse, delta, slopes, kpos, kneg, scale, causal, bq, bk, interpret
+    )
+    dk, dv = _flash_dkv_pallas(
+        q, k, v, g, lse, delta, slopes, kpos, kneg, scale, causal, bq, bk, interpret
+    )
+    return dq, dk, dv, jnp.zeros_like(slopes), jnp.zeros_like(kpos), jnp.zeros_like(kneg)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -168,21 +393,43 @@ def flash_attention(
     k: jax.Array,
     v: jax.Array,
     alibi_slopes: Optional[jax.Array] = None,  # (nh,)
+    attention_mask: Optional[jax.Array] = None,  # (B, S) 1=keep 0=pad
+    kv_pos: Optional[jax.Array] = None,  # (B, S) ALiBi position per key
+    kv_neg: Optional[jax.Array] = None,  # (B, S) 0 valid / NEG_INF padded
     causal: bool = True,
     scale: Optional[float] = None,
     interpret: Optional[bool] = None,
 ) -> jax.Array:
-    """BLOOM-shaped fused attention. Returns (B, S, nh, hd)."""
+    """BLOOM-shaped fused attention. Returns (B, S, nh, hd).
+
+    Padding: pass either ``attention_mask`` (positions derived with
+    BLOOM's mask-aware cumsum, matching ``models.bloom.build_alibi``) or
+    precomputed ``kv_pos``/``kv_neg`` arrays.
+    """
     b, s, nh, hd = q.shape
     if scale is None:
         scale = hd**-0.5
     if alibi_slopes is None:
         alibi_slopes = jnp.zeros((nh,), jnp.float32)
+    if attention_mask is not None and (kv_pos is None or kv_neg is None):
+        kv_pos, kv_neg = mask_to_kv_bias(attention_mask)
+    if kv_pos is None:
+        kv_pos = jnp.broadcast_to(jnp.arange(s, dtype=jnp.float32)[None], (b, s))
+    if kv_neg is None:
+        kv_neg = jnp.zeros((b, s), jnp.float32)
+
     slopes = jnp.broadcast_to(alibi_slopes[None], (b, nh)).reshape(b * nh)
 
     def flat(x):
         return x.transpose(0, 2, 1, 3).reshape(b * nh, s, hd)
 
-    out = _flash(flat(q), flat(k), flat(v), slopes.astype(jnp.float32),
-                 float(scale), causal, interpret)
+    def flat_bs(x):  # (B, S) -> (B*nh, S)
+        return jnp.broadcast_to(
+            x.astype(jnp.float32)[:, None, :], (b, nh, s)
+        ).reshape(b * nh, s)
+
+    out = _flash(
+        flat(q), flat(k), flat(v), slopes.astype(jnp.float32),
+        flat_bs(kv_pos), flat_bs(kv_neg), float(scale), causal, interpret
+    )
     return out.reshape(b, nh, s, hd).transpose(0, 2, 1, 3)
