@@ -258,22 +258,26 @@ def embed_tokens(
 
 
 def attention_bias(attention_mask: jax.Array, config: BloomConfig) -> dict:
-    """ALiBi + combined causal/padding mask bias (single source for the
-    plain and pipeline forward paths). Also carries the flash-kernel
-    form of the same information: per-key mask-aware ALiBi position
-    ``kv_pos`` and validity bias ``kv_neg`` (B, S)."""
-    from pipegoose_tpu.ops.flash_attention import mask_to_kv_bias
+    """Attention bias inputs, in the form the configured attention path
+    consumes (single source for the plain, pipeline, and 1F1B paths):
+    - flash (``config.use_flash``): O(S) per-key mask-aware ALiBi
+      position ``kv_pos`` and validity bias ``kv_neg`` — the dense
+      (B, 1, S, S) tensors are never materialized;
+    - standard: per-head ``alibi`` plus the dense causal/padding
+      ``mask_bias``."""
+    if config.use_flash:
+        from pipegoose_tpu.ops.flash_attention import mask_to_kv_bias
+
+        kv_pos, kv_neg = mask_to_kv_bias(attention_mask)
+        return {"kv_pos": kv_pos, "kv_neg": kv_neg}
 
     s = attention_mask.shape[-1]
     alibi = build_alibi(attention_mask, config.n_head)
     causal = jnp.tril(jnp.ones((s, s), dtype=bool))
     keep = causal[None, None] & (attention_mask[:, None, None, :] > 0)
-    kv_pos, kv_neg = mask_to_kv_bias(attention_mask)
     return {
         "alibi": alibi,
         "mask_bias": jnp.where(keep, 0.0, NEG_INF).astype(jnp.float32),
-        "kv_pos": kv_pos,
-        "kv_neg": kv_neg,
     }
 
 
@@ -486,6 +490,109 @@ def loss_fn_pp(
     tot, cnt = jax.vmap(head_one)(outs, mbs["ids"], mbs["mask"], mbs["labels"])
     loss_local = tot.sum() / jnp.maximum(cnt.sum(), 1)
     return last_stage_value(loss_local, pipe_axis)
+
+
+def loss_fn_1f1b(
+    params: dict,
+    input_ids: jax.Array,
+    attention_mask: Optional[jax.Array],
+    labels: jax.Array,
+    config: BloomConfig,
+    n_microbatches: int,
+    tp_axis: Optional[str] = None,
+    pipe_axis: str = "pipe",
+) -> jax.Array:
+    """Pipeline-parallel loss with the 1F1B (PipeDream-flush) runtime:
+    same semantics as :func:`loss_fn_pp` (identical loss value and
+    gradients) but peak activation memory bounded by the STAGE count
+    instead of the microbatch count — each microbatch's backward starts
+    as soon as its forward clears the last stage
+    (nn/pipeline_parallel/pipeline.py:one_f_one_b).
+
+    Implemented as a ``jax.custom_vjp`` whose forward runs the fused
+    forward+backward pipeline and stashes the parameter gradients as
+    residuals, so ``jax.value_and_grad(loss_fn_1f1b)`` plugs into
+    ``make_hybrid_train_step`` unchanged (grad_sync_axes=("pipe","sum")
+    completes the replicated embed/ln_f grads across stages, exactly as
+    for loss_fn_pp)."""
+    from functools import partial as _partial
+
+    from pipegoose_tpu.nn.pipeline_parallel import microbatch as mb
+    from pipegoose_tpu.nn.pipeline_parallel.pipeline import one_f_one_b
+
+    b, s = input_ids.shape
+    if attention_mask is None:
+        attention_mask = jnp.ones((b, s), dtype=jnp.int32)
+
+    mbs = mb.split(
+        {"ids": input_ids, "mask": attention_mask, "labels": labels}, n_microbatches
+    )
+    side = jax.vmap(lambda m: attention_bias(m, config))(mbs["mask"])
+    side = {**side, "labels": mbs["labels"], "mask": mbs["mask"]}
+
+    # per-microbatch head losses are pre-normalized by the LOCAL total
+    # token count so their plain sum equals loss_fn_pp's tot/cnt
+    inv_count = 1.0 / jnp.maximum(attention_mask[:, 1:].sum().astype(jnp.float32), 1)
+
+    block = _partial(_block, config=config, tp_axis=tp_axis)
+    if config.remat:
+        block = jax.checkpoint(block)
+
+    def stage_fn(blocks, h, side):
+        def scan_fn(carry, blk):
+            return block(blk, carry, side), None
+
+        h, _ = jax.lax.scan(scan_fn, h, blocks)
+        return h
+
+    def head_fn(hp, h, side):
+        h = layer_norm(hp["ln_f"], h, config.layer_norm_epsilon)
+        logits = logits_fn({"embed": hp["embed"]}, h, tp_axis)
+        per_tok = vocab_parallel_cross_entropy(
+            logits[:, :-1], side["labels"][:, 1:], tp_axis,
+            valid_size=config.valid_vocab_size,
+        )
+        w = side["mask"][:, 1:].astype(per_tok.dtype)
+        return ((per_tok * w).sum() * inv_count).astype(jnp.float32)
+
+    def run(params):
+        embed_params = {"embed": params["embed"], "embed_ln": params["embed_ln"]}
+        h0, embed_vjp = jax.vjp(
+            lambda ep: jax.vmap(
+                lambda ids: embed_tokens(ep, ids, config, tp_axis)
+            )(mbs["ids"]),
+            embed_params,
+        )
+        head_params = {"ln_f": params["ln_f"], "embed": params["embed"]}
+        loss_local, dh0, d_blocks, d_head = one_f_one_b(
+            stage_fn, params["blocks"], head_fn, head_params, h0, side, pipe_axis
+        )
+        (d_embed,) = embed_vjp(dh0)
+        P = jax.lax.axis_size(pipe_axis)
+        is_last = jax.lax.axis_index(pipe_axis) == P - 1
+        loss = jax.lax.psum(jnp.where(is_last, loss_local, 0.0), pipe_axis)
+        grads = {
+            "embed": {
+                "weight": d_embed["embed"]["weight"] + d_head["embed"]["weight"]
+            },
+            "embed_ln": d_embed["embed_ln"],
+            "blocks": d_blocks,
+            "ln_f": d_head["ln_f"],
+        }
+        return loss, grads
+
+    @jax.custom_vjp
+    def pipelined(params):
+        return run(params)[0]
+
+    def fwd(params):
+        return run(params)
+
+    def bwd(grads, ct):
+        return (jax.tree_util.tree_map(lambda g: (g * ct).astype(g.dtype), grads),)
+
+    pipelined.defvjp(fwd, bwd)
+    return pipelined(params)
 
 
 def pp_specs(params: dict, tp_axis: str = "tensor", pipe_axis: str = "pipe") -> dict:

@@ -31,7 +31,11 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from pipegoose_tpu.distributed.functional import reduce_from_tensor_group, shift_right
+from pipegoose_tpu.distributed.functional import (
+    reduce_from_tensor_group,
+    shift_left,
+    shift_right,
+)
 from pipegoose_tpu.nn.pipeline_parallel.scheduler import GPipeScheduler
 
 
@@ -146,6 +150,174 @@ def gpipe(
         clock_step, (template, out_buf, aux_acc0), jnp.arange(n_clock)
     )
     return (out_buf, aux_acc) if with_aux else out_buf
+
+
+def one_f_one_b(
+    stage_fn: Callable[..., Any],
+    stage_params: Any,
+    head_fn: Callable[..., jax.Array],
+    head_params: Any,
+    inputs: Any,
+    side_inputs: Any,
+    axis_name: str = "pipe",
+):
+    """1F1B (PipeDream-flush) pipeline as ONE compiled SPMD program with a
+    MANUAL interleaved backward.
+
+    GPipe + reverse-mode AD (``gpipe``) keeps every in-flight microbatch's
+    stage input alive until the backward scan replays — O(M) live
+    activations per stage. Here the backward of microbatch m starts as
+    soon as its forward returns from the last stage, so saved stage
+    inputs live in a ring of ``n_slots <= P`` slots — the 1F1B memory
+    guarantee (live activations bounded by the stage count, not the
+    microbatch count).
+
+    Mechanics:
+    - the per-stage instruction streams (``OneFOneBScheduler.timeline``)
+      are list-scheduled into static (n_clock, P) fwd/bwd timetables
+      (``one_f_one_b_tables``); one ``lax.scan`` runs the global clock;
+    - each clock, every stage executes exactly ONE of {forward,
+      backward, idle} via ``lax.switch`` on its timetable entry
+      (device-varying predicate — uniform across non-pipe axes, so
+      tensor-parallel collectives inside ``stage_fn`` stay collective-
+      safe: all tensor peers of a stage take the same branch);
+    - forward saves ONLY the stage input (ring slot ``m % n_slots``);
+      backward re-runs the stage forward inside ``jax.vjp``
+      (rematerialization) and accumulates parameter gradients;
+    - the LAST stage seeds its own backward: ``head_fn(head_params, h,
+      side) -> scalar loss contribution`` (already normalized by the
+      caller) is differentiated together with the stage, so the loss
+      gradient flows without a separate backward engine;
+    - stage-to-stage transfers are the same ``ppermute`` rings as gpipe:
+      activations down, cotangents up, one clock of latency each, with
+      in-transit values parked in ``n_slots`` rings (the timetable
+      builder PROVES slot-collision freedom).
+
+    Contract: ``stage_fn(stage_params, h, side) -> h`` exactly as in
+    ``gpipe``; ``side_inputs`` is required (M-leading pytree; carry the
+    head's labels/mask in it). Returns ``(loss_sum, d_inputs,
+    d_stage_params, d_head_params)`` where loss_sum/d_head_params are
+    valid on the LAST pipe rank (zeros elsewhere), d_inputs (M-leading)
+    on the FIRST — combine replicated-param grads with a psum over the
+    pipe axis (grad_sync_axes=("pipe", "sum")).
+
+    This runtime is callable from a non-differentiable context only (it
+    RETURNS gradients); wrap it in ``jax.custom_vjp`` for use under
+    ``jax.grad`` (see ``models.bloom.loss_fn_1f1b``).
+    """
+    from pipegoose_tpu.nn.pipeline_parallel.scheduler import one_f_one_b_tables
+
+    P = lax.axis_size(axis_name)
+    stage = lax.axis_index(axis_name)
+    M = jax.tree_util.tree_leaves(inputs)[0].shape[0]
+    fwd_np, bwd_np, n_slots, n_clock = one_f_one_b_tables(M, P)
+    fwd_tab = jnp.asarray(fwd_np)  # (n_clock, P)
+    bwd_tab = jnp.asarray(bwd_np)
+
+    tree_zeros = partial(jax.tree_util.tree_map, jnp.zeros_like)
+
+    def tree_add(a, b):
+        return jax.tree_util.tree_map(jnp.add, a, b)
+
+    def ring_like(t):
+        return jax.tree_util.tree_map(
+            lambda a: jnp.zeros((n_slots,) + a.shape, a.dtype), t
+        )
+
+    template = _tree_index(inputs, 0)
+    is_first = stage == 0
+    is_last = stage == P - 1
+
+    def lookup(tab, c, s):
+        ok = (c >= 0) & (c <= n_clock - 1) & (s >= 0) & (s <= P - 1)
+        val = tab[jnp.clip(c, 0, n_clock - 1), jnp.clip(s, 0, P - 1)]
+        return jnp.where(ok, val, -1)
+
+    def cycle(carry, c):
+        (send_h, send_g, recv_h, recv_g, acts, dh0, pgrads, hgrads, loss) = carry
+
+        # 1) receive what the neighbors sent at clock c-1; the sender's
+        # timetable entry tells us which microbatch it is
+        h_arr = jax.tree_util.tree_map(lambda a: shift_right(a, axis_name), send_h)
+        g_arr = jax.tree_util.tree_map(lambda a: shift_left(a, axis_name), send_g)
+        m_h = lookup(fwd_tab, c - 1, stage - 1)
+        recv_h = _tree_update(
+            recv_h, h_arr, jnp.clip(m_h, 0, M - 1) % n_slots, (m_h >= 0) & ~is_first
+        )
+        m_g = lookup(bwd_tab, c - 1, stage + 1)
+        recv_g = _tree_update(
+            recv_g, g_arr, jnp.clip(m_g, 0, M - 1) % n_slots, (m_g >= 0) & ~is_last
+        )
+
+        f_m = lookup(fwd_tab, c, stage)
+        b_m = lookup(bwd_tab, c, stage)
+        branch = jnp.where(f_m >= 0, 0, jnp.where(b_m >= 0, 1, 2))
+
+        def f_branch(op):
+            (send_h, send_g, recv_h, recv_g, acts, dh0, pgrads, hgrads, loss) = op
+            m = jnp.clip(f_m, 0, M - 1)
+            slot = m % n_slots
+            x0 = _tree_index(inputs, m)
+            h_in = jax.tree_util.tree_map(
+                lambda a, b: jnp.where(is_first, a, b),
+                x0, _tree_index(recv_h, slot),
+            )
+            acts = _tree_update(acts, h_in, slot, True)
+            h_out = stage_fn(stage_params, h_in, _tree_index(side_inputs, m))
+            return (h_out, send_g, recv_h, recv_g, acts, dh0, pgrads, hgrads, loss)
+
+        def b_branch(op):
+            (send_h, send_g, recv_h, recv_g, acts, dh0, pgrads, hgrads, loss) = op
+            m = jnp.clip(b_m, 0, M - 1)
+            slot = m % n_slots
+            h_in = _tree_index(acts, slot)
+            side = _tree_index(side_inputs, m)
+            g_in = _tree_index(recv_g, slot)
+
+            def last_fn(_):
+                def full(p, hp, h):
+                    return head_fn(hp, stage_fn(p, h, side), side)
+
+                loss_m, vjp = jax.vjp(full, stage_params, head_params, h_in)
+                dp, dhp, dh = vjp(jnp.ones_like(loss_m))
+                return loss_m.astype(jnp.float32), dp, dhp, dh
+
+            def mid_fn(_):
+                _, vjp = jax.vjp(
+                    lambda p, h: stage_fn(p, h, side), stage_params, h_in
+                )
+                dp, dh = vjp(g_in)
+                return jnp.zeros((), jnp.float32), dp, tree_zeros(head_params), dh
+
+            loss_m, dp, dhp, dh = lax.cond(is_last, last_fn, mid_fn, None)
+            pgrads = tree_add(pgrads, dp)
+            hgrads = tree_add(hgrads, dhp)
+            dh0 = _tree_update(dh0, dh, m, is_first)
+            return (send_h, dh, recv_h, recv_g, acts, dh0, pgrads, hgrads, loss + loss_m)
+
+        def idle(op):
+            return op
+
+        carry = lax.switch(
+            branch, [f_branch, b_branch, idle],
+            (send_h, send_g, recv_h, recv_g, acts, dh0, pgrads, hgrads, loss),
+        )
+        return carry, None
+
+    carry0 = (
+        tree_zeros(template),  # send_h
+        tree_zeros(template),  # send_g
+        ring_like(template),   # recv_h
+        ring_like(template),   # recv_g
+        ring_like(template),   # acts
+        tree_zeros(inputs),    # dh0
+        tree_zeros(stage_params),
+        tree_zeros(head_params),
+        jnp.zeros((), jnp.float32),
+    )
+    carry, _ = lax.scan(cycle, carry0, jnp.arange(n_clock))
+    (_, _, _, _, _, dh0, pgrads, hgrads, loss) = carry
+    return loss, dh0, pgrads, hgrads
 
 
 def last_stage_value(x: jax.Array, axis_name: str = "pipe") -> jax.Array:

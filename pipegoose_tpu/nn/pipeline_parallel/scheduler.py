@@ -75,6 +75,101 @@ class GPipeScheduler:
         ]
 
 
+def one_f_one_b_tables(n_microbatches: int, n_partitions: int):
+    """Compile the 1F1B per-stage instruction streams into a global
+    clock timetable for the SPMD runtime (pipeline.py:one_f_one_b).
+
+    Greedy list-scheduling of each stage's ``timeline`` under the data
+    dependencies of a compiled pipeline with one-clock transfers:
+    F(m, p) needs F(m, p-1) at an earlier clock (activation arrives the
+    clock after it was produced); B(m, p) needs B(m, p+1) earlier (for
+    the cotangent) — B(m, P-1) only needs its own F, which stream order
+    guarantees. Each stage executes at most ONE instruction per clock.
+
+    Returns ``(fwd, bwd, n_slots, n_clock)`` where ``fwd``/``bwd`` are
+    (n_clock, P) int arrays holding the microbatch index executed by
+    stage p at clock c (or -1), and ``n_slots`` is the verified ring
+    size bounding simultaneously-live saved activations / in-transit
+    values per stage (<= P + 1, the 1F1B memory guarantee).
+    """
+    import numpy as np
+
+    M, P = n_microbatches, n_partitions
+    streams = [OneFOneBScheduler(M, P).timeline(p) for p in range(P)]
+    ptrs = [0] * P
+    f_done: dict = {}
+    b_done: dict = {}
+    fwd_rows, bwd_rows = [], []
+    c = 0
+    while any(ptrs[p] < len(streams[p]) for p in range(P)):
+        fwd_row = [-1] * P
+        bwd_row = [-1] * P
+        progressed = False
+        for p in range(P):
+            if ptrs[p] >= len(streams[p]):
+                continue
+            t = streams[p][ptrs[p]]
+            m = t.microbatch_idx
+            if t.job_type == JobType.FORWARD:
+                ready = p == 0 or f_done.get((m, p - 1), c) < c
+                if ready:
+                    fwd_row[p] = m
+                    f_done[(m, p)] = c
+                    ptrs[p] += 1
+                    progressed = True
+            else:
+                ready = (p == P - 1) or b_done.get((m, p + 1), c) < c
+                if ready:
+                    bwd_row[p] = m
+                    b_done[(m, p)] = c
+                    ptrs[p] += 1
+                    progressed = True
+        assert progressed, f"1F1B schedule deadlocked at clock {c} (M={M}, P={P})"
+        fwd_rows.append(fwd_row)
+        bwd_rows.append(bwd_row)
+        c += 1
+
+    # verify the ring bound: three per-stage buffer families, each keyed
+    # by microbatch and indexed m % n_slots —
+    #   act:    saved stage input, live [F(m,p), B(m,p)]
+    #   recv_h: in-transit activation, live [F(m,p-1)+1, F(m,p)]
+    #   recv_g: in-transit cotangent, live [B(m,p+1)+1, B(m,p)]
+    span_families = []
+    for p in range(P):
+        span_families.append([(f_done[(m, p)], b_done[(m, p)]) for m in range(M)])
+        if p > 0:
+            span_families.append(
+                [(f_done[(m, p - 1)] + 1, f_done[(m, p)]) for m in range(M)]
+            )
+        if p < P - 1:
+            span_families.append(
+                [(b_done[(m, p + 1)] + 1, b_done[(m, p)]) for m in range(M)]
+            )
+
+    def max_overlap(spans):
+        return max(
+            sum(1 for s2, e2 in spans if s2 <= s <= e2) for s, e in spans
+        )
+
+    n_slots = min(M, max(max_overlap(sp) for sp in span_families))
+    for spans in span_families:
+        for m1 in range(M):
+            for m2 in range(m1 + 1, M):
+                if m1 % n_slots == m2 % n_slots:
+                    s1, e1 = spans[m1]
+                    s2, e2 = spans[m2]
+                    assert e1 < s2 or e2 < s1, (
+                        f"ring collision: microbatches {m1},{m2} share a slot "
+                        f"(n_slots={n_slots}, spans {spans[m1]} vs {spans[m2]})"
+                    )
+    return (
+        np.asarray(fwd_rows, np.int32),
+        np.asarray(bwd_rows, np.int32),
+        n_slots,
+        c,
+    )
+
+
 class OneFOneBScheduler(GPipeScheduler):
     """1F1B (PipeDream-flush) ordering: same total clocks, but each
     stage starts its backward as soon as its first microbatch returns,

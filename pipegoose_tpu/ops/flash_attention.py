@@ -412,7 +412,11 @@ def flash_attention(
     if alibi_slopes is None:
         alibi_slopes = jnp.zeros((nh,), jnp.float32)
     if attention_mask is not None and (kv_pos is None or kv_neg is None):
-        kv_pos, kv_neg = mask_to_kv_bias(attention_mask)
+        # fill only what the caller did not provide (a custom kv_pos may
+        # legitimately accompany a mask, e.g. offset decode positions)
+        pos, neg = mask_to_kv_bias(attention_mask)
+        kv_pos = pos if kv_pos is None else kv_pos
+        kv_neg = neg if kv_neg is None else kv_neg
     if kv_pos is None:
         kv_pos = jnp.broadcast_to(jnp.arange(s, dtype=jnp.float32)[None], (b, s))
     if kv_neg is None:

@@ -1,0 +1,184 @@
+"""1F1B compiled runtime: timetable properties, loss/grad equivalence
+with the GPipe path, and the activation-memory bound it exists for.
+
+The reference's backward schedule is a naive reversed-forward
+(scheduler.py:82-94, SURVEY.md §7 quirks); its engine never interleaves.
+Here 1F1B runs as one compiled program (pipeline.py:one_f_one_b)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from pipegoose_tpu.distributed import ParallelContext
+from pipegoose_tpu.models import bloom
+from pipegoose_tpu.nn.pipeline_parallel.scheduler import one_f_one_b_tables
+
+try:
+    from jax import shard_map
+except ImportError:
+    from jax.experimental.shard_map import shard_map
+
+
+@pytest.mark.parametrize("M,Pp", [(4, 2), (8, 2), (8, 4), (4, 4), (1, 2), (6, 3)])
+def test_tables_properties(M, Pp):
+    fwd, bwd, n_slots, T = one_f_one_b_tables(M, Pp)
+    assert fwd.shape == bwd.shape == (T, Pp)
+    # every (m, p) executes exactly once in each direction
+    for p in range(Pp):
+        assert sorted(m for m in fwd[:, p] if m >= 0) == list(range(M))
+        assert sorted(m for m in bwd[:, p] if m >= 0) == list(range(M))
+    f_at = {(m, p): c for c in range(T) for p in range(Pp) for m in [fwd[c, p]] if m >= 0}
+    b_at = {(m, p): c for c in range(T) for p in range(Pp) for m in [bwd[c, p]] if m >= 0}
+    for m in range(M):
+        for p in range(Pp):
+            if p > 0:  # activation must arrive (1-clock transfer)
+                assert f_at[(m, p)] > f_at[(m, p - 1)]
+            if p < Pp - 1:  # cotangent must arrive
+                assert b_at[(m, p)] > b_at[(m, p + 1)]
+            assert b_at[(m, p)] > f_at[(m, p)]
+    # the memory guarantee: ring bounded by the stage count
+    assert n_slots <= min(M, Pp + 1)
+    # total clocks: 2M per stage + fill/drain
+    assert T == 2 * M + 2 * (Pp - 1)
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = bloom.BloomConfig(vocab_size=128, hidden_size=64, n_layer=4, n_head=4)
+    params = bloom.init_params(cfg, jax.random.PRNGKey(0))
+    ids = jnp.asarray(np.random.RandomState(3).randint(0, cfg.vocab_size, (8, 12)))
+    mask = np.ones((8, 12), np.int32)
+    mask[0, 9:] = 0  # exercise padding through the pipeline
+    return cfg, params, ids, jnp.asarray(mask)
+
+
+@pytest.mark.parametrize("tp,pp,M", [(1, 4, 4), (2, 2, 4), (1, 2, 8)])
+def test_matches_gpipe_loss_and_grads(setup, devices, tp, pp, M):
+    """value_and_grad(loss_fn_1f1b) == value_and_grad(loss_fn_pp) on the
+    same mesh: identical loss, identical gradients on every rank."""
+    cfg, params, ids, mask = setup
+    dp = 8 // (tp * pp)
+    kw = dict(tensor_parallel_size=tp, pipeline_parallel_size=pp,
+              data_parallel_size=dp)
+    ctx = ParallelContext(**kw)
+    try:
+        specs = bloom.pp_specs(params)
+        tp_axis = "tensor" if tp > 1 else None
+
+        def run(loss_fn):
+            f = jax.jit(
+                shard_map(
+                    jax.value_and_grad(
+                        lambda p, i, m: loss_fn(
+                            p, i, m, i, cfg, M, tp_axis=tp_axis, pipe_axis="pipe"
+                        )
+                    ),
+                    mesh=ctx.mesh,
+                    in_specs=(specs, P(), P()),
+                    out_specs=(P(), specs),
+                    check_vma=False,
+                )
+            )
+            return f(params, ids, mask)
+
+        loss_ref, g_ref = run(bloom.loss_fn_pp)
+        loss_new, g_new = run(bloom.loss_fn_1f1b)
+        np.testing.assert_allclose(float(loss_new), float(loss_ref), rtol=1e-5)
+        for (path, a), b in zip(
+            jax.tree_util.tree_leaves_with_path(g_ref),
+            jax.tree_util.tree_leaves(g_new),
+        ):
+            np.testing.assert_allclose(
+                np.asarray(b), np.asarray(a), rtol=1e-4, atol=1e-5, err_msg=str(path)
+            )
+    finally:
+        ctx.destroy()
+
+
+def test_training_matches_gpipe(setup, devices):
+    """Full hybrid train steps with the 1F1B loss track the GPipe loss."""
+    import optax
+
+    from pipegoose_tpu.optim.zero import DistributedOptimizer
+    from pipegoose_tpu.parallel import make_hybrid_train_step
+
+    cfg, params, ids, mask = setup
+    results = {}
+    for name, loss in [("gpipe", bloom.loss_fn_pp), ("1f1b", bloom.loss_fn_1f1b)]:
+        ctx = ParallelContext(
+            tensor_parallel_size=2, pipeline_parallel_size=2, data_parallel_size=2
+        )
+        try:
+            specs = bloom.pp_specs(params)
+            zopt = DistributedOptimizer(optax.adam(1e-3), axis_name="data")
+
+            def loss_fn(p, i, loss=loss):
+                return loss(p, i, None, i, cfg, 4, tp_axis="tensor", pipe_axis="pipe")
+
+            init_fn, make_step = make_hybrid_train_step(
+                loss_fn, specs, zopt, ctx, grad_sync_axes=("pipe",)
+            )
+            # step donates its param/state buffers — give each run its own
+            p = jax.tree_util.tree_map(jnp.copy, params)
+            opt_state = init_fn(p)
+            step = make_step(p)
+            losses = []
+            for _ in range(3):
+                p, opt_state, l = step(p, opt_state, ids)
+                losses.append(float(l))
+            results[name] = (losses, p)
+        finally:
+            ctx.destroy()
+
+    np.testing.assert_allclose(results["1f1b"][0], results["gpipe"][0], rtol=1e-4)
+    for (path, a), b in zip(
+        jax.tree_util.tree_leaves_with_path(results["gpipe"][1]),
+        jax.tree_util.tree_leaves(results["1f1b"][1]),
+    ):
+        np.testing.assert_allclose(
+            np.asarray(b), np.asarray(a), rtol=1e-3, atol=1e-4, err_msg=str(path)
+        )
+
+
+def test_activation_memory_bound(devices):
+    """Compiled peak temp memory of the 1F1B grad step is well below
+    GPipe's at the same FIXED total batch — GPipe + AD keeps every
+    microbatch's stage state live until the backward replay, 1F1B frees
+    each microbatch as its backward completes (ring of <= P slots).
+    Measured via XLA's compiled memory analysis (observed ~0.45-0.66x
+    across M on this config)."""
+    cfg = bloom.BloomConfig(
+        vocab_size=64, hidden_size=64, n_layer=4, n_head=4, remat=True
+    )
+    params = bloom.init_params(cfg, jax.random.PRNGKey(0))
+    pp = 2
+    ids = jnp.asarray(np.random.RandomState(0).randint(0, 64, (16, 64)))
+
+    def temp_bytes(loss_fn, M):
+        ctx = ParallelContext(pipeline_parallel_size=pp, data_parallel_size=4)
+        try:
+            specs = bloom.pp_specs(params)
+            f = jax.jit(
+                shard_map(
+                    jax.value_and_grad(
+                        lambda p, i: loss_fn(p, i, None, i, cfg, M, pipe_axis="pipe")
+                    ),
+                    mesh=ctx.mesh,
+                    in_specs=(specs, P()),
+                    out_specs=(P(), specs),
+                    check_vma=False,
+                )
+            )
+            compiled = f.lower(params, ids).compile()
+            mem = compiled.memory_analysis()
+            if mem is None:
+                pytest.skip("backend reports no memory analysis")
+            return mem.temp_size_in_bytes
+        finally:
+            ctx.destroy()
+
+    for M in (2 * pp, 8 * pp):
+        g = temp_bytes(bloom.loss_fn_pp, M)
+        f = temp_bytes(bloom.loss_fn_1f1b, M)
+        assert f < 0.8 * g, (M, f, g)
