@@ -1,6 +1,11 @@
-from pipegoose_tpu.models import bloom, bloom_moe, mixtral
+from pipegoose_tpu.models import bloom, bloom_moe, llama, mixtral
 from pipegoose_tpu.models.bloom import BloomConfig
 from pipegoose_tpu.models.bloom_moe import BloomMoEConfig
+from pipegoose_tpu.models.convert import from_hf
+from pipegoose_tpu.models.llama import LlamaConfig
 from pipegoose_tpu.models.mixtral import MixtralConfig
 
-__all__ = ["bloom", "bloom_moe", "mixtral", "BloomConfig", "BloomMoEConfig", "MixtralConfig"]
+__all__ = [
+    "bloom", "bloom_moe", "llama", "mixtral", "from_hf",
+    "BloomConfig", "BloomMoEConfig", "LlamaConfig", "MixtralConfig",
+]

@@ -1,0 +1,140 @@
+"""Policy-table-driven HF checkpoint ingestion.
+
+The reference parallelizes *any* HF model whose modules appear in its
+per-model policy registry (``__MAPPING__`` tables,
+reference nn/tensor_parallel/parallel_mapping.py:16-52, consumed by
+module surgery in tensor_parallel.py:44-69). The TPU-native equivalent
+is declarative: each model family ships a RULES table mapping HF state-
+dict names to stacked-pytree paths, and this module executes it — one
+generic converter instead of a hand-written function per family.
+
+Rule format (one dict per target leaf):
+  path:      pytree path, "/"-separated ("blocks/attn/q/kernel")
+  hf:        HF state-dict name; "{l}" = layer index, "{e}" = expert
+             index (presence of the placeholders decides stacking)
+  transpose: torch Linear stores (out, in); JAX kernels are (in, out)
+  optional:  skip silently if the HF tensor is absent (e.g. untied
+             lm_head on a tied checkpoint)
+
+``register_family`` + ``from_hf`` give the reference's top-level UX —
+hand over any supported HF model, get (config, params, module) back.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def _t(x) -> np.ndarray:
+    x = x.detach().cpu()
+    if str(x.dtype) == "torch.bfloat16":  # torch bf16 has no .numpy()
+        x = x.float()
+    return np.asarray(x.numpy())
+
+
+def _set_in(tree: dict, path: list, value) -> None:
+    for k in path[:-1]:
+        tree = tree.setdefault(k, {})
+    tree[path[-1]] = value
+
+
+def params_from_state_dict(
+    sd: dict,
+    rules: list,
+    n_layer: int,
+    n_experts: int = 0,
+    dtype=jnp.float32,
+    prefix: str = "",
+) -> dict:
+    """Execute a RULES table against an HF state dict -> stacked pytree."""
+    out: dict = {}
+    for rule in rules:
+        hf = prefix + rule["hf"]
+        tr = rule.get("transpose", False)
+
+        def get(name):
+            m = _t(sd[name])
+            return m.T if tr else m
+
+        try:
+            if "{e}" in hf:
+                arr = np.stack(
+                    [
+                        np.stack([get(hf.format(l=l, e=e)) for e in range(n_experts)])
+                        for l in range(n_layer)
+                    ]
+                )
+            elif "{l}" in hf:
+                arr = np.stack([get(hf.format(l=l)) for l in range(n_layer)])
+            else:
+                arr = get(hf)
+        except KeyError:
+            if rule.get("optional"):
+                continue
+            raise
+        _set_in(out, rule["path"].split("/"), jnp.asarray(arr, dtype=dtype))
+    return out
+
+
+def state_dict_from_params(params: dict, rules: list, prefix: str = "") -> dict:
+    """Inverse conversion: stacked pytree -> HF-named numpy state dict."""
+
+    def get_in(tree, path):
+        for k in path:
+            if k not in tree:
+                return None
+            tree = tree[k]
+        return tree
+
+    out = {}
+    for rule in rules:
+        leaf = get_in(params, rule["path"].split("/"))
+        if leaf is None:
+            if rule.get("optional"):
+                continue
+            raise KeyError(rule["path"])
+        arr = np.asarray(leaf)
+        tr = rule.get("transpose", False)
+        hf = prefix + rule["hf"]
+        if "{e}" in hf:
+            for l in range(arr.shape[0]):
+                for e in range(arr.shape[1]):
+                    m = arr[l, e]
+                    out[hf.format(l=l, e=e)] = m.T if tr else m
+        elif "{l}" in hf:
+            for l in range(arr.shape[0]):
+                m = arr[l]
+                out[hf.format(l=l)] = m.T if tr else m
+        else:
+            out[hf] = arr.T if tr else arr
+    return out
+
+
+# -- family registry ---------------------------------------------------------
+
+_FAMILIES: dict = {}
+
+
+def register_family(model_type: str, loader: Callable) -> None:
+    """loader(hf_model, dtype) -> (config, params, module)."""
+    _FAMILIES[model_type] = loader
+
+
+def from_hf(model: Any, dtype=jnp.float32):
+    """Convert any registered HF model: returns (config, params, module)
+    where ``module`` is the framework model module (forward/loss_fn/
+    specs/generate live there). The reference's equivalent is
+    ``TensorParallel(model, ...).parallelize()`` over its mapping
+    registry — here conversion is explicit and happens once."""
+    # import for registration side effects
+    from pipegoose_tpu.models import hf as _hf  # noqa: F401
+
+    mt = getattr(model.config, "model_type", None)
+    if mt not in _FAMILIES:
+        raise NotImplementedError(
+            f"model_type={mt!r} has no registered family "
+            f"(supported: {sorted(_FAMILIES)})"
+        )
+    return _FAMILIES[mt](model, dtype)

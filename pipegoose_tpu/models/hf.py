@@ -5,6 +5,12 @@ The reference consumes HF torch models directly and mutates them
 framework's stacked-pytree layout. Torch is only imported inside these
 functions — the training path never touches it.
 
+Each family is a declarative RULES table executed by the generic
+converter (models/convert.py) — the checkpoint-side half of the policy
+registry the reference keeps in its per-model ``__MAPPING__`` tables
+(reference nn/tensor_parallel/parallel_mapping.py:16-52). Three
+families are registered: bloom, mixtral, llama.
+
 Layout notes:
 - torch Linear stores (out, in); JAX kernels are (in, out) -> transpose.
 - per-layer tensors are stacked on a leading n_layer axis (models/bloom.py).
@@ -16,16 +22,40 @@ from __future__ import annotations
 from typing import Any
 
 import jax.numpy as jnp
-import numpy as np
 
 from pipegoose_tpu.models.bloom import BloomConfig
+from pipegoose_tpu.models.convert import (
+    params_from_state_dict,
+    register_family,
+    state_dict_from_params,
+)
 
+# -- BLOOM ------------------------------------------------------------------
 
-def _t(x) -> np.ndarray:
-    x = x.detach().cpu()
-    if str(x.dtype) == "torch.bfloat16":  # torch bf16 has no .numpy()
-        x = x.float()
-    return np.asarray(x.numpy())
+BLOOM_RULES = [
+    {"path": "embed/weight", "hf": "word_embeddings.weight"},
+    {"path": "embed_ln/scale", "hf": "word_embeddings_layernorm.weight"},
+    {"path": "embed_ln/bias", "hf": "word_embeddings_layernorm.bias"},
+    {"path": "blocks/ln_1/scale", "hf": "h.{l}.input_layernorm.weight"},
+    {"path": "blocks/ln_1/bias", "hf": "h.{l}.input_layernorm.bias"},
+    {"path": "blocks/attn/qkv/kernel",
+     "hf": "h.{l}.self_attention.query_key_value.weight", "transpose": True},
+    {"path": "blocks/attn/qkv/bias",
+     "hf": "h.{l}.self_attention.query_key_value.bias"},
+    {"path": "blocks/attn/out/kernel",
+     "hf": "h.{l}.self_attention.dense.weight", "transpose": True},
+    {"path": "blocks/attn/out/bias", "hf": "h.{l}.self_attention.dense.bias"},
+    {"path": "blocks/ln_2/scale", "hf": "h.{l}.post_attention_layernorm.weight"},
+    {"path": "blocks/ln_2/bias", "hf": "h.{l}.post_attention_layernorm.bias"},
+    {"path": "blocks/mlp/up/kernel",
+     "hf": "h.{l}.mlp.dense_h_to_4h.weight", "transpose": True},
+    {"path": "blocks/mlp/up/bias", "hf": "h.{l}.mlp.dense_h_to_4h.bias"},
+    {"path": "blocks/mlp/down/kernel",
+     "hf": "h.{l}.mlp.dense_4h_to_h.weight", "transpose": True},
+    {"path": "blocks/mlp/down/bias", "hf": "h.{l}.mlp.dense_4h_to_h.bias"},
+    {"path": "ln_f/scale", "hf": "ln_f.weight"},
+    {"path": "ln_f/bias", "hf": "ln_f.bias"},
+]
 
 
 def bloom_config_from_hf(hf_config, **overrides) -> BloomConfig:
@@ -50,106 +80,53 @@ def bloom_params_from_hf(model: Any, dtype=jnp.float32) -> tuple[BloomConfig, di
     stacked params pytree. The lm_head is tied to the embedding in BLOOM,
     so only the embedding table is stored (reference LMHeadParallelizer
     tied-weight handling, parallelizer.py:205-211)."""
-    sd = {k: v for k, v in model.state_dict().items()}
+    sd = dict(model.state_dict())
     prefix = "transformer." if any(k.startswith("transformer.") for k in sd) else ""
     cfg = bloom_config_from_hf(model.config, dtype=dtype)
-    L = cfg.n_layer
-
-    def get(name):
-        return _t(sd[prefix + name])
-
-    def stack(fmt, transpose=False):
-        mats = [get(fmt.format(i)) for i in range(L)]
-        if transpose:
-            mats = [m.T for m in mats]
-        return jnp.asarray(np.stack(mats), dtype=dtype)
-
-    params = {
-        "embed": {"weight": jnp.asarray(get("word_embeddings.weight"), dtype=dtype)},
-        "embed_ln": {
-            "scale": jnp.asarray(get("word_embeddings_layernorm.weight"), dtype=dtype),
-            "bias": jnp.asarray(get("word_embeddings_layernorm.bias"), dtype=dtype),
-        },
-        "blocks": {
-            "ln_1": {
-                "scale": stack("h.{}.input_layernorm.weight"),
-                "bias": stack("h.{}.input_layernorm.bias"),
-            },
-            "attn": {
-                "qkv": {
-                    "kernel": stack("h.{}.self_attention.query_key_value.weight", transpose=True),
-                    "bias": stack("h.{}.self_attention.query_key_value.bias"),
-                },
-                "out": {
-                    "kernel": stack("h.{}.self_attention.dense.weight", transpose=True),
-                    "bias": stack("h.{}.self_attention.dense.bias"),
-                },
-            },
-            "ln_2": {
-                "scale": stack("h.{}.post_attention_layernorm.weight"),
-                "bias": stack("h.{}.post_attention_layernorm.bias"),
-            },
-            "mlp": {
-                "up": {
-                    "kernel": stack("h.{}.mlp.dense_h_to_4h.weight", transpose=True),
-                    "bias": stack("h.{}.mlp.dense_h_to_4h.bias"),
-                },
-                "down": {
-                    "kernel": stack("h.{}.mlp.dense_4h_to_h.weight", transpose=True),
-                    "bias": stack("h.{}.mlp.dense_4h_to_h.bias"),
-                },
-            },
-        },
-        "ln_f": {
-            "scale": jnp.asarray(get("ln_f.weight"), dtype=dtype),
-            "bias": jnp.asarray(get("ln_f.bias"), dtype=dtype),
-        },
-    }
+    params = params_from_state_dict(
+        sd, BLOOM_RULES, cfg.n_layer, dtype=dtype, prefix=prefix
+    )
     return cfg, params
 
 
 def bloom_params_to_hf_state_dict(params: dict) -> dict:
     """Inverse conversion, for exporting back to HF format (numpy arrays
     keyed by HF names; caller wraps in torch tensors if needed)."""
-    out = {}
-    out["transformer.word_embeddings.weight"] = np.asarray(params["embed"]["weight"])
-    out["transformer.word_embeddings_layernorm.weight"] = np.asarray(
-        params["embed_ln"]["scale"]
-    )
-    out["transformer.word_embeddings_layernorm.bias"] = np.asarray(
-        params["embed_ln"]["bias"]
-    )
-    blocks = params["blocks"]
-    L = np.asarray(blocks["ln_1"]["scale"]).shape[0]
-    for i in range(L):
-        p = f"transformer.h.{i}."
-        out[p + "input_layernorm.weight"] = np.asarray(blocks["ln_1"]["scale"][i])
-        out[p + "input_layernorm.bias"] = np.asarray(blocks["ln_1"]["bias"][i])
-        out[p + "self_attention.query_key_value.weight"] = np.asarray(
-            blocks["attn"]["qkv"]["kernel"][i]
-        ).T
-        out[p + "self_attention.query_key_value.bias"] = np.asarray(
-            blocks["attn"]["qkv"]["bias"][i]
-        )
-        out[p + "self_attention.dense.weight"] = np.asarray(
-            blocks["attn"]["out"]["kernel"][i]
-        ).T
-        out[p + "self_attention.dense.bias"] = np.asarray(blocks["attn"]["out"]["bias"][i])
-        out[p + "post_attention_layernorm.weight"] = np.asarray(blocks["ln_2"]["scale"][i])
-        out[p + "post_attention_layernorm.bias"] = np.asarray(blocks["ln_2"]["bias"][i])
-        out[p + "mlp.dense_h_to_4h.weight"] = np.asarray(blocks["mlp"]["up"]["kernel"][i]).T
-        out[p + "mlp.dense_h_to_4h.bias"] = np.asarray(blocks["mlp"]["up"]["bias"][i])
-        out[p + "mlp.dense_4h_to_h.weight"] = np.asarray(
-            blocks["mlp"]["down"]["kernel"][i]
-        ).T
-        out[p + "mlp.dense_4h_to_h.bias"] = np.asarray(blocks["mlp"]["down"]["bias"][i])
-    out["transformer.ln_f.weight"] = np.asarray(params["ln_f"]["scale"])
-    out["transformer.ln_f.bias"] = np.asarray(params["ln_f"]["bias"])
+    out = state_dict_from_params(params, BLOOM_RULES, prefix="transformer.")
     out["lm_head.weight"] = out["transformer.word_embeddings.weight"]
     return out
 
 
 # -- Mixtral ----------------------------------------------------------------
+
+MIXTRAL_RULES = [
+    {"path": "embed/weight", "hf": "model.embed_tokens.weight"},
+    {"path": "blocks/ln_1/scale", "hf": "model.layers.{l}.input_layernorm.weight"},
+    {"path": "blocks/attn/q/kernel",
+     "hf": "model.layers.{l}.self_attn.q_proj.weight", "transpose": True},
+    {"path": "blocks/attn/k/kernel",
+     "hf": "model.layers.{l}.self_attn.k_proj.weight", "transpose": True},
+    {"path": "blocks/attn/v/kernel",
+     "hf": "model.layers.{l}.self_attn.v_proj.weight", "transpose": True},
+    {"path": "blocks/attn/o/kernel",
+     "hf": "model.layers.{l}.self_attn.o_proj.weight", "transpose": True},
+    {"path": "blocks/ln_2/scale",
+     "hf": "model.layers.{l}.post_attention_layernorm.weight"},
+    {"path": "blocks/router/gate/kernel",
+     "hf": "model.layers.{l}.block_sparse_moe.gate.weight", "transpose": True},
+    {"path": "blocks/moe/w1/kernel",
+     "hf": "model.layers.{l}.block_sparse_moe.experts.{e}.w1.weight",
+     "transpose": True},
+    {"path": "blocks/moe/w3/kernel",
+     "hf": "model.layers.{l}.block_sparse_moe.experts.{e}.w3.weight",
+     "transpose": True},
+    {"path": "blocks/moe/w2/kernel",
+     "hf": "model.layers.{l}.block_sparse_moe.experts.{e}.w2.weight",
+     "transpose": True},
+    {"path": "ln_f/scale", "hf": "model.norm.weight"},
+    {"path": "lm_head/kernel", "hf": "lm_head.weight", "transpose": True},
+]
+
 
 def mixtral_config_from_hf(hf_config, **overrides):
     from pipegoose_tpu.models.mixtral import MixtralConfig
@@ -168,7 +145,8 @@ def mixtral_config_from_hf(hf_config, **overrides):
         rope_theta=hf_config.rope_theta,
         rms_eps=hf_config.rms_norm_eps,
         router_jitter=getattr(hf_config, "router_jitter_noise", 0.0) or 0.0,
-        aux_loss_weight=getattr(hf_config, "router_aux_loss_coef", 0.02),
+        # 0.001 is MixtralConfig's documented router_aux_loss_coef default
+        aux_loss_weight=getattr(hf_config, "router_aux_loss_coef", 0.001),
         **overrides,
     )
 
@@ -176,52 +154,109 @@ def mixtral_config_from_hf(hf_config, **overrides):
 def mixtral_params_from_hf(model: Any, dtype=jnp.float32) -> tuple:
     """Convert HF ``MixtralForCausalLM`` to the stacked pytree (experts
     gathered into (L, E, in, out) stacks)."""
-    sd = model.state_dict()
     cfg = mixtral_config_from_hf(model.config, dtype=dtype)
-    L, E = cfg.n_layer, cfg.num_experts
-
-    def get(name):
-        return _t(sd[name])
-
-    def stack(fmt, transpose=True):
-        mats = [get(fmt.format(i)) for i in range(L)]
-        if transpose:
-            mats = [m.T for m in mats]
-        return jnp.asarray(np.stack(mats), dtype=dtype)
-
-    def stack_experts(fmt):
-        # (L, E, in, out), torch stores (out, in)
-        return jnp.asarray(
-            np.stack(
-                [np.stack([get(fmt.format(i, e)).T for e in range(E)]) for i in range(L)]
-            ),
-            dtype=dtype,
-        )
-
-    pre = "model."
-    params = {
-        "embed": {"weight": jnp.asarray(get(pre + "embed_tokens.weight"), dtype=dtype)},
-        "blocks": {
-            "ln_1": {"scale": stack(pre + "layers.{}.input_layernorm.weight", transpose=False)},
-            "attn": {
-                "q": {"kernel": stack(pre + "layers.{}.self_attn.q_proj.weight")},
-                "k": {"kernel": stack(pre + "layers.{}.self_attn.k_proj.weight")},
-                "v": {"kernel": stack(pre + "layers.{}.self_attn.v_proj.weight")},
-                "o": {"kernel": stack(pre + "layers.{}.self_attn.o_proj.weight")},
-            },
-            "ln_2": {
-                "scale": stack(pre + "layers.{}.post_attention_layernorm.weight", transpose=False)
-            },
-            "router": {
-                "gate": {"kernel": stack(pre + "layers.{}.block_sparse_moe.gate.weight")}
-            },
-            "moe": {
-                "w1": {"kernel": stack_experts(pre + "layers.{}.block_sparse_moe.experts.{}.w1.weight")},
-                "w3": {"kernel": stack_experts(pre + "layers.{}.block_sparse_moe.experts.{}.w3.weight")},
-                "w2": {"kernel": stack_experts(pre + "layers.{}.block_sparse_moe.experts.{}.w2.weight")},
-            },
-        },
-        "ln_f": {"scale": jnp.asarray(get(pre + "norm.weight"), dtype=dtype)},
-        "lm_head": {"kernel": jnp.asarray(get("lm_head.weight").T, dtype=dtype)},
-    }
+    params = params_from_state_dict(
+        dict(model.state_dict()), MIXTRAL_RULES, cfg.n_layer,
+        n_experts=cfg.num_experts, dtype=dtype,
+    )
     return cfg, params
+
+
+# -- Llama ------------------------------------------------------------------
+
+LLAMA_RULES = [
+    {"path": "embed/weight", "hf": "model.embed_tokens.weight"},
+    {"path": "blocks/ln_1/scale", "hf": "model.layers.{l}.input_layernorm.weight"},
+    {"path": "blocks/attn/q/kernel",
+     "hf": "model.layers.{l}.self_attn.q_proj.weight", "transpose": True},
+    {"path": "blocks/attn/k/kernel",
+     "hf": "model.layers.{l}.self_attn.k_proj.weight", "transpose": True},
+    {"path": "blocks/attn/v/kernel",
+     "hf": "model.layers.{l}.self_attn.v_proj.weight", "transpose": True},
+    {"path": "blocks/attn/o/kernel",
+     "hf": "model.layers.{l}.self_attn.o_proj.weight", "transpose": True},
+    {"path": "blocks/ln_2/scale",
+     "hf": "model.layers.{l}.post_attention_layernorm.weight"},
+    {"path": "blocks/mlp/gate/kernel",
+     "hf": "model.layers.{l}.mlp.gate_proj.weight", "transpose": True},
+    {"path": "blocks/mlp/up/kernel",
+     "hf": "model.layers.{l}.mlp.up_proj.weight", "transpose": True},
+    {"path": "blocks/mlp/down/kernel",
+     "hf": "model.layers.{l}.mlp.down_proj.weight", "transpose": True},
+    {"path": "ln_f/scale", "hf": "model.norm.weight"},
+    {"path": "lm_head/kernel", "hf": "lm_head.weight", "transpose": True,
+     "optional": True},  # absent on tied checkpoints
+]
+
+
+def llama_config_from_hf(hf_config, **overrides):
+    from pipegoose_tpu.models.llama import LlamaConfig
+
+    if getattr(hf_config, "rope_scaling", None):
+        raise NotImplementedError("rope_scaling checkpoints not supported yet")
+    if getattr(hf_config, "attention_bias", False):
+        raise NotImplementedError("attention_bias=True checkpoints not supported")
+    derived_hd = hf_config.hidden_size // hf_config.num_attention_heads
+    if getattr(hf_config, "head_dim", None) not in (None, derived_hd):
+        raise NotImplementedError(
+            f"explicit head_dim={hf_config.head_dim} != "
+            f"hidden_size/num_attention_heads={derived_hd} not supported"
+        )
+    return LlamaConfig(
+        vocab_size=hf_config.vocab_size,
+        hidden_size=hf_config.hidden_size,
+        intermediate_size=hf_config.intermediate_size,
+        n_layer=hf_config.num_hidden_layers,
+        n_head=hf_config.num_attention_heads,
+        n_kv_head=hf_config.num_key_value_heads,
+        rope_theta=getattr(hf_config, "rope_theta", 1e4),
+        rms_eps=hf_config.rms_norm_eps,
+        tie_word_embeddings=getattr(hf_config, "tie_word_embeddings", False),
+        **overrides,
+    )
+
+
+def llama_params_from_hf(model: Any, dtype=jnp.float32) -> tuple:
+    """Convert HF ``LlamaForCausalLM`` to the stacked pytree."""
+    cfg = llama_config_from_hf(model.config, dtype=dtype)
+    params = params_from_state_dict(
+        dict(model.state_dict()), LLAMA_RULES, cfg.n_layer, dtype=dtype
+    )
+    if cfg.tie_word_embeddings:
+        params.pop("lm_head", None)
+    return cfg, params
+
+
+# -- family registry --------------------------------------------------------
+
+def _load_bloom(model, dtype):
+    from pipegoose_tpu.models import bloom as module
+
+    cfg, params = bloom_params_from_hf(model, dtype)
+    return cfg, params, module
+
+
+def _load_mixtral(model, dtype):
+    from pipegoose_tpu.models import mixtral as module
+
+    cfg, params = mixtral_params_from_hf(model, dtype)
+    return cfg, params, module
+
+
+def _load_llama(model, dtype):
+    from pipegoose_tpu.models import llama as module
+
+    cfg, params = llama_params_from_hf(model, dtype)
+    return cfg, params, module
+
+
+register_family("bloom", _load_bloom)
+register_family("mixtral", _load_mixtral)
+register_family("llama", _load_llama)
+
+__all__ = [
+    "bloom_config_from_hf", "bloom_params_from_hf", "bloom_params_to_hf_state_dict",
+    "mixtral_config_from_hf", "mixtral_params_from_hf",
+    "llama_config_from_hf", "llama_params_from_hf",
+    "BLOOM_RULES", "MIXTRAL_RULES", "LLAMA_RULES",
+]

@@ -150,6 +150,16 @@ def apply_rope(q, k, cos, sin):
     return q * cos + _rotate_half(q) * sin, k * cos + _rotate_half(k) * sin
 
 
+def causal_mask_bias(attention_mask: jax.Array) -> jax.Array:
+    """Combined causal + padding additive bias (B, 1, S, S) — shared by
+    the Mixtral and Llama families (absolute positions; RoPE models
+    carry no ALiBi term)."""
+    s = attention_mask.shape[-1]
+    causal = jnp.tril(jnp.ones((s, s), bool))
+    keep = causal[None, None] & (attention_mask[:, None, None, :] > 0)
+    return jnp.where(keep, 0.0, NEG_INF).astype(jnp.float32)
+
+
 def _swiglu_experts(moe_params: dict, x: jax.Array, tp_axis: Optional[str]) -> jax.Array:
     """(E_local, C, H) -> (E_local, C, H): w2(silu(w1 x) * w3 x), with the
     FFN dim Megatron-sharded over tensor (w1/w3 column, w2 row+reduce)."""
@@ -227,9 +237,7 @@ def forward_hidden(
     x = vocab_parallel_embedding(params["embed"], input_ids, tp_axis).astype(config.dtype)
 
     cos, sin = rope_cos_sin(s, config.head_dim, config.rope_theta)
-    causal = jnp.tril(jnp.ones((s, s), bool))
-    keep = causal[None, None] & (attention_mask[:, None, None, :] > 0)
-    mask_bias = jnp.where(keep, 0.0, NEG_INF).astype(jnp.float32)
+    mask_bias = causal_mask_bias(attention_mask)
 
     if rng is None:
         if train and config.router_jitter:
@@ -345,13 +353,7 @@ def loss_fn_pp(
     )(mbs["ids"])
 
     cos, sin = rope_cos_sin(s, config.head_dim, config.rope_theta)
-    causal = jnp.tril(jnp.ones((s, s), bool))
-
-    def mk_bias(m):
-        keep = causal[None, None] & (m[:, None, None, :] > 0)
-        return jnp.where(keep, 0.0, NEG_INF).astype(jnp.float32)
-
-    side = {"mask_bias": jax.vmap(mk_bias)(mbs["mask"])}
+    side = {"mask_bias": jax.vmap(causal_mask_bias)(mbs["mask"])}
 
     def stage_fn(blocks_and_keys, h, side):
         blocks, keys = blocks_and_keys
