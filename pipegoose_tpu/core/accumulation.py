@@ -50,25 +50,39 @@ def accumulate_gradients(
 
 
 def make_accumulating_loss(
-    loss_fn: Callable[[Any, Any], jax.Array], n_accum: int
-) -> Callable[[Any, Any], jax.Array]:
+    loss_fn: Callable[..., jax.Array], n_accum: int
+) -> Callable[..., jax.Array]:
     """Wrap a per-batch loss into one that splits its batch into
     ``n_accum`` microbatches and averages — drop-in for
     make_hybrid_train_step's loss_fn (grads then accumulate through the
-    scan automatically under value_and_grad)."""
+    scan automatically under value_and_grad). An optional rng argument
+    (the ``with_rng`` train-step form) is folded per microbatch so e.g.
+    router noise differs across them.
+
+    Exactness caveat: microbatch losses are averaged with EQUAL weight.
+    For unmasked batches (or any loss linear in its examples) this
+    reproduces the one-shot large-batch step exactly; for
+    attention-masked losses whose microbatches carry different
+    valid-token counts, the equal-weight average differs from the
+    global token-weighted mean — arrange microbatching so token counts
+    match (e.g. length-grouped batches) if exactness matters."""
     from pipegoose_tpu.nn.pipeline_parallel.microbatch import split
 
-    def wrapped(params, batch):
+    def wrapped(params, batch, *rng):
         mbs = split(batch, n_accum)
 
         # remat each microbatch: without it, differentiating through the
         # scan stores every microbatch's residuals and peak activation
         # memory equals the full batch — no accumulation benefit
         @jax.checkpoint
-        def body(loss_sum, mb):
-            return loss_sum + loss_fn(params, mb), None
+        def body(loss_sum, mb_and_i):
+            mb, i = mb_and_i
+            extra = (jax.random.fold_in(rng[0], i),) if rng else ()
+            return loss_sum + loss_fn(params, mb, *extra), None
 
-        total, _ = lax.scan(body, jnp.zeros(()), mbs)
+        total, _ = lax.scan(
+            body, jnp.zeros(()), (mbs, jnp.arange(n_accum))
+        )
         return total / n_accum
 
     return wrapped

@@ -122,7 +122,8 @@ class TokenDataset:
         self.path, self.batch, self.seq = path, batch, seq
         self.rank, self.world, self.seed = rank, world, seed
         self.epoch = 0
-        self._fallback_step = 0
+        self._iter_token = 0  # newest live iterator wins (see __iter__)
+        self._epoch_gen = 0  # bumped on EVERY set_epoch (even same epoch)
         self._closed = False
         self._handle = None
         self._lib = _load_native() if native in (None, True) else None
@@ -153,10 +154,10 @@ class TokenDataset:
 
     def set_epoch(self, epoch: int) -> None:
         """Reshuffle for a new epoch; the native loader discards any
-        prefetched old-epoch batches and restarts at step 0 (so does the
-        fallback via its per-iterator step counter)."""
+        prefetched old-epoch batches and restarts at step 0 (the fallback
+        iterator observes the epoch change and resets its own counter)."""
         self.epoch = epoch
-        self._fallback_step = 0
+        self._epoch_gen += 1  # every call restarts at step 0, like native
         if self._handle:
             self._lib.pgt_loader_set_epoch(self._handle, epoch)
 
@@ -176,18 +177,37 @@ class TokenDataset:
         return out
 
     def __iter__(self) -> Iterator[np.ndarray]:
+        """Single live iterator: the native prefetch ring is one shared
+        stream, and two interleaving iterators would silently steal each
+        other's batches. Creating a new iterator invalidates the old one
+        (it raises on its next pull instead of corrupting the epoch).
+        The fallback's step counter is per-iterator and resets on EVERY
+        ``set_epoch`` call (same-epoch restarts included — matching the
+        native loader's unconditional step reset)."""
+        self._iter_token += 1
+        token = self._iter_token
+        step = 0
+        gen_seen = self._epoch_gen
         buf = np.empty(self.batch * self.seq, np.uint32)
         while True:
             if self._closed:
                 raise RuntimeError("TokenDataset is closed")
+            if token != self._iter_token:
+                raise RuntimeError(
+                    "a newer iterator was created for this TokenDataset; only "
+                    "one live iterator is supported (shared prefetch stream)"
+                )
             if self._handle:
                 self._lib.pgt_loader_next(
                     self._handle, buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32))
                 )
                 yield buf.reshape(self.batch, self.seq).copy()
             else:
-                yield self._fill_numpy(self._fallback_step)
-                self._fallback_step += 1
+                if gen_seen != self._epoch_gen:
+                    gen_seen = self._epoch_gen
+                    step = 0
+                yield self._fill_numpy(step)
+                step += 1
 
     def take(self, n: int):
         it = iter(self)

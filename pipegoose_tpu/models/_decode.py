@@ -14,7 +14,31 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+# bounded: a long-lived process generating from many prompt lengths /
+# temperatures would otherwise retain every compiled program pair
 _JIT_CACHE: dict = {}
+_JIT_CACHE_MAX = 32
+
+_MASKS: dict = {}
+
+
+def vocab_mask_for(config):
+    """Memoized padded-vocab logits mask, keyed on valid size: None when
+    the config has no ``valid_vocab_size``. The closure participates in
+    the decode driver's jit-cache key, so it must be a stable object.
+    pad_for_tp zero-rows give padded slots logit 0.0 exactly — they must
+    never win a decode step."""
+    valid = getattr(config, "valid_vocab_size", None)
+    if valid is None:
+        return None
+    if valid not in _MASKS:
+        from pipegoose_tpu.nn.tensor_parallel.layers import mask_padded_vocab
+
+        def mask(logits, _valid=valid):
+            return mask_padded_vocab(logits, None, _valid)
+
+        _MASKS[valid] = mask
+    return _MASKS[valid]
 
 
 def autoregressive_generate(
@@ -74,7 +98,11 @@ def autoregressive_generate(
             _, toks = lax.scan(step, init, keys)
             return toks
 
+        while len(_JIT_CACHE) >= _JIT_CACHE_MAX:
+            _JIT_CACHE.pop(next(iter(_JIT_CACHE)))  # evict least-recent
         _JIT_CACHE[key] = (prefill, decode_all)
+    else:
+        _JIT_CACHE[key] = _JIT_CACHE.pop(key)  # LRU refresh on hit
     prefill, decode_all = _JIT_CACHE[key]
 
     first, cache = prefill(params, input_ids, cache, rng)

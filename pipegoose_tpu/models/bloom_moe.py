@@ -171,7 +171,9 @@ def loss_fn(
         params, input_ids, attention_mask, config, tp_axis, ep_axis, rng, train
     )
     logits = logits_fn(params, hidden, tp_axis)
-    per_tok = vocab_parallel_cross_entropy(logits[:, :-1], labels[:, 1:], tp_axis)
+    per_tok = vocab_parallel_cross_entropy(
+        logits[:, :-1], labels[:, 1:], tp_axis, valid_size=config.valid_vocab_size
+    )
     if attention_mask is not None:
         w = attention_mask[:, 1:].astype(per_tok.dtype)
         task = (per_tok * w).sum() / jnp.maximum(w.sum(), 1)

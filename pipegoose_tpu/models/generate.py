@@ -105,25 +105,6 @@ def _bloom_init_cache(config, batch, max_len):
     return init_cache(config, batch, max_len)
 
 
-_MASKS: dict = {}
-
-
-def _bloom_vocab_mask(config):
-    """Memoized per valid size: the mask closure participates in the
-    decode driver's jit-cache key, so it must be a stable object."""
-    if config.valid_vocab_size is None:
-        return None
-    valid = config.valid_vocab_size
-    if valid not in _MASKS:
-        from pipegoose_tpu.nn.tensor_parallel.layers import mask_padded_vocab
-
-        def mask(logits, _valid=valid):
-            # pad_for_tp zero-rows give padded slots logit 0.0 exactly —
-            # they must never win a decode step
-            return mask_padded_vocab(logits, None, _valid)
-
-        _MASKS[valid] = mask
-    return _MASKS[valid]
 
 
 def generate(
@@ -138,10 +119,10 @@ def generate(
     """Greedy (temperature=0) or sampled decoding. Returns (B, S+new).
     ``eos_token_id``: finished sequences emit eos from then on (HF
     generate's pad-with-eos behavior)."""
-    from pipegoose_tpu.models._decode import autoregressive_generate
+    from pipegoose_tpu.models._decode import autoregressive_generate, vocab_mask_for
 
     return autoregressive_generate(
         forward_cached, _bloom_init_cache, params, input_ids, config,
         max_new_tokens, temperature, rng, eos_token_id,
-        logits_mask=_bloom_vocab_mask(config),
+        logits_mask=vocab_mask_for(config),
     )

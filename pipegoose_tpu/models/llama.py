@@ -302,9 +302,10 @@ def generate(
     params, input_ids, config, max_new_tokens,
     temperature: float = 0.0, rng=None, eos_token_id=None,
 ) -> jax.Array:
-    from pipegoose_tpu.models._decode import autoregressive_generate
+    from pipegoose_tpu.models._decode import autoregressive_generate, vocab_mask_for
 
     return autoregressive_generate(
         forward_cached, init_cache, params, input_ids, config,
         max_new_tokens, temperature, rng, eos_token_id,
+        logits_mask=vocab_mask_for(config),
     )

@@ -57,6 +57,9 @@ class MixtralConfig:
     capacity_factor: Optional[float] = None
     dtype: Any = jnp.float32
     remat: bool = False
+    # set when the embedding/head was padded for TP divisibility: the
+    # true vocab size; padded logit slots are masked out of CE + decode
+    valid_vocab_size: Optional[int] = None
 
     @property
     def head_dim(self) -> int:
@@ -271,7 +274,9 @@ def loss_fn(params, input_ids, attention_mask, labels, config,
     logits, aux, z = forward(
         params, input_ids, attention_mask, config, tp_axis, ep_axis, rng, train
     )
-    per_tok = vocab_parallel_cross_entropy(logits[:, :-1], labels[:, 1:], tp_axis)
+    per_tok = vocab_parallel_cross_entropy(
+        logits[:, :-1], labels[:, 1:], tp_axis, valid_size=config.valid_vocab_size
+    )
     if attention_mask is not None:
         w = attention_mask[:, 1:].astype(per_tok.dtype)
         task = (per_tok * w).sum() / jnp.maximum(w.sum(), 1)
@@ -382,7 +387,9 @@ def loss_fn_pp(
     def head_one(h, mask, labels):
         h = rms_norm(params["ln_f"], h, config.rms_eps)
         logits = column_parallel_linear(params["lm_head"], h, tp_axis)
-        per_tok = vocab_parallel_cross_entropy(logits[:, :-1], labels[:, 1:], tp_axis)
+        per_tok = vocab_parallel_cross_entropy(
+            logits[:, :-1], labels[:, 1:], tp_axis, valid_size=config.valid_vocab_size
+        )
         w = mask[:, 1:].astype(per_tok.dtype)
         return (per_tok * w).sum(), w.sum()
 
@@ -426,6 +433,92 @@ def specs(params: dict, tp_axis: str = "tensor", ep_axis: str = "expert") -> dic
         return P()
 
     return spec_tree(params, spec_fn)
+
+
+def upcycle_from_llama(
+    llama_params: dict,
+    llama_config,
+    num_experts: int,
+    top_k: int = 2,
+    key: Optional[jax.Array] = None,
+    jitter: float = 0.0,
+    **config_overrides,
+):
+    """Sparse-upcycle a dense Llama into a Mixtral-style MoE: every
+    expert starts as a copy of the dense SwiGLU MLP (gate/up/down map
+    exactly onto w1/w3/w2), plus a fresh router gate.
+
+    This is the "turn this model into MoE" capability beyond the
+    framework's own BLOOM (the reference's Experts wraps arbitrary HF
+    MLP modules, experts.py:55-68; its ExpertParallel swaps dense MLPs
+    for expert copies, expert_parallel.py:53-80). With ``jitter=0`` the
+    upcycled model's FORWARD equals the dense Llama exactly — identical
+    experts and normalized top-k gates make routing irrelevant — which
+    the test pins; ``jitter`` perturbs experts so they diverge in
+    training.
+
+    Returns (MixtralConfig, params) ready for every Mixtral parallel
+    form (TP/EP/PP/ZeRO, generation).
+    """
+    cfg = MixtralConfig(
+        vocab_size=llama_config.vocab_size,
+        hidden_size=llama_config.hidden_size,
+        intermediate_size=llama_config.intermediate_size,
+        n_layer=llama_config.n_layer,
+        n_head=llama_config.n_head,
+        n_kv_head=llama_config.n_kv_head,
+        rope_theta=llama_config.rope_theta,
+        rms_eps=llama_config.rms_eps,
+        num_experts=num_experts,
+        top_k=top_k,
+        dtype=llama_config.dtype,
+        remat=llama_config.remat,
+        valid_vocab_size=llama_config.valid_vocab_size,
+        **config_overrides,
+    )
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    kj, kr = jax.random.split(key)
+
+    blocks = dict(llama_params["blocks"])
+    mlp = blocks.pop("mlp")
+    E = num_experts
+
+    def tile(x):
+        return jnp.broadcast_to(x[:, None], (x.shape[0], E) + x.shape[1:])
+
+    moe = {
+        "w1": {"kernel": tile(mlp["gate"]["kernel"])},
+        "w3": {"kernel": tile(mlp["up"]["kernel"])},
+        "w2": {"kernel": tile(mlp["down"]["kernel"])},
+    }
+    if jitter:
+        leaves, treedef = jax.tree_util.tree_flatten(moe)
+        keys = jax.random.split(kj, len(leaves))
+        leaves = [
+            x * (1 + jitter * jax.random.normal(k, x.shape, x.dtype))
+            for x, k in zip(leaves, keys)
+        ]
+        moe = jax.tree_util.tree_unflatten(treedef, leaves)
+    blocks["moe"] = moe
+    blocks["router"] = {
+        "gate": {
+            "kernel": (
+                jax.random.normal(kr, (cfg.n_layer, cfg.hidden_size, E)) * 0.02
+            ).astype(cfg.dtype)
+        }
+    }
+
+    lm_head = llama_params.get("lm_head")
+    if lm_head is None:  # tied checkpoint: materialize the head
+        lm_head = {"kernel": llama_params["embed"]["weight"].T}
+    params = {
+        "embed": llama_params["embed"],
+        "blocks": blocks,
+        "ln_f": llama_params["ln_f"],
+        "lm_head": lm_head,
+    }
+    return cfg, params
 
 
 def pp_specs(
@@ -528,9 +621,10 @@ def generate(
 ) -> jax.Array:
     """Greedy/sampled decoding with a GQA KV cache — shared decode
     driver (models/_decode.py), same EOS semantics as BLOOM's generate."""
-    from pipegoose_tpu.models._decode import autoregressive_generate
+    from pipegoose_tpu.models._decode import autoregressive_generate, vocab_mask_for
 
     return autoregressive_generate(
         forward_cached, init_cache, params, input_ids, config,
         max_new_tokens, temperature, rng, eos_token_id,
+        logits_mask=vocab_mask_for(config),
     )

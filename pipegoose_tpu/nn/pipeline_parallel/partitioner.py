@@ -13,6 +13,18 @@ This module covers the general, non-uniform case: given per-layer costs
 from the profiler), compute the contiguous assignment minimizing the
 bottleneck stage cost (exact interval-partition DP, not the reference's
 greedy running-total heuristic, partitioner.py:101-144).
+
+Why the RUNTIME uses equal stages only (gpipe/one_f_one_b consume an
+evenly pipe-sharded stacked dim): the pipeline is ONE compiled SPMD
+program — every pipe rank runs the same executable over identically-
+shaped param shards, which is exactly what makes the thread/RPC engine
+of the reference unnecessary. Genuinely uneven stages need per-rank
+DIFFERENT param shapes (an MPMD runtime) or padding every stage to the
+longest (which costs the padded compute on every stage and erases the
+balancing win). For transformer stacks — identical per-layer cost by
+construction — equal split IS the DP optimum; this partitioner is for
+cost analysis and for heterogeneous-cost stacks feeding a future
+per-stage-compiled (MPMD) runtime.
 """
 from __future__ import annotations
 

@@ -91,6 +91,7 @@ def make_hybrid_train_step(
     loss_axis: str = "data",
     grad_sync_axes: tuple = (),
     with_rng: bool = False,
+    n_accum: int = 1,
 ):
     """Build (init_fn, step_fn), both jitted over the context's mesh.
 
@@ -110,11 +111,21 @@ def make_hybrid_train_step(
     axis indices inside ``loss_fn`` for per-rank diversity (the
     reference seeded every rank identically, parallel_context.py:253-261,
     which SURVEY.md §7 flags as wrong for router noise).
+
+    ``n_accum > 1``: gradient accumulation — the per-device batch shard
+    is split into ``n_accum`` microbatches scanned with rematerialization
+    (core/accumulation.py), so peak activation memory is one
+    microbatch's while the optimizer sees the full-batch gradient.
     """
     ctx = parallel_context or ParallelContext.get_context()
     if ctx is None:
         raise ValueError("no ParallelContext; construct one first")
     mesh = ctx.mesh
+
+    if n_accum > 1:
+        from pipegoose_tpu.core.accumulation import make_accumulating_loss
+
+        loss_fn = make_accumulating_loss(loss_fn, n_accum)
 
     def _state_spec_for(params):
         return zero_state_spec(optimizer, params, param_specs, mesh)

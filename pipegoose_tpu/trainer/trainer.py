@@ -33,6 +33,7 @@ class Trainer:
         loss_axis: Any = "data",
         grad_sync_axes: tuple = (),
         with_rng: bool = False,
+        n_accum: int = 1,
         callbacks: Sequence[Callback] = (),
         logger: Optional[DistributedLogger] = None,
         resume_dir: Optional[str] = None,
@@ -53,6 +54,7 @@ class Trainer:
             loss_axis=loss_axis,
             grad_sync_axes=grad_sync_axes,
             with_rng=with_rng,
+            n_accum=n_accum,
         )
         self.param_specs = param_specs
         self.optimizer = optimizer

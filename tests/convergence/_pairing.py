@@ -5,12 +5,18 @@ import math
 import sys
 
 
-def run_paired(batches, ref_step, par_step, tol: float, names=("ref", "par")):
+def run_paired(
+    batches, ref_step, par_step, tol: float, names=("ref", "par"),
+    out_path: str | None = None, meta: dict | None = None,
+):
     """Run both steps over the batches, print a paired-loss CSV, and exit
     nonzero if relative divergence exceeds ``tol`` — or if ANY loss goes
-    non-finite (a NaN must fail the gate, not sail past a max())."""
+    non-finite (a NaN must fail the gate, not sail past a max()).
+    ``out_path``: also write a JSON record of the run (committed as the
+    acceptance evidence, the analog of the reference's wandb runs)."""
     print(f"step,{names[0]}_loss,{names[1]}_loss,abs_diff")
     worst = 0.0
+    pairs = []
     for i, ids in enumerate(batches):
         ref_loss = float(ref_step(ids))
         loss = float(par_step(ids))
@@ -20,8 +26,31 @@ def run_paired(batches, ref_step, par_step, tol: float, names=("ref", "par")):
             worst = float("inf")
         else:
             worst = max(worst, rel)
+        pairs.append({names[0]: ref_loss, names[1]: loss})
         print(f"{i},{ref_loss:.6f},{loss:.6f},{d:.2e}")
     ok = worst <= tol
-    print(f"max relative divergence: {worst:.2e} (tol {tol}) -> "
-          f"{'PASS' if ok else 'FAIL'}")
-    sys.exit(0 if ok else 1)
+    # the run must also LEARN: final reference loss below the first
+    # (vacuously true for runs too short to show a trend)
+    learned = (
+        len(pairs) < 2 or pairs[-1][names[0]] < pairs[0][names[0]]
+    )
+    print(f"max relative divergence: {worst:.2e} (tol {tol}), "
+          f"loss {'decreased' if learned else 'DID NOT decrease'} -> "
+          f"{'PASS' if ok and learned else 'FAIL'}")
+    if out_path:
+        import json
+
+        with open(out_path, "w") as f:
+            json.dump(
+                {
+                    "pairs": pairs,
+                    "max_rel_divergence": worst,
+                    "tol": tol,
+                    "loss_decreased": learned,
+                    "ok": bool(ok and learned),
+                    **(meta or {}),
+                },
+                f, indent=1,
+            )
+        print(f"wrote {out_path}")
+    sys.exit(0 if ok and learned else 1)

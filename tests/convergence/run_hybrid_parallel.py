@@ -33,9 +33,29 @@ def main():
     ap.add_argument("--batch", type=int, default=8)
     ap.add_argument("--seq", type=int, default=64)
     ap.add_argument("--tol", type=float, default=5e-3)
+    ap.add_argument(
+        "--model", choices=("toy", "560m"), default="toy",
+        help="'560m' runs the real bloom-560m config — the reference's "
+        "acceptance scale (run_hybrid_parallel.py:83-177)",
+    )
+    ap.add_argument("--lr", type=float, default=1e-3)
+    ap.add_argument("--out", default=None, help="write a JSON run record")
+    ap.add_argument(
+        "--platform", choices=("auto", "cpu"), default="auto",
+        help="'cpu' pins the fake-CPU-device backend before first use "
+        "(needed where a sitecustomize pins an accelerator plugin)",
+    )
     args = ap.parse_args()
 
-    cfg = bloom.BloomConfig(vocab_size=512, hidden_size=128, n_layer=4, n_head=8)
+    if args.platform == "cpu":
+        from pipegoose_tpu.testing import force_cpu_devices
+
+        force_cpu_devices(max(8, args.tp * args.dp))
+
+    if args.model == "560m":
+        cfg = bloom.BloomConfig.bloom_560m()
+    else:
+        cfg = bloom.BloomConfig(vocab_size=512, hidden_size=128, n_layer=4, n_head=8)
     params = bloom.init_params(cfg, jax.random.PRNGKey(0))
     rng = np.random.RandomState(0)
     batches = [
@@ -44,7 +64,7 @@ def main():
     ]
 
     # single-device reference
-    opt = optax.adam(1e-3)
+    opt = optax.adam(args.lr)
     st = opt.init(params)
     p_ref = params
 
@@ -58,7 +78,7 @@ def main():
     init_fn, make_step = make_hybrid_train_step(
         lambda p, ids: bloom.loss_fn(p, ids, None, ids, cfg, tp_axis="tensor"),
         bloom.tp_specs(params),
-        DistributedOptimizer(optax.adam(1e-3), axis_name="data"),
+        DistributedOptimizer(optax.adam(args.lr), axis_name="data"),
         ctx,
     )
     opt_state = init_fn(params)
@@ -82,7 +102,13 @@ def main():
     sys.path.insert(0, __import__("os").path.dirname(__import__("os").path.abspath(__file__)))
     from _pairing import run_paired
 
-    run_paired(batches, ref_fn, par_fn, args.tol, names=("ref", "hybrid"))
+    run_paired(
+        batches, ref_fn, par_fn, args.tol, names=("ref", "hybrid"),
+        out_path=args.out,
+        meta={"model": args.model, "tp": args.tp, "dp": args.dp,
+              "batch": args.batch, "seq": args.seq, "lr": args.lr,
+              "backend": f"{jax.default_backend()}-{jax.device_count()}dev"},
+    )
 
 
 if __name__ == "__main__":

@@ -94,3 +94,33 @@ def test_tiny_file_fallback(token_file, tmp_path):
     write_token_file(np.arange(10, dtype=np.uint32), tiny)
     with pytest.raises(Exception):
         TokenDataset(tiny, batch=4, seq=16).take(1)
+
+
+def test_second_iterator_invalidates_first(token_file):
+    """Only the newest iterator may pull: the prefetch stream is shared,
+    so an interleaving stale iterator must fail loudly instead of
+    silently stealing batches."""
+    ds = TokenDataset(token_file, batch=2, seq=4, native=False)
+    it1 = iter(ds)
+    next(it1)
+    it2 = iter(ds)
+    next(it2)  # newest iterator works
+    with pytest.raises(RuntimeError, match="newer iterator"):
+        next(it1)
+    ds.close()
+
+
+def test_fallback_iterator_resets_on_set_epoch(token_file):
+    ds = TokenDataset(token_file, batch=2, seq=4, native=False)
+    it = iter(ds)
+    first_epoch0 = next(it).copy()
+    next(it)
+    # same-epoch restart resets to step 0, matching the native loader's
+    # unconditional reset in pgt_loader_set_epoch
+    ds.set_epoch(0)
+    np.testing.assert_array_equal(next(it), first_epoch0)
+    ds.set_epoch(1)
+    assert not np.array_equal(next(it), first_epoch0)
+    ds.set_epoch(0)
+    np.testing.assert_array_equal(next(it), first_epoch0)
+    ds.close()

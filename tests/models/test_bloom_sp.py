@@ -86,3 +86,68 @@ def test_sp_grads_match_single_device(setup, devices):
             )
     finally:
         ctx.destroy()
+
+
+def test_sp_training_matches_single_device(setup, devices):
+    """Multi-step SP x TP x DP + ZeRO-1 training tracks the single-device
+    trajectory (losses + final params) — the missing SP TRAINING coverage
+    (round-1 review: only loss/grad checks existed)."""
+    import optax
+
+    from pipegoose_tpu.optim.zero import DistributedOptimizer
+    from pipegoose_tpu.parallel import make_hybrid_train_step
+
+    cfg, _, _ = setup
+    params = bloom.init_params(cfg, jax.random.PRNGKey(0))
+    ids = jnp.asarray(np.random.RandomState(9).randint(0, 128, (4, 32)))
+    STEPS = 3
+
+    opt = optax.adam(1e-3)
+    st = opt.init(params)
+    p_ref = params
+    ref_losses = []
+
+    @jax.jit
+    def ref_step(p, s, i):
+        loss, g = jax.value_and_grad(bloom.loss_fn)(p, i, None, i, cfg)
+        u, s = opt.update(g, s, p)
+        return optax.apply_updates(p, u), s, loss
+
+    for _ in range(STEPS):
+        p_ref, st, loss = ref_step(p_ref, st, ids)
+        ref_losses.append(float(loss))
+    assert ref_losses[-1] < ref_losses[0]
+
+    ctx = ParallelContext(
+        sequence_parallel_size=2, tensor_parallel_size=2, data_parallel_size=2
+    )
+    try:
+        specs = bloom.tp_specs(params)
+        zopt = DistributedOptimizer(optax.adam(1e-3), axis_name="data")
+
+        def loss_fn(p, i):
+            return bloom.loss_fn_sp(p, i, None, i, cfg, tp_axis="tensor", sp_axis="seq")
+
+        init_fn, make_step = make_hybrid_train_step(
+            loss_fn, specs, zopt, ctx,
+            batch_spec=P("data", "seq"),
+            grad_sync_axes=(("seq", "sum"),),
+        )
+        p = jax.tree_util.tree_map(jnp.copy, params)
+        opt_state = init_fn(p)
+        step = make_step(p)
+        losses = []
+        for _ in range(STEPS):
+            p, opt_state, loss = step(p, opt_state, ids)
+            losses.append(float(loss))
+
+        np.testing.assert_allclose(losses, ref_losses, rtol=2e-3, atol=2e-4)
+        for (path, r), t in zip(
+            jax.tree_util.tree_leaves_with_path(p_ref),
+            jax.tree_util.tree_leaves(p),
+        ):
+            np.testing.assert_allclose(
+                np.asarray(t), np.asarray(r), rtol=5e-3, atol=5e-4, err_msg=str(path)
+            )
+    finally:
+        ctx.destroy()

@@ -162,3 +162,35 @@ def test_generate_matches_hf(hf_model):
         llama.generate(params, jnp.asarray(ids), cfg, max_new_tokens=5, eos_token_id=2)
     )
     np.testing.assert_array_equal(ours, hf_out)
+
+
+def test_upcycle_to_moe_matches_dense(hf_model, inputs):
+    """Sparse upcycling: dense Llama -> Mixtral MoE with identical
+    experts reproduces the dense forward EXACTLY (normalized top-k gates
+    over identical experts = the dense MLP), and the upcycled model
+    trains with finite grads."""
+    import optax
+
+    from pipegoose_tpu.models import mixtral
+
+    cfg, params = llama_params_from_hf(hf_model)
+    ids = jnp.asarray(inputs)
+    dense_logits = llama.forward(params, ids, None, cfg)
+
+    mcfg, mparams = mixtral.upcycle_from_llama(params, cfg, num_experts=4, top_k=2)
+    moe_logits, aux, z = mixtral.forward(mparams, ids, None, mcfg, train=False)
+    np.testing.assert_allclose(
+        np.asarray(moe_logits), np.asarray(dense_logits), rtol=2e-5, atol=2e-5
+    )
+
+    # jittered upcycle diverges but still trains
+    mcfg2, mparams2 = mixtral.upcycle_from_llama(
+        params, cfg, num_experts=4, top_k=2, jitter=0.01,
+        key=jax.random.PRNGKey(3),
+    )
+    loss, grads = jax.value_and_grad(mixtral.loss_fn)(
+        mparams2, ids, None, ids, mcfg2, train=False
+    )
+    assert np.isfinite(float(loss))
+    for path, g in jax.tree_util.tree_leaves_with_path(grads):
+        assert np.all(np.isfinite(np.asarray(g))), path

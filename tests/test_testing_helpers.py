@@ -1,0 +1,34 @@
+"""Public testing-utilities package (the reference's testing/utils.py
+analog, pipegoose_tpu/testing)."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pipegoose_tpu.testing import (
+    assert_trees_allclose,
+    parameter_similarity,
+    random_input_ids,
+)
+
+
+def test_parameter_similarity():
+    a = {"x": jnp.ones(4), "y": jnp.zeros(3)}
+    b = {"x": jnp.ones(4), "y": jnp.ones(3)}
+    assert parameter_similarity(a, a) == 1.0
+    assert parameter_similarity(a, b) == 0.5
+    with pytest.raises(ValueError):
+        parameter_similarity(a, {"x": jnp.ones(4)})
+
+
+def test_assert_trees_allclose():
+    a = {"w": jnp.arange(3.0)}
+    assert_trees_allclose(a, {"w": jnp.arange(3.0) + 1e-8})
+    with pytest.raises(AssertionError, match="w"):
+        assert_trees_allclose(a, {"w": jnp.arange(3.0) + 1.0})
+
+
+def test_random_input_ids_deterministic():
+    a = random_input_ids(100, (2, 5), seed=3)
+    b = random_input_ids(100, (2, 5), seed=3)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert int(a.max()) < 100
