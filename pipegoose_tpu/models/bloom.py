@@ -581,18 +581,9 @@ def loss_fn_1f1b(
         }
         return loss, grads
 
-    @jax.custom_vjp
-    def pipelined(params):
-        return run(params)[0]
+    from pipegoose_tpu.nn.pipeline_parallel.pipeline import manual_grads_loss
 
-    def fwd(params):
-        return run(params)
-
-    def bwd(grads, ct):
-        return (jax.tree_util.tree_map(lambda g: (g * ct).astype(g.dtype), grads),)
-
-    pipelined.defvjp(fwd, bwd)
-    return pipelined(params)
+    return manual_grads_loss(run, params)
 
 
 def pp_specs(params: dict, tp_axis: str = "tensor", pipe_axis: str = "pipe") -> dict:

@@ -24,8 +24,8 @@ import jax.numpy as jnp
 
 from pipegoose_tpu.models.mixtral import (
     _attention,
-    causal_mask_bias,
     rms_norm,
+    rope_attention_bias,
     rope_cos_sin,
 )
 from pipegoose_tpu.nn.tensor_parallel.layers import (
@@ -50,6 +50,8 @@ class LlamaConfig:
     tie_word_embeddings: bool = False
     dtype: Any = jnp.float32
     remat: bool = False
+    # fused Pallas flash attention after RoPE + GQA repetition
+    use_flash: bool = False
     valid_vocab_size: Optional[int] = None
 
     @property
@@ -116,14 +118,13 @@ def _mlp(blk: dict, x: jax.Array, tp_axis: Optional[str]) -> jax.Array:
     return row_parallel_linear(blk["down"], jax.nn.silu(g) * u, tp_axis)
 
 
-def _block(blk, x, cos, sin, mask_bias, config, tp_axis):
+def _block(blk, x, cos, sin, bias, config, tp_axis):
     h = rms_norm(blk["ln_1"], x, config.rms_eps)
-    x = x + _attention(blk["attn"], h, cos, sin, mask_bias, config, tp_axis)
+    x = x + _attention(blk["attn"], h, cos, sin, bias, config, tp_axis)
     h = rms_norm(blk["ln_2"], x, config.rms_eps)
     return x + _mlp(blk["mlp"], h, tp_axis)
 
 
-attention_bias = causal_mask_bias
 
 
 def forward_hidden(
@@ -136,14 +137,14 @@ def forward_hidden(
         config.dtype
     )
     cos, sin = rope_cos_sin(s, config.head_dim, config.rope_theta)
-    mask_bias = attention_bias(attention_mask)
+    bias = rope_attention_bias(attention_mask, config)
 
     block = partial(_block, config=config, tp_axis=tp_axis)
     if config.remat:
         block = jax.checkpoint(block)
 
     def scan_fn(carry, blk):
-        return block(blk, carry, cos, sin, mask_bias), None
+        return block(blk, carry, cos, sin, bias), None
 
     x, _ = jax.lax.scan(scan_fn, x, params["blocks"])
     return rms_norm(params["ln_f"], x, config.rms_eps)
@@ -202,11 +203,11 @@ def loss_fn_pp(
         )
     )(mbs["ids"])
     cos, sin = rope_cos_sin(s, config.head_dim, config.rope_theta)
-    side = {"mask_bias": jax.vmap(attention_bias)(mbs["mask"])}
+    side = {"bias": jax.vmap(lambda m: rope_attention_bias(m, config))(mbs["mask"])}
 
     def stage_fn(blocks, h, side):
         def scan_fn(carry, blk):
-            return _block(blk, carry, cos, sin, side["mask_bias"], config, tp_axis), None
+            return _block(blk, carry, cos, sin, side["bias"], config, tp_axis), None
 
         h, _ = jax.lax.scan(scan_fn, h, blocks)
         return h

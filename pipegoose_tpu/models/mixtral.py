@@ -57,6 +57,10 @@ class MixtralConfig:
     capacity_factor: Optional[float] = None
     dtype: Any = jnp.float32
     remat: bool = False
+    # fused Pallas flash attention (ops/flash_attention.py): applied
+    # after RoPE + GQA head repetition, zero ALiBi slopes, padding via
+    # the kernel's kv_neg bias input
+    use_flash: bool = False
     # set when the embedding/head was padded for TP divisibility: the
     # true vocab size; padded logit slots are masked out of CE + decode
     valid_vocab_size: Optional[int] = None
@@ -163,6 +167,17 @@ def causal_mask_bias(attention_mask: jax.Array) -> jax.Array:
     return jnp.where(keep, 0.0, NEG_INF).astype(jnp.float32)
 
 
+def rope_attention_bias(attention_mask: jax.Array, config) -> dict:
+    """Bias inputs in the form the configured attention path consumes
+    (shared by Mixtral and Llama): flash gets the O(S) per-key validity
+    bias ``kv_neg`` (the causal mask lives inside the kernel); the
+    standard path gets the dense (B, 1, S, S) ``mask_bias``."""
+    if config.use_flash:
+        m = attention_mask.astype(jnp.float32)
+        return {"kv_neg": (1.0 - m) * NEG_INF}
+    return {"mask_bias": causal_mask_bias(attention_mask)}
+
+
 def _swiglu_experts(moe_params: dict, x: jax.Array, tp_axis: Optional[str]) -> jax.Array:
     """(E_local, C, H) -> (E_local, C, H): w2(silu(w1 x) * w3 x), with the
     FFN dim Megatron-sharded over tensor (w1/w3 column, w2 row+reduce)."""
@@ -187,7 +202,9 @@ def _swiglu_experts(moe_params: dict, x: jax.Array, tp_axis: Optional[str]) -> j
     return out
 
 
-def _attention(blk, x, cos, sin, mask_bias, config, tp_axis):
+def _attention(blk, x, cos, sin, bias, config, tp_axis):
+    """RoPE + GQA attention; ``bias`` is the dict from
+    :func:`rope_attention_bias` (dense mask_bias OR flash kv_neg)."""
     b, s, _ = x.shape
     hd = config.head_dim
     tp = jax.lax.axis_size(tp_axis) if tp_axis else 1
@@ -203,21 +220,32 @@ def _attention(blk, x, cos, sin, mask_bias, config, tp_axis):
     k = column_parallel_linear(blk["k"], x, tp_axis).reshape(b, s, nkv_l, hd)
     v = column_parallel_linear(blk["v"], x, tp_axis).reshape(b, s, nkv_l, hd)
     q, k = apply_rope(q, k, cos, sin)
-    # GQA: repeat kv heads
+    # GQA: repeat kv heads (a grouped kernel that reads the nkv-wide
+    # K/V directly is a future optimization of the flash path)
     k = jnp.repeat(k, groups, axis=2)
     v = jnp.repeat(v, groups, axis=2)
 
+    if config.use_flash:
+        from pipegoose_tpu.ops.flash_attention import flash_attention
+
+        ctx = flash_attention(
+            q, k, v, alibi_slopes=None,  # RoPE: no ALiBi term
+            kv_neg=bias["kv_neg"], causal=True,
+        )
+        ctx = ctx.astype(x.dtype).reshape(b, s, nh_l * hd)
+        return row_parallel_linear(blk["o"], ctx, tp_axis)
+
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
-    scores = scores * (hd**-0.5) + mask_bias
+    scores = scores * (hd**-0.5) + bias["mask_bias"]
     probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(x.dtype)
     ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, v, preferred_element_type=jnp.float32)
     ctx = ctx.astype(x.dtype).reshape(b, s, nh_l * hd)
     return row_parallel_linear(blk["o"], ctx, tp_axis)
 
 
-def _block(blk, x, cos, sin, mask_bias, key, config, tp_axis, ep_axis, train):
+def _block(blk, x, cos, sin, bias, key, config, tp_axis, ep_axis, train):
     h = rms_norm(blk["ln_1"], x, config.rms_eps)
-    x = x + _attention(blk["attn"], h, cos, sin, mask_bias, config, tp_axis)
+    x = x + _attention(blk["attn"], h, cos, sin, bias, config, tp_axis)
     h = rms_norm(blk["ln_2"], x, config.rms_eps)
 
     router = config.router()
@@ -240,7 +268,7 @@ def forward_hidden(
     x = vocab_parallel_embedding(params["embed"], input_ids, tp_axis).astype(config.dtype)
 
     cos, sin = rope_cos_sin(s, config.head_dim, config.rope_theta)
-    mask_bias = causal_mask_bias(attention_mask)
+    bias = rope_attention_bias(attention_mask, config)
 
     if rng is None:
         if train and config.router_jitter:
@@ -251,7 +279,7 @@ def forward_hidden(
     def scan_fn(carry, blk_and_key):
         blk, key = blk_and_key
         out, aux, z = _block(
-            blk, carry, cos, sin, mask_bias, key, config, tp_axis, ep_axis, train
+            blk, carry, cos, sin, bias, key, config, tp_axis, ep_axis, train
         )
         return out, (aux, z)
 
@@ -358,7 +386,7 @@ def loss_fn_pp(
     )(mbs["ids"])
 
     cos, sin = rope_cos_sin(s, config.head_dim, config.rope_theta)
-    side = {"mask_bias": jax.vmap(causal_mask_bias)(mbs["mask"])}
+    side = {"bias": jax.vmap(lambda m: rope_attention_bias(m, config))(mbs["mask"])}
 
     def stage_fn(blocks_and_keys, h, side):
         blocks, keys = blocks_and_keys
@@ -366,7 +394,7 @@ def loss_fn_pp(
         def scan_fn(carry, blk_key):
             blk, key = blk_key
             out, aux, z = _block(
-                blk, carry, cos, sin, side["mask_bias"], key,
+                blk, carry, cos, sin, side["bias"], key,
                 config, tp_axis, ep_axis, train,
             )
             return out, (aux, z)
@@ -435,6 +463,118 @@ def specs(params: dict, tp_axis: str = "tensor", ep_axis: str = "expert") -> dic
     return spec_tree(params, spec_fn)
 
 
+def loss_fn_1f1b(
+    params: dict,
+    input_ids: jax.Array,
+    attention_mask: Optional[jax.Array],
+    labels: jax.Array,
+    config: MixtralConfig,
+    n_microbatches: int,
+    tp_axis: Optional[str] = None,
+    pipe_axis: str = "pipe",
+    ep_axis: Optional[str] = None,
+    rng: Optional[jax.Array] = None,
+    train: bool = True,
+) -> jax.Array:
+    """Mixtral pipeline loss on the 1F1B runtime: same value/gradients
+    as :func:`loss_fn_pp` with O(stages) activation memory. Router aux/z
+    losses ride ``one_f_one_b``'s ``with_aux`` channel: each stage's
+    pre-weighted aux scalar seeds its OWN backward, so router gradients
+    never cross stages, and the per-rank loss sums combine with one
+    psum over the pipe axis."""
+    from pipegoose_tpu.nn.pipeline_parallel import microbatch as mb
+    from pipegoose_tpu.nn.pipeline_parallel.pipeline import one_f_one_b
+
+    b, s = input_ids.shape
+    if attention_mask is None:
+        attention_mask = jnp.ones((b, s), jnp.int32)
+
+    P_pipe = jax.lax.axis_size(pipe_axis)
+    L = config.n_layer
+    if L % P_pipe:
+        raise ValueError(
+            f"n_layer={L} must be divisible by the pipe axis size {P_pipe}"
+        )
+    L_local = L // P_pipe
+    stage = jax.lax.axis_index(pipe_axis)
+
+    if rng is None:
+        if train and config.router_jitter:
+            raise ValueError("train=True with router jitter needs an explicit rng")
+        rng = jax.random.PRNGKey(0)
+    layer_keys = jax.random.split(rng, L)
+    local_keys = jax.lax.dynamic_slice_in_dim(layer_keys, stage * L_local, L_local, 0)
+
+    M = n_microbatches
+    mbs = mb.split(
+        {"ids": input_ids, "mask": attention_mask, "labels": labels}, M
+    )
+    cos, sin = rope_cos_sin(s, config.head_dim, config.rope_theta)
+    side = {
+        "bias": jax.vmap(lambda m: rope_attention_bias(m, config))(mbs["mask"]),
+        "labels": mbs["labels"],
+        "mask": mbs["mask"],
+    }
+    inv_count = 1.0 / jnp.maximum(attention_mask[:, 1:].sum().astype(jnp.float32), 1)
+
+    def stage_fn(blocks, h, side):
+        # local_keys is closed over (constant for AD): integer key
+        # arrays must not enter the differentiated stage_params pytree
+        def scan_fn(carry, blk_key):
+            blk, key = blk_key
+            out, aux, z = _block(
+                blk, carry, cos, sin, side["bias"], key,
+                config, tp_axis, ep_axis, train,
+            )
+            return out, (aux, z)
+
+        h, (aux, z) = jax.lax.scan(scan_fn, h, (blocks, local_keys))
+        aux_scalar = (
+            config.aux_loss_weight * aux.sum() + config.z_loss_weight * z.sum()
+        ) / (L * M)
+        return h, aux_scalar.astype(jnp.float32)
+
+    def head_fn(hp, h, side):
+        h = rms_norm(hp["ln_f"], h, config.rms_eps)
+        logits = column_parallel_linear(hp["lm_head"], h, tp_axis)
+        per_tok = vocab_parallel_cross_entropy(
+            logits[:, :-1], side["labels"][:, 1:], tp_axis,
+            valid_size=config.valid_vocab_size,
+        )
+        w = side["mask"][:, 1:].astype(per_tok.dtype)
+        return ((per_tok * w).sum() * inv_count).astype(jnp.float32)
+
+    def run(params):
+        h0, embed_vjp = jax.vjp(
+            lambda ep: jax.vmap(
+                lambda ids: vocab_parallel_embedding(ep, ids, tp_axis).astype(
+                    config.dtype
+                )
+            )(mbs["ids"]),
+            params["embed"],
+        )
+        head_params = {"ln_f": params["ln_f"], "lm_head": params["lm_head"]}
+        loss_local, dh0, d_blocks, d_head = one_f_one_b(
+            stage_fn, params["blocks"], head_fn, head_params,
+            h0, side, pipe_axis, with_aux=True,
+        )
+        (d_embed,) = embed_vjp(dh0)
+        # every rank's aux rode its local loss sum; the task part lives
+        # on the last rank — one psum combines both
+        loss = jax.lax.psum(loss_local, pipe_axis)
+        grads = {
+            "embed": d_embed,
+            "blocks": d_blocks,
+            "ln_f": d_head["ln_f"],
+            "lm_head": d_head["lm_head"],
+        }
+        return loss, grads
+
+    from pipegoose_tpu.nn.pipeline_parallel.pipeline import manual_grads_loss
+
+    return manual_grads_loss(run, params)
+
+
 def upcycle_from_llama(
     llama_params: dict,
     llama_config,
@@ -473,6 +613,7 @@ def upcycle_from_llama(
         top_k=top_k,
         dtype=llama_config.dtype,
         remat=llama_config.remat,
+        use_flash=llama_config.use_flash,
         valid_vocab_size=llama_config.valid_vocab_size,
         **config_overrides,
     )

@@ -160,6 +160,7 @@ def one_f_one_b(
     inputs: Any,
     side_inputs: Any,
     axis_name: str = "pipe",
+    with_aux: bool = False,
 ):
     """1F1B (PipeDream-flush) pipeline as ONE compiled SPMD program with a
     MANUAL interleaved backward.
@@ -200,6 +201,15 @@ def one_f_one_b(
     valid on the LAST pipe rank (zeros elsewhere), d_inputs (M-leading)
     on the FIRST — combine replicated-param grads with a psum over the
     pipe axis (grad_sync_axes=("pipe", "sum")).
+
+    ``with_aux=True``: ``stage_fn`` returns ``(h, aux_scalar)`` where
+    ``aux_scalar`` is this stage's PRE-WEIGHTED, PRE-NORMALIZED scalar
+    loss contribution for the microbatch (e.g. MoE router aux/z terms
+    already multiplied by their coefficients and divided by L*M). Each
+    stage's backward seeds a unit cotangent on its own aux scalar — its
+    router gradients flow during ITS backward, no cross-stage traffic —
+    and the aux values accumulate into loss_sum on EVERY rank, so the
+    caller combines loss with a plain psum over the pipe axis.
 
     This runtime is callable from a non-differentiable context only (it
     RETURNS gradients); wrap it in ``jax.custom_vjp`` for use under
@@ -263,7 +273,8 @@ def one_f_one_b(
                 x0, _tree_index(recv_h, slot),
             )
             acts = _tree_update(acts, h_in, slot, True)
-            h_out = stage_fn(stage_params, h_in, _tree_index(side_inputs, m))
+            out = stage_fn(stage_params, h_in, _tree_index(side_inputs, m))
+            h_out = out[0] if with_aux else out
             return (h_out, send_g, recv_h, recv_g, acts, dh0, pgrads, hgrads, loss)
 
         def b_branch(op):
@@ -276,6 +287,9 @@ def one_f_one_b(
 
             def last_fn(_):
                 def full(p, hp, h):
+                    if with_aux:
+                        h_out, aux = stage_fn(p, h, side)
+                        return head_fn(hp, h_out, side) + aux
                     return head_fn(hp, stage_fn(p, h, side), side)
 
                 loss_m, vjp = jax.vjp(full, stage_params, head_params, h_in)
@@ -283,6 +297,13 @@ def one_f_one_b(
                 return loss_m.astype(jnp.float32), dp, dhp, dh
 
             def mid_fn(_):
+                if with_aux:
+                    (_, aux), vjp = jax.vjp(
+                        lambda p, h: stage_fn(p, h, side), stage_params, h_in
+                    )
+                    # unit cotangent on this stage's own aux scalar
+                    dp, dh = vjp((g_in, jnp.ones_like(aux)))
+                    return aux.astype(jnp.float32), dp, tree_zeros(head_params), dh
                 _, vjp = jax.vjp(
                     lambda p, h: stage_fn(p, h, side), stage_params, h_in
                 )
@@ -318,6 +339,28 @@ def one_f_one_b(
     carry, _ = lax.scan(cycle, carry0, jnp.arange(n_clock))
     (_, _, _, _, _, dh0, pgrads, hgrads, loss) = carry
     return loss, dh0, pgrads, hgrads
+
+
+def manual_grads_loss(run: Callable[[Any], tuple], params: Any) -> jax.Array:
+    """Make a manual-backward pipeline differentiable: ``run(params) ->
+    (loss, grads)`` computes gradients itself (the 1F1B fused
+    forward+backward); this wraps it in a ``custom_vjp`` whose forward
+    stashes the gradients as residuals and whose backward just scales
+    them by the cotangent — so ``jax.value_and_grad(loss_fn)`` works
+    unchanged. Shared by the bloom and mixtral ``loss_fn_1f1b``."""
+
+    @jax.custom_vjp
+    def pipelined(params):
+        return run(params)[0]
+
+    def fwd(params):
+        return run(params)
+
+    def bwd(grads, ct):
+        return (jax.tree_util.tree_map(lambda g: (g * ct).astype(g.dtype), grads),)
+
+    pipelined.defvjp(fwd, bwd)
+    return pipelined(params)
 
 
 def last_stage_value(x: jax.Array, axis_name: str = "pipe") -> jax.Array:

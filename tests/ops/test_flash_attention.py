@@ -180,3 +180,52 @@ def test_bloom_with_flash_matches_plain():
     cfg_f = dataclasses.replace(cfg, use_flash=True)
     out = bloom.forward(params, ids, None, cfg_f)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("family", ["llama", "mixtral"])
+def test_rope_family_flash_matches_plain(family):
+    """use_flash=True for the RoPE families (zero ALiBi slopes, padding
+    via kv_neg) == the standard dense-mask path: loss and parameter
+    gradients on a PADDED batch."""
+    import dataclasses
+
+    from jax.flatten_util import ravel_pytree
+
+    if family == "llama":
+        from pipegoose_tpu.models import llama as mod
+
+        cfg = mod.LlamaConfig(
+            vocab_size=64, hidden_size=64, intermediate_size=112,
+            n_layer=2, n_head=4, n_kv_head=2,
+        )
+
+        def loss(p, ids, mask, c):
+            return mod.loss_fn(p, ids, mask, ids, c)
+    else:
+        from pipegoose_tpu.models import mixtral as mod
+
+        cfg = mod.MixtralConfig(
+            vocab_size=64, hidden_size=64, intermediate_size=112,
+            n_layer=2, n_head=4, n_kv_head=2, num_experts=4, top_k=2,
+        )
+
+        def loss(p, ids, mask, c):
+            return mod.loss_fn(p, ids, mask, ids, c, train=False)
+
+    params = mod.init_params(cfg, jax.random.PRNGKey(0))
+    ids = jnp.asarray(np.random.RandomState(0).randint(0, 64, (2, 32)))
+    mask = np.ones((2, 32), np.int32)
+    mask[0, 20:] = 0
+    mask[1, 27:] = 0
+    mask = jnp.asarray(mask)
+    cfg_f = dataclasses.replace(cfg, use_flash=True)
+
+    ref_loss, ref_g = jax.value_and_grad(loss)(params, ids, mask, cfg)
+    out_loss, out_g = jax.value_and_grad(loss)(params, ids, mask, cfg_f)
+    np.testing.assert_allclose(float(out_loss), float(ref_loss), rtol=2e-4)
+    fr, _ = ravel_pytree(ref_g)
+    fo, _ = ravel_pytree(out_g)
+    assert np.isfinite(np.asarray(fo)).all()
+    np.testing.assert_allclose(
+        np.asarray(fo), np.asarray(fr), rtol=5e-3, atol=1e-4
+    )
