@@ -518,7 +518,10 @@ def loss_fn_1f1b(
     from functools import partial as _partial
 
     from pipegoose_tpu.nn.pipeline_parallel import microbatch as mb
-    from pipegoose_tpu.nn.pipeline_parallel.pipeline import one_f_one_b
+    from pipegoose_tpu.nn.pipeline_parallel.pipeline import (
+        manual_grads_loss,
+        one_f_one_b,
+    )
 
     b, s = input_ids.shape
     if attention_mask is None:
@@ -580,8 +583,6 @@ def loss_fn_1f1b(
             "ln_f": d_head["ln_f"],
         }
         return loss, grads
-
-    from pipegoose_tpu.nn.pipeline_parallel.pipeline import manual_grads_loss
 
     return manual_grads_loss(run, params)
 
@@ -651,10 +652,7 @@ def loss_fn_sp(
     each chunk boundary arrives by one ppermute of the label chunk.
     Gradients of (seq-replicated) params are partial per rank — sum them
     over ``sp_axis`` (grad_sync_axes=(("seq","sum"),))."""
-    from pipegoose_tpu.distributed.functional import (
-        reduce_from_tensor_group,
-        shift_left,
-    )
+    from pipegoose_tpu.distributed.functional import reduce_from_tensor_group
 
     b, s_local = input_ids.shape
     if attention_mask is None:
@@ -663,20 +661,41 @@ def loss_fn_sp(
     x = embed_tokens(params, input_ids, config, tp_axis)
 
     def scan_fn(carry, blk):
-        h = carry
-        ln1 = layer_norm(blk["ln_1"], h, config.layer_norm_epsilon)
-        attn_blk = {"qkv": blk["attn"]["qkv"], "out": blk["attn"]["out"]}
-        h = h + _attention_sp(attn_blk, ln1, config, tp_axis, sp_axis, attention_mask)
-        return h + _mlp(blk, h, config, tp_axis), None
+        return _sp_block(blk, carry, config, tp_axis, sp_axis, attention_mask), None
 
     step = jax.checkpoint(scan_fn) if config.remat else scan_fn
     x, _ = jax.lax.scan(step, x, params["blocks"])
-    x = layer_norm(params["ln_f"], x, config.layer_norm_epsilon)
 
+    total, w_sum = _sp_head_sums(
+        params, x, attention_mask, labels, config, tp_axis, sp_axis
+    )
+    count = jax.lax.psum(w_sum, sp_axis)
+    # identity-backward combine: each rank's grads stay local and are
+    # psum'd over sp by the train step
+    return reduce_from_tensor_group(total / jnp.maximum(count, 1), sp_axis)
+
+
+def _sp_block(blk, h, config, tp_axis, sp_axis, pad_mask_local):
+    """One transformer block on sequence-sharded activations (shared by
+    the plain SP and the PP x SP compositions)."""
+    ln1 = layer_norm(blk["ln_1"], h, config.layer_norm_epsilon)
+    attn_blk = {"qkv": blk["attn"]["qkv"], "out": blk["attn"]["out"]}
+    h = h + _attention_sp(attn_blk, ln1, config, tp_axis, sp_axis, pad_mask_local)
+    return h + _mlp(blk, h, config, tp_axis)
+
+
+def _sp_head_sums(params, x, attention_mask, labels, config, tp_axis, sp_axis):
+    """Final LN -> logits -> SP-shifted CE sums. Returns the LOCAL
+    (weighted-loss sum, weight sum) for this sequence shard.
+
+    Global shift-by-one on a sharded sequence: within-chunk shift + the
+    first element of the NEXT chunk arrives by one ppermute of the label
+    chunk (the last rank's trailing target is padding-masked)."""
+    from pipegoose_tpu.distributed.functional import shift_left
+
+    x = layer_norm(params["ln_f"], x, config.layer_norm_epsilon)
     logits = logits_fn(params, x, tp_axis)  # (B, S_local, V/tp)
 
-    # global shift-by-one: within-chunk shift + first element of the NEXT
-    # chunk via ring (the last rank's trailing target is padding-masked)
     sp = jax.lax.axis_size(sp_axis)
     rank = jax.lax.axis_index(sp_axis)
     next_first_label = shift_left(labels[:, :1], sp_axis)  # (B, 1)
@@ -690,8 +709,60 @@ def loss_fn_sp(
         logits, shifted_labels, tp_axis, valid_size=config.valid_vocab_size
     )
     w = shifted_w.astype(per_tok.dtype)
-    total = (per_tok * w).sum()
-    count = jax.lax.psum(w.sum(), sp_axis)
-    # identity-backward combine: each rank's grads stay local and are
-    # psum'd over sp by the train step
-    return reduce_from_tensor_group(total / jnp.maximum(count, 1), sp_axis)
+    return (per_tok * w).sum(), w.sum()
+
+
+def loss_fn_pp_sp(
+    params: dict,
+    input_ids: jax.Array,  # (B, S_local) — sequence sharded over sp_axis
+    attention_mask: Optional[jax.Array],
+    labels: jax.Array,
+    config: BloomConfig,
+    n_microbatches: int,
+    tp_axis: Optional[str] = None,
+    pipe_axis: str = "pipe",
+    sp_axis: str = "seq",
+) -> jax.Array:
+    """Pipeline x sequence parallel composition: sequence-sharded
+    activations flow through the compiled GPipe schedule, with ring
+    attention running over the ``seq`` axis INSIDE each pipeline stage
+    (all sp peers of a stage advance in lockstep — uniform SPMD). This
+    is the long-context + deep-model shape neither axis covers alone.
+
+    Gradient sync for the hybrid step: ``grad_sync_axes=(("pipe","sum"),
+    ("seq","sum"))`` — replicated params get partial grads from both the
+    stage split and the sequence split."""
+    from pipegoose_tpu.distributed.functional import reduce_from_tensor_group
+    from pipegoose_tpu.nn.pipeline_parallel import microbatch as mb
+    from pipegoose_tpu.nn.pipeline_parallel.pipeline import gpipe, last_stage_value
+
+    b, s_local = input_ids.shape
+    if attention_mask is None:
+        attention_mask = jnp.ones((b, s_local), dtype=jnp.int32)
+
+    mbs = mb.split(
+        {"ids": input_ids, "mask": attention_mask, "labels": labels}, n_microbatches
+    )
+    h0 = jax.vmap(lambda ids: embed_tokens(params, ids, config, tp_axis))(mbs["ids"])
+    side = {"mask": mbs["mask"]}
+
+    def stage_fn(blocks, h, side):
+        def scan_fn(carry, blk):
+            return _sp_block(blk, carry, config, tp_axis, sp_axis, side["mask"]), None
+
+        h, _ = jax.lax.scan(scan_fn, h, blocks)
+        return h
+
+    outs = gpipe(
+        stage_fn, params["blocks"], h0, side_inputs=side,
+        axis_name=pipe_axis, remat=config.remat,
+    )
+
+    tot, cnt = jax.vmap(
+        lambda h, m, l: _sp_head_sums(params, h, m, l, config, tp_axis, sp_axis)
+    )(outs, mbs["mask"], mbs["labels"])
+    count = jax.lax.psum(cnt.sum(), sp_axis)
+    loss_local = reduce_from_tensor_group(
+        tot.sum() / jnp.maximum(count, 1), sp_axis
+    )
+    return last_stage_value(loss_local, pipe_axis)

@@ -125,8 +125,6 @@ def _block(blk, x, cos, sin, bias, config, tp_axis):
     return x + _mlp(blk["mlp"], h, tp_axis)
 
 
-
-
 def forward_hidden(
     params, input_ids, attention_mask, config, tp_axis: Optional[str] = None
 ):

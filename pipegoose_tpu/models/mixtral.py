@@ -173,8 +173,9 @@ def rope_attention_bias(attention_mask: jax.Array, config) -> dict:
     bias ``kv_neg`` (the causal mask lives inside the kernel); the
     standard path gets the dense (B, 1, S, S) ``mask_bias``."""
     if config.use_flash:
-        m = attention_mask.astype(jnp.float32)
-        return {"kv_neg": (1.0 - m) * NEG_INF}
+        from pipegoose_tpu.ops.flash_attention import mask_to_kv_bias
+
+        return {"kv_neg": mask_to_kv_bias(attention_mask)[1]}
     return {"mask_bias": causal_mask_bias(attention_mask)}
 
 
@@ -318,6 +319,59 @@ def loss_fn(params, input_ids, attention_mask, labels, config,
     )
 
 
+def _pp_prologue(
+    input_ids, attention_mask, labels, config, n_microbatches, pipe_axis, rng, train
+):
+    """Shared pipeline setup for the GPipe and 1F1B Mixtral losses:
+    validates the stage split, derives THIS stage's slice of the same
+    L-layer router keys the dense path uses, splits microbatches, and
+    builds the RoPE tables + per-microbatch attention bias (M-leading,
+    ready as gpipe/1F1B side inputs)."""
+    from pipegoose_tpu.nn.pipeline_parallel import microbatch as mb
+
+    b, s = input_ids.shape
+    if attention_mask is None:
+        attention_mask = jnp.ones((b, s), jnp.int32)
+
+    P_pipe = jax.lax.axis_size(pipe_axis)
+    L = config.n_layer
+    if L % P_pipe:
+        raise ValueError(
+            f"n_layer={L} must be divisible by the pipe axis size {P_pipe}"
+        )
+    L_local = L // P_pipe
+    stage = jax.lax.axis_index(pipe_axis)
+
+    if rng is None:
+        if train and config.router_jitter:
+            raise ValueError("train=True with router jitter needs an explicit rng")
+        rng = jax.random.PRNGKey(0)
+    layer_keys = jax.random.split(rng, L)  # (L, 2) — same keys as dense
+    local_keys = jax.lax.dynamic_slice_in_dim(layer_keys, stage * L_local, L_local, 0)
+
+    mbs = mb.split(
+        {"ids": input_ids, "mask": attention_mask, "labels": labels}, n_microbatches
+    )
+    cos, sin = rope_cos_sin(s, config.head_dim, config.rope_theta)
+    side = {"bias": jax.vmap(lambda m: rope_attention_bias(m, config))(mbs["mask"])}
+    return attention_mask, mbs, cos, sin, local_keys, L, side
+
+
+def _stage_scan(blocks, keys, h, bias, cos, sin, config, tp_axis, ep_axis, train):
+    """Scan this stage's local layer slice; returns (h, aux (L_local,),
+    z (L_local,)). Shared by the GPipe and 1F1B stage functions."""
+
+    def scan_fn(carry, blk_key):
+        blk, key = blk_key
+        out, aux, z = _block(
+            blk, carry, cos, sin, bias, key, config, tp_axis, ep_axis, train
+        )
+        return out, (aux, z)
+
+    h, (aux, z) = jax.lax.scan(scan_fn, h, (blocks, keys))
+    return h, aux, z
+
+
 def loss_fn_pp(
     params: dict,
     input_ids: jax.Array,
@@ -351,33 +405,12 @@ def loss_fn_pp(
       the layer mean; with M=1 the two coincide exactly).
     """
     from pipegoose_tpu.distributed.functional import reduce_from_tensor_group
-    from pipegoose_tpu.nn.pipeline_parallel import microbatch as mb
     from pipegoose_tpu.nn.pipeline_parallel.pipeline import gpipe, last_stage_value
 
-    b, s = input_ids.shape
-    if attention_mask is None:
-        attention_mask = jnp.ones((b, s), jnp.int32)
-
-    P_pipe = jax.lax.axis_size(pipe_axis)
-    L = config.n_layer
-    if L % P_pipe:
-        raise ValueError(
-            f"n_layer={L} must be divisible by the pipe axis size {P_pipe}"
-        )
-    L_local = L // P_pipe
-    stage = jax.lax.axis_index(pipe_axis)
-
-    if rng is None:
-        if train and config.router_jitter:
-            raise ValueError("train=True with router jitter needs an explicit rng")
-        rng = jax.random.PRNGKey(0)
-    layer_keys = jax.random.split(rng, L)  # (L, 2) — same keys as dense
-    local_keys = jax.lax.dynamic_slice_in_dim(layer_keys, stage * L_local, L_local, 0)
-
-    mbs = mb.split(
-        {"ids": input_ids, "mask": attention_mask, "labels": labels}, n_microbatches
-    )
     M = n_microbatches
+    attention_mask, mbs, cos, sin, local_keys, L, side = _pp_prologue(
+        input_ids, attention_mask, labels, config, M, pipe_axis, rng, train
+    )
 
     h0 = jax.vmap(
         lambda ids: vocab_parallel_embedding(params["embed"], ids, tp_axis).astype(
@@ -385,21 +418,11 @@ def loss_fn_pp(
         )
     )(mbs["ids"])
 
-    cos, sin = rope_cos_sin(s, config.head_dim, config.rope_theta)
-    side = {"bias": jax.vmap(lambda m: rope_attention_bias(m, config))(mbs["mask"])}
-
     def stage_fn(blocks_and_keys, h, side):
         blocks, keys = blocks_and_keys
-
-        def scan_fn(carry, blk_key):
-            blk, key = blk_key
-            out, aux, z = _block(
-                blk, carry, cos, sin, side["bias"], key,
-                config, tp_axis, ep_axis, train,
-            )
-            return out, (aux, z)
-
-        h, (aux, z) = jax.lax.scan(scan_fn, h, (blocks, keys))
+        h, aux, z = _stage_scan(
+            blocks, keys, h, side["bias"], cos, sin, config, tp_axis, ep_axis, train
+        )
         return h, (aux.sum(), z.sum())
 
     outs, (aux_sum, z_sum) = gpipe(
@@ -482,53 +505,25 @@ def loss_fn_1f1b(
     pre-weighted aux scalar seeds its OWN backward, so router gradients
     never cross stages, and the per-rank loss sums combine with one
     psum over the pipe axis."""
-    from pipegoose_tpu.nn.pipeline_parallel import microbatch as mb
-    from pipegoose_tpu.nn.pipeline_parallel.pipeline import one_f_one_b
-
-    b, s = input_ids.shape
-    if attention_mask is None:
-        attention_mask = jnp.ones((b, s), jnp.int32)
-
-    P_pipe = jax.lax.axis_size(pipe_axis)
-    L = config.n_layer
-    if L % P_pipe:
-        raise ValueError(
-            f"n_layer={L} must be divisible by the pipe axis size {P_pipe}"
-        )
-    L_local = L // P_pipe
-    stage = jax.lax.axis_index(pipe_axis)
-
-    if rng is None:
-        if train and config.router_jitter:
-            raise ValueError("train=True with router jitter needs an explicit rng")
-        rng = jax.random.PRNGKey(0)
-    layer_keys = jax.random.split(rng, L)
-    local_keys = jax.lax.dynamic_slice_in_dim(layer_keys, stage * L_local, L_local, 0)
+    from pipegoose_tpu.nn.pipeline_parallel.pipeline import (
+        manual_grads_loss,
+        one_f_one_b,
+    )
 
     M = n_microbatches
-    mbs = mb.split(
-        {"ids": input_ids, "mask": attention_mask, "labels": labels}, M
+    attention_mask, mbs, cos, sin, local_keys, L, side = _pp_prologue(
+        input_ids, attention_mask, labels, config, M, pipe_axis, rng, train
     )
-    cos, sin = rope_cos_sin(s, config.head_dim, config.rope_theta)
-    side = {
-        "bias": jax.vmap(lambda m: rope_attention_bias(m, config))(mbs["mask"]),
-        "labels": mbs["labels"],
-        "mask": mbs["mask"],
-    }
+    side = {**side, "labels": mbs["labels"], "mask": mbs["mask"]}
     inv_count = 1.0 / jnp.maximum(attention_mask[:, 1:].sum().astype(jnp.float32), 1)
 
     def stage_fn(blocks, h, side):
         # local_keys is closed over (constant for AD): integer key
         # arrays must not enter the differentiated stage_params pytree
-        def scan_fn(carry, blk_key):
-            blk, key = blk_key
-            out, aux, z = _block(
-                blk, carry, cos, sin, side["bias"], key,
-                config, tp_axis, ep_axis, train,
-            )
-            return out, (aux, z)
-
-        h, (aux, z) = jax.lax.scan(scan_fn, h, (blocks, local_keys))
+        h, aux, z = _stage_scan(
+            blocks, local_keys, h, side["bias"], cos, sin,
+            config, tp_axis, ep_axis, train,
+        )
         aux_scalar = (
             config.aux_loss_weight * aux.sum() + config.z_loss_weight * z.sum()
         ) / (L * M)
@@ -569,8 +564,6 @@ def loss_fn_1f1b(
             "lm_head": d_head["lm_head"],
         }
         return loss, grads
-
-    from pipegoose_tpu.nn.pipeline_parallel.pipeline import manual_grads_loss
 
     return manual_grads_loss(run, params)
 
