@@ -72,6 +72,19 @@ class Trainer:
         self.params = jax.jit(lambda t: t, out_shardings=out_shardings)(params)
         self._step_fn = make_step(params)
 
+        # lazily-built jitted eval step (loss only, no grads/update);
+        # evaluate() must run the SAME accumulated loss as training —
+        # n_accum exists because the un-microbatched forward doesn't fit
+        if n_accum > 1:
+            from pipegoose_tpu.core.accumulation import make_accumulating_loss
+
+            self._loss_fn = make_accumulating_loss(loss_fn, n_accum)
+        else:
+            self._loss_fn = loss_fn
+        self._batch_spec = batch_spec
+        self._loss_axis = loss_axis
+        self._eval_fn = None
+
         resumed = False
         if resume_dir is not None:
             # shapes only — materializing a full ZeRO state just to
@@ -109,6 +122,58 @@ class Trainer:
         self.state.step = step
         self.logger.info(f"resumed from {directory} at step {step}")
         return True
+
+    def evaluate(
+        self,
+        batches: Iterable[Any],
+        rng: Optional[jax.Array] = None,
+    ) -> float:
+        """Mean loss over ``batches`` with the CURRENT params — no
+        gradients, no optimizer update (the eval half the reference's
+        Trainer stub never got, trainer.py:13-35). Runs the same
+        sharded loss_fn as training, jitted once."""
+        if self._eval_fn is None:
+            from pipegoose_tpu.parallel.hybrid import shard_map  # jax<0.6-safe
+
+            in_specs = (self.param_specs, self._batch_spec) + (
+                (P(),) if self.with_rng else ()
+            )
+
+            def eval_step(params, batch, *rng):
+                loss = self._loss_fn(params, batch, *rng)
+                axes = (
+                    self._loss_axis
+                    if isinstance(self._loss_axis, tuple)
+                    else (self._loss_axis,)
+                )
+                for ax in axes:
+                    loss = jax.lax.pmean(loss, ax)
+                return loss
+
+            self._eval_fn = jax.jit(
+                shard_map(
+                    eval_step,
+                    mesh=self.parallel_context.mesh,
+                    in_specs=in_specs,
+                    out_specs=P(),
+                    check_vma=False,
+                )
+            )
+        if rng is None:
+            rng = jax.random.PRNGKey(0)
+        total, n = 0.0, 0
+        for i, batch in enumerate(batches):
+            args = (self.params, batch)
+            if self.with_rng:
+                args = args + (jax.random.fold_in(rng, i),)
+            total += float(self._eval_fn(*args))
+            n += 1
+        if n == 0:
+            raise ValueError(
+                "evaluate() received no batches (an exhausted generator?) — "
+                "0.0 would be indistinguishable from perfect convergence"
+            )
+        return total / n
 
     def fit(
         self,

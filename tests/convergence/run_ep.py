@@ -25,17 +25,37 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--steps", type=int, default=20)
     ap.add_argument("--tol", type=float, default=1e-2)
+    ap.add_argument(
+        "--model", choices=("toy", "large"), default="toy",
+        help="'large' widens to an 8-expert hidden-512 config",
+    )
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--seq", type=int, default=32)
+    ap.add_argument("--out", default=None, help="write a JSON run record")
+    ap.add_argument("--platform", choices=("auto", "cpu"), default="auto")
     args = ap.parse_args()
 
-    cfg = bloom_moe.BloomMoEConfig(
-        vocab_size=512, hidden_size=128, n_layer=2, n_head=8,
-        num_experts=4, top_k=1, capacity_factor=4.0, router_noise_eps=0.0,
-        aux_loss_weight=0.0,  # per-device aux is nonlinear across shards
-    )
+    if args.platform == "cpu":
+        from pipegoose_tpu.testing import force_cpu_devices
+
+        force_cpu_devices(8)
+
+    if args.model == "large":
+        cfg = bloom_moe.BloomMoEConfig(
+            vocab_size=8192, hidden_size=512, n_layer=6, n_head=8,
+            num_experts=8, top_k=2, capacity_factor=4.0, router_noise_eps=0.0,
+            aux_loss_weight=0.0,  # per-device aux is nonlinear across shards
+        )
+    else:
+        cfg = bloom_moe.BloomMoEConfig(
+            vocab_size=512, hidden_size=128, n_layer=2, n_head=8,
+            num_experts=4, top_k=1, capacity_factor=4.0, router_noise_eps=0.0,
+            aux_loss_weight=0.0,
+        )
     params = bloom_moe.init_params(cfg, jax.random.PRNGKey(0))
     rng = np.random.RandomState(1)
     batches = [
-        jnp.asarray(rng.randint(0, cfg.vocab_size, (8, 32)))
+        jnp.asarray(rng.randint(0, cfg.vocab_size, (args.batch, args.seq)))
         for _ in range(args.steps)
     ]
 
@@ -86,7 +106,13 @@ def main():
     sys.path.insert(0, __import__("os").path.dirname(__import__("os").path.abspath(__file__)))
     from _pairing import run_paired
 
-    run_paired(batches, ref_fn, par_fn, args.tol, names=("ref", "moe"))
+    run_paired(
+        batches, ref_fn, par_fn, args.tol, names=("ref", "moe"),
+        out_path=args.out,
+        meta={"model": args.model, "ep": 2, "tp": 2, "dp": 2,
+              "batch": args.batch, "seq": args.seq,
+              "backend": f"{jax.default_backend()}-{jax.device_count()}dev"},
+    )
 
 
 if __name__ == "__main__":

@@ -92,3 +92,34 @@ def test_checkpoint_and_resume(parts, tmp_path):
         ).max()
     )
     assert diff > 0
+
+
+def test_evaluate(parts):
+    """evaluate() returns the sharded mean loss without touching params,
+    and reflects training progress."""
+    cfg, params, ctx = parts
+
+    def loss_fn(p, ids):
+        return bloom.loss_fn(p, ids, None, ids, cfg, tp_axis="tensor")
+
+    trainer = Trainer(
+        loss_fn, params, bloom.tp_specs(params),
+        DistributedOptimizer(optax.adam(1e-2), axis_name="data"), ctx,
+    )
+    batches = _batches(cfg, 3)
+    before = trainer.evaluate(batches)
+    # matches the single-device loss on the same (replicated) batch
+    ref = float(bloom.loss_fn(params, batches[0], None, batches[0], cfg))
+    assert abs(before - ref) < 2e-4, (before, ref)
+
+    p_before = jax.tree_util.tree_map(np.asarray, trainer.params)
+    again = trainer.evaluate(batches)
+    assert again == before  # eval is pure: params unchanged
+    for (path, a), b in zip(
+        jax.tree_util.tree_leaves_with_path(p_before),
+        jax.tree_util.tree_leaves(trainer.params),
+    ):
+        np.testing.assert_array_equal(a, np.asarray(b), err_msg=str(path))
+
+    trainer.fit(_batches(cfg, 5), max_steps=5)
+    assert trainer.evaluate(batches) < before  # training reduced eval loss
