@@ -616,6 +616,7 @@ def _attention_sp(
     from pipegoose_tpu.nn.sequence_parallel.ring_attention import (
         make_causal_alibi_bias_fn,
         ring_attention,
+        ring_flash_attention,
     )
 
     b, s_local, _ = x.shape
@@ -632,8 +633,15 @@ def _attention_sp(
         h0 = jax.lax.axis_index(tp_axis) * local_heads
         slopes = jax.lax.dynamic_slice_in_dim(slopes, h0, local_heads, 0)
 
-    bias_fn = make_causal_alibi_bias_fn(s_local, sp_axis, alibi_slopes=slopes)
-    ctx = ring_attention(q, k, v, sp_axis, bias_fn, kv_side=pad_mask_local)
+    if config.use_flash:
+        # fused chunk kernel per ring step — no (S_local, S_local) score
+        # materialization in the forward
+        ctx = ring_flash_attention(
+            q, k, v, sp_axis, alibi_slopes=slopes, kv_side=pad_mask_local
+        )
+    else:
+        bias_fn = make_causal_alibi_bias_fn(s_local, sp_axis, alibi_slopes=slopes)
+        ctx = ring_attention(q, k, v, sp_axis, bias_fn, kv_side=pad_mask_local)
     ctx = ctx.reshape(b, s_local, local_heads * hd)
     return row_parallel_linear(blk["out"], ctx, tp_axis)
 

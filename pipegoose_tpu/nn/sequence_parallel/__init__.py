@@ -1,5 +1,6 @@
 from pipegoose_tpu.nn.sequence_parallel.ring_attention import (
     make_causal_alibi_bias_fn,
+    ring_flash_attention,
     ring_attention,
 )
 from pipegoose_tpu.nn.sequence_parallel.ulysses import ulysses_attention

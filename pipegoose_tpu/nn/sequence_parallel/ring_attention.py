@@ -34,6 +34,36 @@ from pipegoose_tpu.distributed.functional import shift_right
 NEG_INF = -1e9
 
 
+def _ring_scan(chunk_fn, state, k, v, kv_side, axis_name):
+    """Shared ring driver: apply ``chunk_fn(state, k_t, v_t, kv_rank,
+    side_t) -> state`` to the resident K/V chunk, rotate K/V (and the
+    optional side data) one hop, repeat sp times. The LAST chunk skips
+    the rotation — a rotation after the final block would be a dead
+    K+V transfer every layer (XLA can't DCE a collective feeding the
+    loop carry). Used by both the dense-math and flash ring paths so
+    the rotation/indexing subtleties live in exactly one place."""
+    sp = lax.axis_size(axis_name) if axis_name else 1
+    rank = lax.axis_index(axis_name) if axis_name else 0
+
+    if sp == 1:
+        return chunk_fn(state, k, v, jnp.asarray(0), kv_side)
+
+    def scan_fn(carry, t):
+        state, k_t, v_t, side_t = carry
+        kv_rank = (rank - t) % sp
+        state = chunk_fn(state, k_t, v_t, kv_rank, side_t)
+        k_t = shift_right(k_t, axis_name)
+        v_t = shift_right(v_t, axis_name)
+        if side_t is not None:
+            side_t = shift_right(side_t, axis_name)
+        return (state, k_t, v_t, side_t), None
+
+    (state, k_t, v_t, side_t), _ = lax.scan(
+        scan_fn, (state, k, v, kv_side), jnp.arange(sp - 1)
+    )
+    return chunk_fn(state, k_t, v_t, (rank - (sp - 1)) % sp, side_t)
+
+
 def ring_attention(
     q: jax.Array,  # (B, Sq_local, nh, hd)
     k: jax.Array,  # (B, Skv_local, nh, hd)
@@ -53,12 +83,11 @@ def ring_attention(
     b, sq, nh, hd = q.shape
     if scale is None:
         scale = hd**-0.5
-    sp = lax.axis_size(axis_name) if axis_name else 1
-    rank = lax.axis_index(axis_name) if axis_name else 0
 
     qf = q.astype(jnp.float32) * scale
 
-    def block(m, l, o, k_t, v_t, kv_rank, side_t):
+    def block(state, k_t, v_t, kv_rank, side_t):
+        m, l, o = state
         s = jnp.einsum("bqhd,bkhd->bhqk", qf, k_t.astype(jnp.float32))
         bias = bias_fn(kv_rank, side_t) if side_t is not None else bias_fn(kv_rank)
         s = s + bias
@@ -71,35 +100,87 @@ def ring_attention(
         o_new = o * alpha[..., None] + pv
         return m_new, l_new, o_new
 
-    m0 = jnp.full((b, nh, sq), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((b, nh, sq), jnp.float32)
-    o0 = jnp.zeros((b, nh, sq, hd), jnp.float32)
-
-    if sp == 1:
-        m, l, o = block(m0, l0, o0, k, v, jnp.asarray(0), kv_side)
-    else:
-        # sp-1 (block + rotate) steps, then a final block with NO rotation
-        # — a rotation after the last block would be a dead K+V transfer
-        # every layer (XLA can't DCE a collective feeding the loop carry)
-
-        def scan_fn(carry, t):
-            m, l, o, k_t, v_t, side_t = carry
-            kv_rank = (rank - t) % sp
-            m, l, o = block(m, l, o, k_t, v_t, kv_rank, side_t)
-            # rotate K/V (and side data) to the next rank
-            k_t = shift_right(k_t, axis_name)
-            v_t = shift_right(v_t, axis_name)
-            if side_t is not None:
-                side_t = shift_right(side_t, axis_name)
-            return (m, l, o, k_t, v_t, side_t), None
-
-        (m, l, o, k_t, v_t, side_t), _ = lax.scan(
-            scan_fn, (m0, l0, o0, k, v, kv_side), jnp.arange(sp - 1)
-        )
-        m, l, o = block(m, l, o, k_t, v_t, (rank - (sp - 1)) % sp, side_t)
+    state0 = (
+        jnp.full((b, nh, sq), NEG_INF, jnp.float32),
+        jnp.zeros((b, nh, sq), jnp.float32),
+        jnp.zeros((b, nh, sq, hd), jnp.float32),
+    )
+    m, l, o = _ring_scan(block, state0, k, v, kv_side, axis_name)
 
     out = o / jnp.maximum(l[..., None], 1e-30)
     return jnp.einsum("bhqd->bqhd", out).astype(q.dtype)
+
+
+def ring_flash_attention(
+    q: jax.Array,  # (B, S_local, nh, hd)
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: Optional[str],
+    alibi_slopes: Optional[jax.Array] = None,  # (nh,)
+    kv_side: Optional[jax.Array] = None,  # (B, S_local) pad mask, rides the ring
+    scale: Optional[float] = None,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Ring attention with the fused flash chunk kernel: per ring step
+    the resident K/V chunk is consumed by a Pallas kernel that updates
+    the online-softmax state in VMEM — the (S_local, S_local) score
+    block is never materialized in HBM (the plain :func:`ring_attention`
+    materializes it per step). Semantics match
+    ``ring_attention(..., make_causal_alibi_bias_fn(...))`` exactly:
+    causal on GLOBAL positions, ALiBi slope * global key position,
+    padding from the K/V chunk's mask. Backward rematerializes one dense
+    chunk at a time inside the reverse ring
+    (ops/flash_attention.py:flash_ring_chunk)."""
+    from pipegoose_tpu.ops.flash_attention import NEG_INF as _NEG_INF
+    from pipegoose_tpu.ops.flash_attention import flash_ring_chunk
+
+    b, s_local, nh, hd = q.shape
+    if scale is None:
+        scale = hd**-0.5
+    rank = lax.axis_index(axis_name) if axis_name else 0  # for global q positions
+    if alibi_slopes is None:
+        alibi_slopes = jnp.zeros((nh,), jnp.float32)
+
+    def flat(x):
+        return x.transpose(0, 2, 1, 3).reshape(b * nh, s_local, hd)
+
+    def flat_bs(x):  # (B, S) -> (B*nh, S)
+        return jnp.broadcast_to(
+            x.astype(jnp.float32)[:, None, :], (b, nh, s_local)
+        ).reshape(b * nh, s_local)
+
+    qf, kf, vf = flat(q), flat(k), flat(v)
+    slopes = jnp.broadcast_to(
+        alibi_slopes.astype(jnp.float32)[None], (b, nh)
+    ).reshape(b * nh)
+    qpos = jnp.broadcast_to(
+        (rank * s_local + jnp.arange(s_local, dtype=jnp.float32))[None],
+        (b * nh, s_local),
+    )
+    bh = b * nh
+    m0 = jnp.full((bh, s_local), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bh, s_local), jnp.float32)
+    acc0 = jnp.zeros((bh, s_local, hd), jnp.float32)
+
+    def chunk(state, k_t, v_t, kv_rank, side_t):
+        m, l, acc = state
+        kpos = jnp.broadcast_to(
+            (kv_rank * s_local + jnp.arange(s_local)).astype(jnp.float32)[None],
+            (bh, s_local),
+        )
+        if side_t is not None:
+            kneg = (1.0 - flat_bs(side_t)) * _NEG_INF
+        else:
+            kneg = jnp.zeros((bh, s_local), jnp.float32)
+        return flash_ring_chunk(
+            qf, k_t, v_t, slopes, qpos, kpos, kneg, m, l, acc,
+            float(scale), interpret,
+        )
+
+    m, l, acc = _ring_scan(chunk, (m0, l0, acc0), kf, vf, kv_side, axis_name)
+
+    out = acc / jnp.maximum(l[..., None], 1e-30)
+    return out.reshape(b, nh, s_local, hd).transpose(0, 2, 1, 3).astype(q.dtype)
 
 
 def make_causal_alibi_bias_fn(

@@ -131,7 +131,13 @@ class Trainer:
         """Mean loss over ``batches`` with the CURRENT params — no
         gradients, no optimizer update (the eval half the reference's
         Trainer stub never got, trainer.py:13-35). Runs the same
-        sharded loss_fn as training, jitted once."""
+        sharded loss_fn as training, jitted once.
+
+        Per-batch losses average with EQUAL weight; for attention-masked
+        batches with very different valid-token counts this is not the
+        corpus token-weighted mean (same caveat as
+        core/accumulation.py:make_accumulating_loss) — keep eval batches
+        comparably full or weight externally."""
         if self._eval_fn is None:
             from pipegoose_tpu.parallel.hybrid import shard_map  # jax<0.6-safe
 

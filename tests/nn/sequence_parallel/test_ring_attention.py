@@ -155,3 +155,103 @@ def test_ulysses_matches_full_attention(ctx):
     )
     out = fn(q, k, v)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-6)
+
+
+def test_ring_flash_matches_ring(ctx):
+    """Fused-chunk ring attention == plain ring attention (forward and
+    gradients), including ALiBi and a padded K/V chunk riding the ring."""
+    from pipegoose_tpu.models.bloom import alibi_slopes
+    from pipegoose_tpu.nn.sequence_parallel import ring_flash_attention
+
+    HDK = 64  # kernel-friendly head dim
+    ks = jax.random.split(jax.random.PRNGKey(9), 3)
+    q, k, v = (jax.random.normal(kk, (B, S, NH, HDK)) for kk in ks)
+    slopes = jnp.asarray(alibi_slopes(NH))
+    pad = np.ones((B, S), np.int32)
+    pad[0, -6:] = 0
+    pad = jnp.asarray(pad)
+    w = pad.astype(jnp.float32)[:, :, None, None]
+
+    def make(kind, with_loss):
+        def body(q, k, v, pad, w_local):
+            if kind == "flash":
+                o = ring_flash_attention(
+                    q, k, v, "seq", alibi_slopes=slopes, kv_side=pad,
+                    interpret=True,
+                )
+            else:
+                bias_fn = make_causal_alibi_bias_fn(
+                    S_LOCAL, "seq", alibi_slopes=slopes
+                )
+                o = ring_attention(q, k, v, "seq", bias_fn, kv_side=pad)
+            if with_loss:
+                return jax.lax.psum(((o * w_local) ** 2).sum(), "seq")
+            return o
+
+        return shard_map(
+            body, mesh=ctx.mesh,
+            in_specs=(P(None, "seq"),) * 5,
+            out_specs=P() if with_loss else P(None, "seq"),
+            check_vma=False,
+        )
+
+    out_ref = make("ring", False)(q, k, v, pad, w)
+    out_flash = make("flash", False)(q, k, v, pad, w)
+    valid = np.asarray(pad, bool)
+    np.testing.assert_allclose(
+        np.asarray(out_flash)[valid], np.asarray(out_ref)[valid],
+        rtol=2e-5, atol=2e-6,
+    )
+
+    g_ref = jax.grad(
+        lambda q, k, v: make("ring", True)(q, k, v, pad, w), argnums=(0, 1, 2)
+    )(q, k, v)
+    g_flash = jax.grad(
+        lambda q, k, v: make("flash", True)(q, k, v, pad, w), argnums=(0, 1, 2)
+    )(q, k, v)
+    for a, b, name in zip(g_flash, g_ref, "qkv"):
+        assert np.isfinite(np.asarray(a)).all(), name
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5, err_msg=name
+        )
+
+
+def test_bloom_sp_flash_matches_plain(ctx):
+    """bloom loss_fn_sp with use_flash (ring_flash_attention inside the
+    blocks) == the plain ring path: loss + grads on the sp mesh."""
+    import dataclasses
+
+    from pipegoose_tpu.models import bloom
+    from pipegoose_tpu.parallel.hybrid import sync_replicated_grads
+
+    cfg = bloom.BloomConfig(vocab_size=64, hidden_size=128, n_layer=2, n_head=2)
+    cfg_f = dataclasses.replace(cfg, use_flash=True)
+    params = bloom.init_params(cfg, jax.random.PRNGKey(0))
+    ids = jnp.asarray(np.random.RandomState(3).randint(0, 64, (2, S)))
+    specs = bloom.tp_specs(params)
+
+    def run(c):
+        def grad_fn(p, i):
+            loss, g = jax.value_and_grad(
+                lambda p: bloom.loss_fn_sp(p, i, None, i, c, sp_axis="seq")
+            )(p)
+            return loss, sync_replicated_grads(g, specs, (("seq", "sum"),))
+
+        return jax.jit(
+            shard_map(
+                grad_fn, mesh=ctx.mesh,
+                in_specs=(specs, P(None, "seq")),
+                out_specs=(P(), specs),
+                check_vma=False,
+            )
+        )(params, ids)
+
+    loss_ref, g_ref = run(cfg)
+    loss_f, g_f = run(cfg_f)
+    np.testing.assert_allclose(float(loss_f), float(loss_ref), rtol=2e-4)
+    for (path, a), b in zip(
+        jax.tree_util.tree_leaves_with_path(g_ref), jax.tree_util.tree_leaves(g_f)
+    ):
+        np.testing.assert_allclose(
+            np.asarray(b), np.asarray(a), rtol=5e-3, atol=1e-4, err_msg=str(path)
+        )
