@@ -50,7 +50,8 @@ class LlamaConfig:
     tie_word_embeddings: bool = False
     dtype: Any = jnp.float32
     remat: bool = False
-    # fused Pallas flash attention after RoPE + GQA repetition
+    # fused Pallas flash attention after RoPE; GQA served natively by
+    # the kernel's grouped K/V index maps (no head repetition)
     use_flash: bool = False
     valid_vocab_size: Optional[int] = None
 

@@ -58,8 +58,9 @@ class MixtralConfig:
     dtype: Any = jnp.float32
     remat: bool = False
     # fused Pallas flash attention (ops/flash_attention.py): applied
-    # after RoPE + GQA head repetition, zero ALiBi slopes, padding via
-    # the kernel's kv_neg bias input
+    # after RoPE, zero ALiBi slopes, padding via the kernel's kv_neg
+    # bias input; GQA served natively (grouped K/V index maps, no head
+    # repetition)
     use_flash: bool = False
     # set when the embedding/head was padded for TP divisibility: the
     # true vocab size; padded logit slots are masked out of CE + decode
@@ -221,20 +222,22 @@ def _attention(blk, x, cos, sin, bias, config, tp_axis):
     k = column_parallel_linear(blk["k"], x, tp_axis).reshape(b, s, nkv_l, hd)
     v = column_parallel_linear(blk["v"], x, tp_axis).reshape(b, s, nkv_l, hd)
     q, k = apply_rope(q, k, cos, sin)
-    # GQA: repeat kv heads (a grouped kernel that reads the nkv-wide
-    # K/V directly is a future optimization of the flash path)
-    k = jnp.repeat(k, groups, axis=2)
-    v = jnp.repeat(v, groups, axis=2)
 
     if config.use_flash:
         from pipegoose_tpu.ops.flash_attention import flash_attention
 
+        # native GQA: the kernel reads the nkv-wide K/V via grouped
+        # index maps — no head repetition, g x less KV traffic
         ctx = flash_attention(
             q, k, v, alibi_slopes=None,  # RoPE: no ALiBi term
             kv_neg=bias["kv_neg"], causal=True,
         )
         ctx = ctx.astype(x.dtype).reshape(b, s, nh_l * hd)
         return row_parallel_linear(blk["o"], ctx, tp_axis)
+
+    # GQA: repeat kv heads for the dense einsum path
+    k = jnp.repeat(k, groups, axis=2)
+    v = jnp.repeat(v, groups, axis=2)
 
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
     scores = scores * (hd**-0.5) + bias["mask_bias"]

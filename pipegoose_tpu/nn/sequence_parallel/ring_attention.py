@@ -22,6 +22,7 @@ block global positions are reconstructed from the rank indices.
 """
 from __future__ import annotations
 
+import functools
 from functools import partial
 from typing import Callable, Optional
 
@@ -121,23 +122,28 @@ def ring_flash_attention(
     scale: Optional[float] = None,
     interpret: Optional[bool] = None,
 ) -> jax.Array:
-    """Ring attention with the fused flash chunk kernel: per ring step
-    the resident K/V chunk is consumed by a Pallas kernel that updates
-    the online-softmax state in VMEM — the (S_local, S_local) score
-    block is never materialized in HBM (the plain :func:`ring_attention`
-    materializes it per step). Semantics match
-    ``ring_attention(..., make_causal_alibi_bias_fn(...))`` exactly:
-    causal on GLOBAL positions, ALiBi slope * global key position,
-    padding from the K/V chunk's mask. Backward rematerializes one dense
-    chunk at a time inside the reverse ring
-    (ops/flash_attention.py:flash_ring_chunk)."""
-    from pipegoose_tpu.ops.flash_attention import NEG_INF as _NEG_INF
-    from pipegoose_tpu.ops.flash_attention import flash_ring_chunk
+    """Ring attention with fused flash chunks, forward AND backward.
 
+    Forward: per ring step the resident K/V chunk updates the
+    online-softmax state inside a Pallas kernel — the (S_local, S_local)
+    score block is never materialized in HBM (the plain
+    :func:`ring_attention` materializes it per step), and NO per-step
+    residuals are stacked (the plain ring's reverse-mode AD saves every
+    rotated K/V copy — sp x the local K/V — plus per-step state).
+
+    Backward: a SECOND gradient ring. With the final logsumexp, the
+    flash backward identity p = exp(s - lse) holds globally, so each
+    chunk's dQ adds locally while dK/dV contribution accumulators ride
+    the ring alongside K/V and arrive home after a full rotation.
+    Residual memory is O(S_local) per layer: q, k, v, out, lse.
+
+    Semantics match ``ring_attention(..., make_causal_alibi_bias_fn)``
+    exactly: causal on GLOBAL positions, ALiBi slope * global key
+    position, padding from the chunk's mask.
+    """
     b, s_local, nh, hd = q.shape
     if scale is None:
         scale = hd**-0.5
-    rank = lax.axis_index(axis_name) if axis_name else 0  # for global q positions
     if alibi_slopes is None:
         alibi_slopes = jnp.zeros((nh,), jnp.float32)
 
@@ -149,38 +155,139 @@ def ring_flash_attention(
             x.astype(jnp.float32)[:, None, :], (b, nh, s_local)
         ).reshape(b * nh, s_local)
 
-    qf, kf, vf = flat(q), flat(k), flat(v)
     slopes = jnp.broadcast_to(
         alibi_slopes.astype(jnp.float32)[None], (b, nh)
     ).reshape(b * nh)
+    # the pad bias rides the ring PER BATCH (B, S_local) — broadcasting
+    # to (B*nh, S_local) happens per chunk call, not per hop
+    if kv_side is not None:
+        kneg = (1.0 - kv_side.astype(jnp.float32)) * NEG_INF
+    else:
+        kneg = jnp.zeros((b, s_local), jnp.float32)
+
+    out = _ring_flash(
+        flat(q), flat(k), flat(v), slopes, kneg,
+        axis_name, float(scale), interpret,
+    )
+    return out.reshape(b, nh, s_local, hd).transpose(0, 2, 1, 3).astype(q.dtype)
+
+
+def _ring_positions(axis_name, bh, s_local):
+    rank = lax.axis_index(axis_name) if axis_name else 0
     qpos = jnp.broadcast_to(
         (rank * s_local + jnp.arange(s_local, dtype=jnp.float32))[None],
-        (b * nh, s_local),
+        (bh, s_local),
     )
-    bh = b * nh
-    m0 = jnp.full((bh, s_local), _NEG_INF, jnp.float32)
-    l0 = jnp.zeros((bh, s_local), jnp.float32)
-    acc0 = jnp.zeros((bh, s_local, hd), jnp.float32)
+    return rank, qpos
 
-    def chunk(state, k_t, v_t, kv_rank, side_t):
+
+def _kpos_for(kv_rank, bh, s_local):
+    return jnp.broadcast_to(
+        (kv_rank * s_local + jnp.arange(s_local)).astype(jnp.float32)[None],
+        (bh, s_local),
+    )
+
+
+def _expand_heads(x_b, bh):
+    """(B, S) per-batch array -> (B*nh, S) for the flat kernel layout."""
+    b, s = x_b.shape
+    nh = bh // b
+    return jnp.broadcast_to(x_b[:, None, :], (b, nh, s)).reshape(bh, s)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
+def _ring_flash(q, k, v, slopes, kneg, axis_name, scale, interpret):
+    out, _ = _ring_flash_fwd_pass(q, k, v, slopes, kneg, axis_name, scale, interpret)
+    return out
+
+
+def _ring_flash_fwd_pass(q, k, v, slopes, kneg, axis_name, scale, interpret):
+    from pipegoose_tpu.ops.flash_attention import flash_ring_chunk
+
+    bh, s_local, hd = q.shape
+    _, qpos = _ring_positions(axis_name, bh, s_local)
+    state0 = (
+        jnp.full((bh, s_local), NEG_INF, jnp.float32),
+        jnp.zeros((bh, s_local), jnp.float32),
+        jnp.zeros((bh, s_local, hd), jnp.float32),
+    )
+
+    def chunk(state, k_t, v_t, kv_rank, kneg_t):
         m, l, acc = state
-        kpos = jnp.broadcast_to(
-            (kv_rank * s_local + jnp.arange(s_local)).astype(jnp.float32)[None],
-            (bh, s_local),
-        )
-        if side_t is not None:
-            kneg = (1.0 - flat_bs(side_t)) * _NEG_INF
-        else:
-            kneg = jnp.zeros((bh, s_local), jnp.float32)
         return flash_ring_chunk(
-            qf, k_t, v_t, slopes, qpos, kpos, kneg, m, l, acc,
-            float(scale), interpret,
+            q, k_t, v_t, slopes, qpos, _kpos_for(kv_rank, bh, s_local),
+            _expand_heads(kneg_t, bh), m, l, acc, scale, interpret,
         )
 
-    m, l, acc = _ring_scan(chunk, (m0, l0, acc0), kf, vf, kv_side, axis_name)
+    m, l, acc = _ring_scan(chunk, state0, k, v, kneg, axis_name)
+    l = jnp.maximum(l, 1e-30)
+    out = (acc / l[..., None]).astype(q.dtype)
+    lse = m + jnp.log(l)
+    return out, lse
 
-    out = acc / jnp.maximum(l[..., None], 1e-30)
-    return out.reshape(b, nh, s_local, hd).transpose(0, 2, 1, 3).astype(q.dtype)
+
+def _ring_flash_vjp_fwd(q, k, v, slopes, kneg, axis_name, scale, interpret):
+    out, lse = _ring_flash_fwd_pass(
+        q, k, v, slopes, kneg, axis_name, scale, interpret
+    )
+    # O(S_local) residuals only — no per-ring-step stacking
+    return out, (q, k, v, slopes, kneg, out, lse)
+
+
+def _ring_flash_vjp_bwd(axis_name, scale, interpret, res, dout):
+    from pipegoose_tpu.ops.flash_attention import flash_chunk_dq, flash_chunk_dkv
+
+    q, k, v, slopes, kneg, out, lse = res
+    bh, s_local, hd = q.shape
+    rank, qpos = _ring_positions(axis_name, bh, s_local)
+    sp = lax.axis_size(axis_name) if axis_name else 1
+    delta = (dout.astype(jnp.float32) * out.astype(jnp.float32)).sum(-1)
+
+    def contributions(dq, dk, dv, k_t, v_t, kneg_t, t):
+        kv_rank = (rank - t) % sp
+        kpos = _kpos_for(kv_rank, bh, s_local)
+        kneg_h = _expand_heads(kneg_t, bh)
+        dq = dq + flash_chunk_dq(
+            q, k_t, v_t, dout, lse, delta, slopes, qpos, kpos, kneg_h,
+            scale, interpret,
+        )
+        dkc, dvc = flash_chunk_dkv(
+            q, k_t, v_t, dout, lse, delta, slopes, qpos, kpos, kneg_h,
+            scale, interpret,
+        )
+        return dq, dk + dkc, dv + dvc
+
+    def step(carry, t):
+        k_t, v_t, kneg_t, dk, dv, dq = carry
+        dq, dk, dv = contributions(dq, dk, dv, k_t, v_t, kneg_t, t)
+        # the dK/dV accumulators ride with their chunk toward home
+        k_t = shift_right(k_t, axis_name) if axis_name else k_t
+        v_t = shift_right(v_t, axis_name) if axis_name else v_t
+        kneg_t = shift_right(kneg_t, axis_name) if axis_name else kneg_t
+        dk = shift_right(dk, axis_name) if axis_name else dk
+        dv = shift_right(dv, axis_name) if axis_name else dv
+        return (k_t, v_t, kneg_t, dk, dv, dq), None
+
+    zeros_kv = jnp.zeros((bh, s_local, hd), jnp.float32)
+    dq0 = jnp.zeros((bh, s_local, hd), jnp.float32)
+    if sp == 1:
+        dq, dk, dv = contributions(dq0, zeros_kv, zeros_kv, k, v, kneg, 0)
+    else:
+        # sp-1 full steps, then a final step that ships ONLY the dK/dV
+        # accumulators home — rotating k/v/kneg on the last step would be
+        # a dead collective per layer (same rationale as the forward
+        # _ring_scan's skipped last rotation)
+        (k_t, v_t, kneg_t, dk, dv, dq), _ = lax.scan(
+            step, (k, v, kneg, zeros_kv, zeros_kv, dq0), jnp.arange(sp - 1)
+        )
+        dq, dk, dv = contributions(dq, dk, dv, k_t, v_t, kneg_t, sp - 1)
+        dk = shift_right(dk, axis_name)
+        dv = shift_right(dv, axis_name)
+    return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype),
+            jnp.zeros_like(slopes), jnp.zeros_like(kneg))
+
+
+_ring_flash.defvjp(_ring_flash_vjp_fwd, _ring_flash_vjp_bwd)
 
 
 def make_causal_alibi_bias_fn(

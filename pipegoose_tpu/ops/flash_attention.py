@@ -69,11 +69,14 @@ def _bias_block(slope, kpos_ref, kneg_ref, q_start, k_start, block_q, block_k, c
 
 
 def _flash_fwd_pallas(q, k, v, slopes, kpos, kneg, scale, causal,
-                      block_q, block_k, interpret):
+                      block_q, block_k, interpret, g=1):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    bh, s, hd = q.shape  # (batch*heads, seq, head_dim)
+    bh, s, hd = q.shape  # (batch*query_heads, seq, head_dim)
+    # GQA: k/v (and their per-key biases) carry batch*kv_heads rows and
+    # are shared by g query heads each via the index maps — never
+    # repeated in HBM (g=1 is plain MHA)
     nq, nk = s // block_q, s // block_k
 
     def kernel(slope_ref, q_ref, k_ref, v_ref, kpos_ref, kneg_ref,
@@ -131,10 +134,10 @@ def _flash_fwd_pallas(q, k, v, slopes, kpos, kneg, scale, causal,
             in_specs=[
                 pl.BlockSpec((1,), lambda b, i, j: (b,), memory_space=pltpu.SMEM),
                 pl.BlockSpec((1, block_q, hd), lambda b, i, j: (b, i, 0)),
-                pl.BlockSpec((1, block_k, hd), lambda b, i, j: (b, j, 0)),
-                pl.BlockSpec((1, block_k, hd), lambda b, i, j: (b, j, 0)),
-                pl.BlockSpec((1, block_k), lambda b, i, j: (b, j)),
-                pl.BlockSpec((1, block_k), lambda b, i, j: (b, j)),
+                pl.BlockSpec((1, block_k, hd), lambda b, i, j: (b // g, j, 0)),
+                pl.BlockSpec((1, block_k, hd), lambda b, i, j: (b // g, j, 0)),
+                pl.BlockSpec((1, block_k), lambda b, i, j: (b // g, j)),
+                pl.BlockSpec((1, block_k), lambda b, i, j: (b // g, j)),
             ],
             out_specs=[
                 pl.BlockSpec((1, block_q, hd), lambda b, i, j: (b, i, 0)),
@@ -159,7 +162,7 @@ def _flash_fwd_pallas(q, k, v, slopes, kpos, kneg, scale, causal,
 
 
 def _flash_dq_pallas(q, k, v, do, lse, delta, slopes, kpos, kneg,
-                     scale, causal, block_q, block_k, interpret):
+                     scale, causal, block_q, block_k, interpret, g=1):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -216,13 +219,13 @@ def _flash_dq_pallas(q, k, v, do, lse, delta, slopes, kpos, kneg,
             in_specs=[
                 pl.BlockSpec((1,), lambda b, i, j: (b,), memory_space=pltpu.SMEM),
                 pl.BlockSpec((1, block_q, hd), lambda b, i, j: (b, i, 0)),
-                pl.BlockSpec((1, block_k, hd), lambda b, i, j: (b, j, 0)),
-                pl.BlockSpec((1, block_k, hd), lambda b, i, j: (b, j, 0)),
+                pl.BlockSpec((1, block_k, hd), lambda b, i, j: (b // g, j, 0)),
+                pl.BlockSpec((1, block_k, hd), lambda b, i, j: (b // g, j, 0)),
                 pl.BlockSpec((1, block_q, hd), lambda b, i, j: (b, i, 0)),
                 pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
                 pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
-                pl.BlockSpec((1, block_k), lambda b, i, j: (b, j)),
-                pl.BlockSpec((1, block_k), lambda b, i, j: (b, j)),
+                pl.BlockSpec((1, block_k), lambda b, i, j: (b // g, j)),
+                pl.BlockSpec((1, block_k), lambda b, i, j: (b // g, j)),
             ],
             out_specs=pl.BlockSpec((1, block_q, hd), lambda b, i, j: (b, i, 0)),
             scratch_shapes=[pltpu.VMEM((block_q, hd), jnp.float32)],
@@ -236,11 +239,14 @@ def _flash_dq_pallas(q, k, v, do, lse, delta, slopes, kpos, kneg,
 
 
 def _flash_dkv_pallas(q, k, v, do, lse, delta, slopes, kpos, kneg,
-                      scale, causal, block_q, block_k, interpret):
+                      scale, causal, block_q, block_k, interpret, g=1):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     bh, s, hd = q.shape
+    # outputs are PER QUERY HEAD (b*nh rows) even under GQA — the caller
+    # sums the g group contributions into the (b*nkv)-row dk/dv (a write
+    # race inside the kernel is not expressible; the XLA sum is fused)
     nq, nk = s // block_q, s // block_k
 
     def kernel(slope_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
@@ -299,13 +305,13 @@ def _flash_dkv_pallas(q, k, v, do, lse, delta, slopes, kpos, kneg,
             in_specs=[
                 pl.BlockSpec((1,), lambda b, j, i: (b,), memory_space=pltpu.SMEM),
                 pl.BlockSpec((1, block_q, hd), lambda b, j, i: (b, i, 0)),
-                pl.BlockSpec((1, block_k, hd), lambda b, j, i: (b, j, 0)),
-                pl.BlockSpec((1, block_k, hd), lambda b, j, i: (b, j, 0)),
+                pl.BlockSpec((1, block_k, hd), lambda b, j, i: (b // g, j, 0)),
+                pl.BlockSpec((1, block_k, hd), lambda b, j, i: (b // g, j, 0)),
                 pl.BlockSpec((1, block_q, hd), lambda b, j, i: (b, i, 0)),
                 pl.BlockSpec((1, block_q), lambda b, j, i: (b, i)),
                 pl.BlockSpec((1, block_q), lambda b, j, i: (b, i)),
-                pl.BlockSpec((1, block_k), lambda b, j, i: (b, j)),
-                pl.BlockSpec((1, block_k), lambda b, j, i: (b, j)),
+                pl.BlockSpec((1, block_k), lambda b, j, i: (b // g, j)),
+                pl.BlockSpec((1, block_k), lambda b, j, i: (b // g, j)),
             ],
             out_specs=[
                 pl.BlockSpec((1, block_k, hd), lambda b, j, i: (b, j, 0)),
@@ -317,8 +323,8 @@ def _flash_dkv_pallas(q, k, v, do, lse, delta, slopes, kpos, kneg,
             ],
         ),
         out_shape=[
-            jax.ShapeDtypeStruct(k.shape, k.dtype),
-            jax.ShapeDtypeStruct(v.shape, v.dtype),
+            jax.ShapeDtypeStruct((bh, s, hd), k.dtype),
+            jax.ShapeDtypeStruct((bh, s, hd), v.dtype),
         ],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
@@ -450,14 +456,16 @@ def _xla_chunk(q, k, v, slopes, qpos, kpos, kneg, m, l, acc, scale):
     return m_new, l_new, acc_new
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(10, 11))
 def flash_ring_chunk(q, k, v, slopes, qpos, kpos, kneg, m, l, acc,
                      scale, interpret):
-    """One ring step of flash attention: fused Pallas forward over the
-    resident K/V chunk (no (Sq, Skv) score materialization), dense
-    rematerialized backward per chunk (transient, one block at a time —
-    exactly what the reverse ring scan replays). All arrays are in the
-    flattened (batch*heads, seq, head_dim) layout; state is f32."""
+    """One FORWARD ring step of flash attention: fused Pallas update of
+    the online-softmax state over the resident K/V chunk (no (Sq, Skv)
+    score materialization). NOT differentiable on its own — the ring
+    owns the backward (see nn/sequence_parallel/ring_attention.py:
+    ring_flash_attention, which runs a second gradient ring using
+    flash_chunk_dq / flash_chunk_dkv with the FINAL logsumexp), so no
+    per-step residuals are stacked by the forward scan. All arrays are
+    in the flattened (batch*heads, seq, head_dim) layout; state is f32."""
     interpret = _resolve_interpret(interpret)
     bq, bk = _pick_block(q.shape[1]), _pick_block(k.shape[1])
     return _flash_chunk_pallas(
@@ -465,29 +473,193 @@ def flash_ring_chunk(q, k, v, slopes, qpos, kpos, kneg, m, l, acc,
     )
 
 
-def _flash_ring_chunk_fwd(q, k, v, slopes, qpos, kpos, kneg, m, l, acc,
-                          scale, interpret):
-    out = flash_ring_chunk(q, k, v, slopes, qpos, kpos, kneg, m, l, acc,
-                           scale, interpret)
-    return out, (q, k, v, slopes, qpos, kpos, kneg, m, l, acc)
+def _chunk_dq_pallas(q, k, v, do, lse, delta, slopes, qpos, kpos, kneg,
+                     scale, block_q, block_k, interpret):
+    """dQ contribution of ONE ring chunk, from the FINAL logsumexp (the
+    standard flash backward identity p = exp(s - lse) holds globally, so
+    per-chunk contributions just add). Position-array causal mask with a
+    value-based fully-future block skip, like the forward chunk."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
 
+    bh, sq, hd = q.shape
+    skv = k.shape[1]
+    nq, nk = sq // block_q, skv // block_k
 
-def _flash_ring_chunk_bwd(scale, interpret, res, cts):
-    q, k, v, slopes, qpos, kpos, kneg, m, l, acc = res
-    _, vjp = jax.vjp(
-        lambda q, k, v, m, l, acc: _xla_chunk(
-            q, k, v, slopes, qpos, kpos, kneg, m, l, acc, scale
+    def kernel(slope_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+               qpos_ref, kpos_ref, kneg_ref, dq_ref, dq_sc):
+        ki = pl.program_id(2)
+
+        @pl.when(ki == 0)
+        def _init():
+            dq_sc[:] = jnp.zeros_like(dq_sc)
+
+        qp = qpos_ref[0].astype(jnp.float32)
+        kp = kpos_ref[0].astype(jnp.float32)
+
+        @pl.when(jnp.min(kp) <= jnp.max(qp))
+        def _compute():
+            qb = q_ref[0].astype(jnp.float32)
+            kb = k_ref[0].astype(jnp.float32)
+            vb = v_ref[0].astype(jnp.float32)
+            dob = do_ref[0].astype(jnp.float32)
+            s_blk = jax.lax.dot_general(
+                qb, kb, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * scale
+            s_blk = s_blk + slope_ref[0] * kp[None, :] + kneg_ref[0][None, :]
+            s_blk = s_blk + jnp.where(kp[None, :] <= qp[:, None], 0.0, NEG_INF)
+            p = jnp.exp(s_blk - lse_ref[0][:, None])
+            dp = jax.lax.dot_general(
+                dob, vb, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            ds = p * (dp - delta_ref[0][:, None])
+            dq_sc[:] += scale * jax.lax.dot_general(
+                ds, kb, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+
+        @pl.when(ki == nk - 1)
+        def _finish():
+            dq_ref[0] = dq_sc[:]
+
+    grid = (bh, nq, nk)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=0,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1,), lambda b, i, j: (b,), memory_space=pltpu.SMEM),
+                pl.BlockSpec((1, block_q, hd), lambda b, i, j: (b, i, 0)),
+                pl.BlockSpec((1, block_k, hd), lambda b, i, j: (b, j, 0)),
+                pl.BlockSpec((1, block_k, hd), lambda b, i, j: (b, j, 0)),
+                pl.BlockSpec((1, block_q, hd), lambda b, i, j: (b, i, 0)),
+                pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+                pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+                pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+                pl.BlockSpec((1, block_k), lambda b, i, j: (b, j)),
+                pl.BlockSpec((1, block_k), lambda b, i, j: (b, j)),
+            ],
+            out_specs=pl.BlockSpec((1, block_q, hd), lambda b, i, j: (b, i, 0)),
+            scratch_shapes=[pltpu.VMEM((block_q, hd), jnp.float32)],
         ),
-        q, k, v, m, l, acc,
-    )
-    dq, dk, dv, dm, dl, dacc = vjp(cts)
-    zeros = jnp.zeros_like
-    return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype),
-            zeros(slopes), zeros(qpos), zeros(kpos), zeros(kneg),
-            dm, dl, dacc)
+        out_shape=jax.ShapeDtypeStruct((bh, sq, hd), jnp.float32),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(slopes, q, k, v, do, lse, delta, qpos, kpos, kneg)
 
 
-flash_ring_chunk.defvjp(_flash_ring_chunk_fwd, _flash_ring_chunk_bwd)
+def _chunk_dkv_pallas(q, k, v, do, lse, delta, slopes, qpos, kpos, kneg,
+                      scale, block_q, block_k, interpret):
+    """dK/dV contributions of ONE ring chunk from THIS rank's queries
+    (accumulated into ring-riding gradient carriers by the caller)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    bh, sq, hd = q.shape
+    skv = k.shape[1]
+    nq, nk = sq // block_q, skv // block_k
+
+    def kernel(slope_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+               qpos_ref, kpos_ref, kneg_ref, dk_ref, dv_ref, dk_sc, dv_sc):
+        qi = pl.program_id(2)
+
+        @pl.when(qi == 0)
+        def _init():
+            dk_sc[:] = jnp.zeros_like(dk_sc)
+            dv_sc[:] = jnp.zeros_like(dv_sc)
+
+        qp = qpos_ref[0].astype(jnp.float32)
+        kp = kpos_ref[0].astype(jnp.float32)
+
+        @pl.when(jnp.min(kp) <= jnp.max(qp))
+        def _compute():
+            qb = q_ref[0].astype(jnp.float32)
+            kb = k_ref[0].astype(jnp.float32)
+            vb = v_ref[0].astype(jnp.float32)
+            dob = do_ref[0].astype(jnp.float32)
+            s_blk = jax.lax.dot_general(
+                qb, kb, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * scale
+            s_blk = s_blk + slope_ref[0] * kp[None, :] + kneg_ref[0][None, :]
+            s_blk = s_blk + jnp.where(kp[None, :] <= qp[:, None], 0.0, NEG_INF)
+            p = jnp.exp(s_blk - lse_ref[0][:, None])
+            dv_sc[:] += jax.lax.dot_general(
+                p, dob, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            dp = jax.lax.dot_general(
+                dob, vb, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            ds = p * (dp - delta_ref[0][:, None])
+            dk_sc[:] += scale * jax.lax.dot_general(
+                ds, qb, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+
+        @pl.when(qi == nq - 1)
+        def _finish():
+            dk_ref[0] = dk_sc[:]
+            dv_ref[0] = dv_sc[:]
+
+    grid = (bh, nk, nq)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=0,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1,), lambda b, j, i: (b,), memory_space=pltpu.SMEM),
+                pl.BlockSpec((1, block_q, hd), lambda b, j, i: (b, i, 0)),
+                pl.BlockSpec((1, block_k, hd), lambda b, j, i: (b, j, 0)),
+                pl.BlockSpec((1, block_k, hd), lambda b, j, i: (b, j, 0)),
+                pl.BlockSpec((1, block_q, hd), lambda b, j, i: (b, i, 0)),
+                pl.BlockSpec((1, block_q), lambda b, j, i: (b, i)),
+                pl.BlockSpec((1, block_q), lambda b, j, i: (b, i)),
+                pl.BlockSpec((1, block_q), lambda b, j, i: (b, i)),
+                pl.BlockSpec((1, block_k), lambda b, j, i: (b, j)),
+                pl.BlockSpec((1, block_k), lambda b, j, i: (b, j)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, block_k, hd), lambda b, j, i: (b, j, 0)),
+                pl.BlockSpec((1, block_k, hd), lambda b, j, i: (b, j, 0)),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((block_k, hd), jnp.float32),
+                pltpu.VMEM((block_k, hd), jnp.float32),
+            ],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, skv, hd), jnp.float32),
+            jax.ShapeDtypeStruct((bh, skv, hd), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(slopes, q, k, v, do, lse, delta, qpos, kpos, kneg)
+
+
+def flash_chunk_dq(q, k, v, do, lse, delta, slopes, qpos, kpos, kneg,
+                   scale, interpret):
+    interpret = _resolve_interpret(interpret)
+    bq, bk = _pick_block(q.shape[1]), _pick_block(k.shape[1])
+    return _chunk_dq_pallas(q, k, v, do, lse, delta, slopes, qpos, kpos, kneg,
+                            scale, bq, bk, interpret)
+
+
+def flash_chunk_dkv(q, k, v, do, lse, delta, slopes, qpos, kpos, kneg,
+                    scale, interpret):
+    interpret = _resolve_interpret(interpret)
+    bq, bk = _pick_block(q.shape[1]), _pick_block(k.shape[1])
+    return _chunk_dkv_pallas(q, k, v, do, lse, delta, slopes, qpos, kpos, kneg,
+                             scale, bq, bk, interpret)
 
 
 def _xla_reference(q, k, v, slopes, scale, causal, kpos=None, kneg=None):
@@ -515,36 +687,44 @@ def _resolve_interpret(interpret):
     return interpret
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8))
-def _flash(q, k, v, slopes, kpos, kneg, scale, causal, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8, 9))
+def _flash(q, k, v, slopes, kpos, kneg, scale, causal, interpret, g=1):
     out, _ = _flash_fwd_pallas(
         q, k, v, slopes, kpos, kneg, scale, causal,
         _pick_block(q.shape[1]), _pick_block(q.shape[1]),
-        _resolve_interpret(interpret),
+        _resolve_interpret(interpret), g,
     )
     return out
 
 
-def _flash_fwd(q, k, v, slopes, kpos, kneg, scale, causal, interpret):
+def _flash_fwd(q, k, v, slopes, kpos, kneg, scale, causal, interpret, g=1):
     out, lse = _flash_fwd_pallas(
         q, k, v, slopes, kpos, kneg, scale, causal,
         _pick_block(q.shape[1]), _pick_block(q.shape[1]),
-        _resolve_interpret(interpret),
+        _resolve_interpret(interpret), g,
     )
     return out, (q, k, v, slopes, kpos, kneg, out, lse)
 
 
-def _flash_bwd(scale, causal, interpret, res, g):
+def _flash_bwd(scale, causal, interpret, g, res, ct):
     q, k, v, slopes, kpos, kneg, out, lse = res
     interpret = _resolve_interpret(interpret)
     bq, bk = _pick_block(q.shape[1]), _pick_block(q.shape[1])
-    delta = (g.astype(jnp.float32) * out.astype(jnp.float32)).sum(-1)  # (bh, s)
+    delta = (ct.astype(jnp.float32) * out.astype(jnp.float32)).sum(-1)  # (bh, s)
     dq = _flash_dq_pallas(
-        q, k, v, g, lse, delta, slopes, kpos, kneg, scale, causal, bq, bk, interpret
+        q, k, v, ct, lse, delta, slopes, kpos, kneg, scale, causal, bq, bk,
+        interpret, g,
     )
     dk, dv = _flash_dkv_pallas(
-        q, k, v, g, lse, delta, slopes, kpos, kneg, scale, causal, bq, bk, interpret
+        q, k, v, ct, lse, delta, slopes, kpos, kneg, scale, causal, bq, bk,
+        interpret, g,
     )
+    if g > 1:
+        # per-query-head contributions -> shared kv heads (rows ordered
+        # so g consecutive query heads share one kv row)
+        s, hd = k.shape[1], k.shape[2]
+        dk = dk.reshape(-1, g, s, hd).sum(1).astype(k.dtype)
+        dv = dv.reshape(-1, g, s, hd).sum(1).astype(v.dtype)
     return dq, dk, dv, jnp.zeros_like(slopes), jnp.zeros_like(kpos), jnp.zeros_like(kneg)
 
 
@@ -553,7 +733,7 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 
 def flash_attention(
     q: jax.Array,  # (B, S, nh, hd)
-    k: jax.Array,
+    k: jax.Array,  # (B, S, nh | nkv, hd) — fewer kv heads = native GQA
     v: jax.Array,
     alibi_slopes: Optional[jax.Array] = None,  # (nh,)
     attention_mask: Optional[jax.Array] = None,  # (B, S) 1=keep 0=pad
@@ -563,13 +743,22 @@ def flash_attention(
     scale: Optional[float] = None,
     interpret: Optional[bool] = None,
 ) -> jax.Array:
-    """BLOOM-shaped fused attention. Returns (B, S, nh, hd).
+    """Fused attention. Returns (B, S, nh, hd).
 
     Padding: pass either ``attention_mask`` (positions derived with
     BLOOM's mask-aware cumsum, matching ``models.bloom.build_alibi``) or
     precomputed ``kv_pos``/``kv_neg`` arrays.
+
+    GQA: when ``k``/``v`` carry fewer heads than ``q`` (``nh = g *
+    nkv``, query head h sharing kv head h // g like HF), the kernels
+    read the shared K/V directly via grouped index maps — K/V are never
+    repeated in HBM, so KV read traffic shrinks by g.
     """
     b, s, nh, hd = q.shape
+    nkv = k.shape[2]
+    if nh % nkv:
+        raise ValueError(f"n_head={nh} must be a multiple of n_kv_head={nkv}")
+    g = nh // nkv
     if scale is None:
         scale = hd**-0.5
     if alibi_slopes is None:
@@ -588,15 +777,17 @@ def flash_attention(
     slopes = jnp.broadcast_to(alibi_slopes[None], (b, nh)).reshape(b * nh)
 
     def flat(x):
-        return x.transpose(0, 2, 1, 3).reshape(b * nh, s, hd)
+        h = x.shape[2]
+        return x.transpose(0, 2, 1, 3).reshape(b * h, s, hd)
 
-    def flat_bs(x):  # (B, S) -> (B*nh, S)
+    def flat_bs(x, h):  # (B, S) -> (B*h, S)
         return jnp.broadcast_to(
-            x.astype(jnp.float32)[:, None, :], (b, nh, s)
-        ).reshape(b * nh, s)
+            x.astype(jnp.float32)[:, None, :], (b, h, s)
+        ).reshape(b * h, s)
 
     out = _flash(
         flat(q), flat(k), flat(v), slopes.astype(jnp.float32),
-        flat_bs(kv_pos), flat_bs(kv_neg), float(scale), causal, interpret
+        flat_bs(kv_pos, nkv), flat_bs(kv_neg, nkv), float(scale), causal,
+        interpret, g,
     )
     return out.reshape(b, nh, s, hd).transpose(0, 2, 1, 3)

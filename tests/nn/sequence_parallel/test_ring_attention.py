@@ -255,3 +255,70 @@ def test_bloom_sp_flash_matches_plain(ctx):
         np.testing.assert_allclose(
             np.asarray(b), np.asarray(a), rtol=5e-3, atol=1e-4, err_msg=str(path)
         )
+
+
+def test_flash_chunk_state_matches_dense():
+    """The stateful chunk kernel's (m, l, acc) update == the dense-math
+    mirror (_xla_chunk) that the gradient ring's identities derive from."""
+    from pipegoose_tpu.ops.flash_attention import (
+        _xla_chunk,
+        flash_ring_chunk,
+    )
+
+    BH, SQ, SKV, HD2 = 4, 32, 32, 64
+    ks = jax.random.split(jax.random.PRNGKey(5), 6)
+    q = jax.random.normal(ks[0], (BH, SQ, HD2))
+    k = jax.random.normal(ks[1], (BH, SKV, HD2))
+    v = jax.random.normal(ks[2], (BH, SKV, HD2))
+    slopes = jax.random.uniform(ks[3], (BH,)) * 0.1
+    qpos = jnp.broadcast_to(jnp.arange(SQ, dtype=jnp.float32)[None] + 32, (BH, SQ))
+    kpos = jnp.broadcast_to(jnp.arange(SKV, dtype=jnp.float32)[None], (BH, SKV))
+    kneg = jnp.where(jax.random.uniform(ks[4], (BH, SKV)) < 0.2, -1e9, 0.0)
+    # a non-trivial incoming state
+    m0 = jax.random.normal(ks[5], (BH, SQ)) * 0.5
+    l0 = jnp.abs(jax.random.normal(ks[0], (BH, SQ))) + 0.5
+    acc0 = jax.random.normal(ks[1], (BH, SQ, HD2))
+
+    got = flash_ring_chunk(q, k, v, slopes, qpos, kpos, kneg, m0, l0, acc0,
+                           HD2**-0.5, True)
+    want = _xla_chunk(q, k, v, slopes, qpos, kpos, kneg, m0, l0, acc0, HD2**-0.5)
+    for a, b, name in zip(got, want, ("m", "l", "acc")):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-5, atol=2e-5, err_msg=name
+        )
+
+
+def test_ring_flash_memory_bound(ctx):
+    """The fused ring's compiled temp memory is well below the plain
+    ring's at long S_local (no per-step score block, no stacked per-step
+    AD residuals; measured ~0.37x at seq 2048 on this config)."""
+    import dataclasses
+
+    from pipegoose_tpu.models import bloom
+
+    cfg = bloom.BloomConfig(vocab_size=64, hidden_size=128, n_layer=4, n_head=2)
+    cfg_f = dataclasses.replace(cfg, use_flash=True)
+    params = bloom.init_params(cfg, jax.random.PRNGKey(0))
+    ids = jnp.asarray(np.random.RandomState(0).randint(0, 64, (2, 2048)))
+    specs = bloom.tp_specs(params)
+
+    def temp(c):
+        f = jax.jit(
+            shard_map(
+                jax.value_and_grad(
+                    lambda p, i: bloom.loss_fn_sp(p, i, None, i, c, sp_axis="seq")
+                ),
+                mesh=ctx.mesh,
+                in_specs=(specs, P(None, "seq")),
+                out_specs=(P(), specs),
+                check_vma=False,
+            )
+        )
+        mem = f.lower(params, ids).compile().memory_analysis()
+        if mem is None:
+            pytest.skip("backend reports no memory analysis")
+        return mem.temp_size_in_bytes
+
+    t_ring = temp(cfg)
+    t_flash = temp(cfg_f)
+    assert t_flash < 0.6 * t_ring, (t_flash, t_ring)

@@ -229,3 +229,42 @@ def test_rope_family_flash_matches_plain(family):
     np.testing.assert_allclose(
         np.asarray(fo), np.asarray(fr), rtol=5e-3, atol=1e-4
     )
+
+
+def test_gqa_grouped_kv_matches_repeated():
+    """Native GQA (un-repeated K/V via grouped index maps) == the same
+    attention with K/V explicitly repeated: forward and gradients."""
+    B, S, NKV, G, HD2 = 2, 64, 2, 3, 64
+    nh = NKV * G
+    ks = jax.random.split(jax.random.PRNGKey(7), 3)
+    q = jax.random.normal(ks[0], (B, S, nh, HD2))
+    k = jax.random.normal(ks[1], (B, S, NKV, HD2))
+    v = jax.random.normal(ks[2], (B, S, NKV, HD2))
+    mask = np.ones((B, S), np.int32)
+    mask[0, 50:] = 0
+    mask = jnp.asarray(mask)
+
+    def grouped(q, k, v):
+        return flash_attention(q, k, v, None, attention_mask=mask, interpret=True)
+
+    def repeated(q, k, v):
+        kr = jnp.repeat(k, G, axis=2)
+        vr = jnp.repeat(v, G, axis=2)
+        return flash_attention(q, kr, vr, None, attention_mask=mask, interpret=True)
+
+    out_g = grouped(q, k, v)
+    out_r = repeated(q, k, v)
+    np.testing.assert_allclose(
+        np.asarray(out_g), np.asarray(out_r), rtol=2e-5, atol=2e-6
+    )
+
+    w = mask.astype(jnp.float32)[:, :, None, None]
+    gg = jax.grad(lambda q, k, v: ((grouped(q, k, v) * w) ** 2).sum(),
+                  argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(lambda q, k, v: ((repeated(q, k, v) * w) ** 2).sum(),
+                  argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(gg, gr, "qkv"):
+        assert np.isfinite(np.asarray(a)).all(), name
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=5e-5, err_msg=name
+        )
