@@ -131,9 +131,11 @@ MIXTRAL_RULES = [
 def mixtral_config_from_hf(hf_config, **overrides):
     from pipegoose_tpu.models.mixtral import MixtralConfig
 
-    if getattr(hf_config, "sliding_window", None):
-        raise NotImplementedError("sliding-window attention not supported yet")
+    # normalize falsy/non-positive windows to disabled (HF treats 0/None
+    # as no sliding window)
+    window = getattr(hf_config, "sliding_window", None)
     return MixtralConfig(
+        sliding_window=window if window and window > 0 else None,
         vocab_size=hf_config.vocab_size,
         hidden_size=hf_config.hidden_size,
         intermediate_size=hf_config.intermediate_size,

@@ -65,6 +65,10 @@ class MixtralConfig:
     # set when the embedding/head was padded for TP divisibility: the
     # true vocab size; padded logit slots are masked out of CE + decode
     valid_vocab_size: Optional[int] = None
+    # Mistral-style sliding-window attention: each query attends keys
+    # within `sliding_window` positions behind it (None = full causal;
+    # HF Mixtral-8x7B configs disable it)
+    sliding_window: Optional[int] = None
 
     @property
     def head_dim(self) -> int:
@@ -158,13 +162,18 @@ def apply_rope(q, k, cos, sin):
     return q * cos + _rotate_half(q) * sin, k * cos + _rotate_half(k) * sin
 
 
-def causal_mask_bias(attention_mask: jax.Array) -> jax.Array:
-    """Combined causal + padding additive bias (B, 1, S, S) — shared by
-    the Mixtral and Llama families (absolute positions; RoPE models
-    carry no ALiBi term)."""
+def causal_mask_bias(
+    attention_mask: jax.Array, window: Optional[int] = None
+) -> jax.Array:
+    """Combined causal + padding (+ optional sliding window) additive
+    bias (B, 1, S, S) — shared by the Mixtral and Llama families
+    (absolute positions; RoPE models carry no ALiBi term)."""
     s = attention_mask.shape[-1]
-    causal = jnp.tril(jnp.ones((s, s), bool))
-    keep = causal[None, None] & (attention_mask[:, None, None, :] > 0)
+    keep = jnp.tril(jnp.ones((s, s), bool))
+    if window is not None:
+        pos = jnp.arange(s)
+        keep = keep & (pos[:, None] - pos[None, :] < window)
+    keep = keep[None, None] & (attention_mask[:, None, None, :] > 0)
     return jnp.where(keep, 0.0, NEG_INF).astype(jnp.float32)
 
 
@@ -176,8 +185,11 @@ def rope_attention_bias(attention_mask: jax.Array, config) -> dict:
     if config.use_flash:
         from pipegoose_tpu.ops.flash_attention import mask_to_kv_bias
 
+        # the sliding window (if any) is applied inside the kernel
         return {"kv_neg": mask_to_kv_bias(attention_mask)[1]}
-    return {"mask_bias": causal_mask_bias(attention_mask)}
+    return {"mask_bias": causal_mask_bias(
+        attention_mask, getattr(config, "sliding_window", None)
+    )}
 
 
 def _swiglu_experts(moe_params: dict, x: jax.Array, tp_axis: Optional[str]) -> jax.Array:
@@ -231,6 +243,7 @@ def _attention(blk, x, cos, sin, bias, config, tp_axis):
         ctx = flash_attention(
             q, k, v, alibi_slopes=None,  # RoPE: no ALiBi term
             kv_neg=bias["kv_neg"], causal=True,
+            window=getattr(config, "sliding_window", None),
         )
         ctx = ctx.astype(x.dtype).reshape(b, s, nh_l * hd)
         return row_parallel_linear(blk["o"], ctx, tp_axis)
@@ -704,6 +717,9 @@ def _attn_cached(blk, x, k_cache, v_cache, start, cos_full, sin_full, config):
     key_pos = jnp.arange(max_len)
     q_pos = start + jnp.arange(s)
     keep = key_pos[None, :] <= q_pos[:, None]
+    window = getattr(config, "sliding_window", None)  # shared with Llama decode
+    if window is not None:
+        keep = keep & (q_pos[:, None] - key_pos[None, :] < window)
     bias = jnp.where(keep[None, None, None], 0.0, NEG_INF)  # (1,1,1,S,max_len)
 
     # grouped einsum against the nkv-wide cache: no group-repeated K/V

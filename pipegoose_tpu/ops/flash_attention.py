@@ -56,20 +56,28 @@ def mask_to_kv_bias(attention_mask: jax.Array):
     return kv_pos, kv_neg
 
 
-def _bias_block(slope, kpos_ref, kneg_ref, q_start, k_start, block_q, block_k, causal):
-    """Additive bias for one (BQ, BK) score block: ALiBi + padding + causal."""
+def _bias_block(slope, kpos_ref, kneg_ref, q_start, k_start, block_q, block_k,
+                causal, window=None):
+    """Additive bias for one (BQ, BK) score block: ALiBi + padding +
+    causal (+ optional sliding window: key within ``window`` positions
+    behind the query, Mistral/Mixtral semantics)."""
     kp = kpos_ref[0].astype(jnp.float32)  # (BK,)
     kn = kneg_ref[0].astype(jnp.float32)
     bias = slope * kp[None, :] + kn[None, :]
-    if causal:
+    if causal or window is not None:
         q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
         k_idx = k_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-        bias = jnp.where(k_idx <= q_pos, bias, NEG_INF)
+        keep = jnp.ones((block_q, block_k), bool)
+        if causal:
+            keep = keep & (k_idx <= q_pos)
+        if window is not None:
+            keep = keep & (q_pos - k_idx < window)
+        bias = jnp.where(keep, bias, NEG_INF)
     return bias
 
 
 def _flash_fwd_pallas(q, k, v, slopes, kpos, kneg, scale, causal,
-                      block_q, block_k, interpret, g=1):
+                      block_q, block_k, interpret, g=1, window=None):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -93,8 +101,13 @@ def _flash_fwd_pallas(q, k, v, slopes, kpos, kneg, scale, causal,
         q_start = qi * block_q
         k_start = ki * block_k
 
-        # skip blocks fully above the causal diagonal
-        @pl.when(k_start <= q_start + block_q - 1 if causal else True)
+        # skip blocks fully above the causal diagonal or fully below
+        # the sliding window
+        keep_blk = k_start <= q_start + block_q - 1 if causal else True
+        if window is not None:
+            keep_blk = keep_blk & (k_start + block_k - 1 >= q_start - window + 1)
+
+        @pl.when(keep_blk)
         def _compute():
             qb = q_ref[0].astype(jnp.float32)  # (BQ, hd)
             kb = k_ref[0].astype(jnp.float32)  # (BK, hd)
@@ -105,7 +118,7 @@ def _flash_fwd_pallas(q, k, v, slopes, kpos, kneg, scale, causal,
             ) * scale  # (BQ, BK)
             s_blk = s_blk + _bias_block(
                 slope_ref[0], kpos_ref, kneg_ref,
-                q_start, k_start, block_q, block_k, causal,
+                q_start, k_start, block_q, block_k, causal, window,
             )
 
             m_prev = m_sc[:, 0]
@@ -162,7 +175,7 @@ def _flash_fwd_pallas(q, k, v, slopes, kpos, kneg, scale, causal,
 
 
 def _flash_dq_pallas(q, k, v, do, lse, delta, slopes, kpos, kneg,
-                     scale, causal, block_q, block_k, interpret, g=1):
+                     scale, causal, block_q, block_k, interpret, g=1, window=None):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -181,7 +194,11 @@ def _flash_dq_pallas(q, k, v, do, lse, delta, slopes, kpos, kneg,
         q_start = qi * block_q
         k_start = ki * block_k
 
-        @pl.when(k_start <= q_start + block_q - 1 if causal else True)
+        keep_blk = k_start <= q_start + block_q - 1 if causal else True
+        if window is not None:
+            keep_blk = keep_blk & (k_start + block_k - 1 >= q_start - window + 1)
+
+        @pl.when(keep_blk)
         def _compute():
             qb = q_ref[0].astype(jnp.float32)
             kb = k_ref[0].astype(jnp.float32)
@@ -193,7 +210,7 @@ def _flash_dq_pallas(q, k, v, do, lse, delta, slopes, kpos, kneg,
             ) * scale
             s_blk = s_blk + _bias_block(
                 slope_ref[0], kpos_ref, kneg_ref,
-                q_start, k_start, block_q, block_k, causal,
+                q_start, k_start, block_q, block_k, causal, window,
             )
             p = jnp.exp(s_blk - lse_ref[0][:, None])  # (BQ, BK)
             dp = jax.lax.dot_general(
@@ -239,7 +256,7 @@ def _flash_dq_pallas(q, k, v, do, lse, delta, slopes, kpos, kneg,
 
 
 def _flash_dkv_pallas(q, k, v, do, lse, delta, slopes, kpos, kneg,
-                      scale, causal, block_q, block_k, interpret, g=1):
+                      scale, causal, block_q, block_k, interpret, g=1, window=None):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -262,7 +279,11 @@ def _flash_dkv_pallas(q, k, v, do, lse, delta, slopes, kpos, kneg,
         q_start = qi * block_q
         k_start = kj * block_k
 
-        @pl.when(k_start <= q_start + block_q - 1 if causal else True)
+        keep_blk = k_start <= q_start + block_q - 1 if causal else True
+        if window is not None:
+            keep_blk = keep_blk & (k_start + block_k - 1 >= q_start - window + 1)
+
+        @pl.when(keep_blk)
         def _compute():
             qb = q_ref[0].astype(jnp.float32)
             kb = k_ref[0].astype(jnp.float32)
@@ -274,7 +295,7 @@ def _flash_dkv_pallas(q, k, v, do, lse, delta, slopes, kpos, kneg,
             ) * scale
             s_blk = s_blk + _bias_block(
                 slope_ref[0], kpos_ref, kneg_ref,
-                q_start, k_start, block_q, block_k, causal,
+                q_start, k_start, block_q, block_k, causal, window,
             )
             p = jnp.exp(s_blk - lse_ref[0][:, None])  # (BQ, BK)
             dv_sc[:] += jax.lax.dot_general(
@@ -687,37 +708,39 @@ def _resolve_interpret(interpret):
     return interpret
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8, 9))
-def _flash(q, k, v, slopes, kpos, kneg, scale, causal, interpret, g=1):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8, 9, 10))
+def _flash(q, k, v, slopes, kpos, kneg, scale, causal, interpret, g=1,
+           window=None):
     out, _ = _flash_fwd_pallas(
         q, k, v, slopes, kpos, kneg, scale, causal,
         _pick_block(q.shape[1]), _pick_block(q.shape[1]),
-        _resolve_interpret(interpret), g,
+        _resolve_interpret(interpret), g, window,
     )
     return out
 
 
-def _flash_fwd(q, k, v, slopes, kpos, kneg, scale, causal, interpret, g=1):
+def _flash_fwd(q, k, v, slopes, kpos, kneg, scale, causal, interpret, g=1,
+               window=None):
     out, lse = _flash_fwd_pallas(
         q, k, v, slopes, kpos, kneg, scale, causal,
         _pick_block(q.shape[1]), _pick_block(q.shape[1]),
-        _resolve_interpret(interpret), g,
+        _resolve_interpret(interpret), g, window,
     )
     return out, (q, k, v, slopes, kpos, kneg, out, lse)
 
 
-def _flash_bwd(scale, causal, interpret, g, res, ct):
+def _flash_bwd(scale, causal, interpret, g, window, res, ct):
     q, k, v, slopes, kpos, kneg, out, lse = res
     interpret = _resolve_interpret(interpret)
     bq, bk = _pick_block(q.shape[1]), _pick_block(q.shape[1])
     delta = (ct.astype(jnp.float32) * out.astype(jnp.float32)).sum(-1)  # (bh, s)
     dq = _flash_dq_pallas(
         q, k, v, ct, lse, delta, slopes, kpos, kneg, scale, causal, bq, bk,
-        interpret, g,
+        interpret, g, window,
     )
     dk, dv = _flash_dkv_pallas(
         q, k, v, ct, lse, delta, slopes, kpos, kneg, scale, causal, bq, bk,
-        interpret, g,
+        interpret, g, window,
     )
     if g > 1:
         # per-query-head contributions -> shared kv heads (rows ordered
@@ -742,6 +765,7 @@ def flash_attention(
     causal: bool = True,
     scale: Optional[float] = None,
     interpret: Optional[bool] = None,
+    window: Optional[int] = None,  # sliding window (Mistral semantics)
 ) -> jax.Array:
     """Fused attention. Returns (B, S, nh, hd).
 
@@ -788,6 +812,6 @@ def flash_attention(
     out = _flash(
         flat(q), flat(k), flat(v), slopes.astype(jnp.float32),
         flat_bs(kv_pos, nkv), flat_bs(kv_neg, nkv), float(scale), causal,
-        interpret, g,
+        interpret, g, int(window) if window is not None else None,
     )
     return out.reshape(b, nh, s, hd).transpose(0, 2, 1, 3)
