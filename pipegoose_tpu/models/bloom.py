@@ -607,18 +607,31 @@ def _attention_sp(
     tp_axis: Optional[str],
     sp_axis: str,
     pad_mask_local: jax.Array,  # (B, S_local)
+    variant: str = "ring",
 ) -> jax.Array:
-    """BLOOM attention with the sequence sharded over ``sp_axis`` (ring
-    attention) and heads over ``tp_axis``. ALiBi uses plain global key
-    positions — identical to HF's mask-aware positions for unpadded or
-    right-padded batches (the cumsum trick only differs under left/
-    interior padding)."""
+    """BLOOM attention with the sequence sharded over ``sp_axis`` and
+    heads over ``tp_axis``. ALiBi uses plain global key positions —
+    identical to HF's mask-aware positions for unpadded or right-padded
+    batches (the cumsum trick only differs under left/interior padding).
+
+    ``variant``:
+    - ``"ring"``: K/V blocks rotate over the sp ring (flash chunk
+      kernels when config.use_flash) — O(S_local^2) score working set,
+      comm = K+V once around, best for very long sequences;
+    - ``"ulysses"``: two all_to_all ops re-shard seq -> heads so each
+      device runs FULL-sequence attention on local_heads/sp heads
+      (flash kernel inside when config.use_flash), then one all_to_all
+      restores sequence sharding — 4 collectives/layer, best when
+      heads >= sp and the ring's per-hop latency dominates.
+    Both are exact; gradient flows through the collectives' AD."""
     from pipegoose_tpu.nn.sequence_parallel.ring_attention import (
         make_causal_alibi_bias_fn,
         ring_attention,
         ring_flash_attention,
     )
 
+    if variant not in ("ring", "ulysses"):
+        raise ValueError(f"unknown SP variant {variant!r} (ring, ulysses)")
     b, s_local, _ = x.shape
     hd = config.head_dim
     tp = jax.lax.axis_size(tp_axis) if tp_axis else 1
@@ -633,7 +646,45 @@ def _attention_sp(
         h0 = jax.lax.axis_index(tp_axis) * local_heads
         slopes = jax.lax.dynamic_slice_in_dim(slopes, h0, local_heads, 0)
 
-    if config.use_flash:
+    if variant == "ulysses":
+        from pipegoose_tpu.distributed.functional import all_gather
+        from pipegoose_tpu.nn.sequence_parallel.ulysses import ulysses_attention
+        from pipegoose_tpu.ops.flash_attention import mask_to_kv_bias
+
+        sp = jax.lax.axis_size(sp_axis)
+        if local_heads % sp:
+            raise ValueError(
+                f"ulysses needs local heads {local_heads} divisible by "
+                f"sequence axis size {sp}"
+            )
+        nh_sub = local_heads // sp
+        sp_rank = jax.lax.axis_index(sp_axis)
+        # the all_to_all hands this device the sp_rank-th head subset
+        sub_slopes = jax.lax.dynamic_slice_in_dim(
+            slopes, sp_rank * nh_sub, nh_sub, 0
+        )
+        full_mask = all_gather(pad_mask_local, sp_axis, dim=1)  # (B, S)
+
+        def attn_fn(qh, kh, vh):  # (B, S_full, nh_sub, hd)
+            s_full = qh.shape[1]
+            if config.use_flash:
+                from pipegoose_tpu.ops.flash_attention import flash_attention
+
+                kv_pos = jnp.broadcast_to(
+                    jnp.arange(s_full, dtype=jnp.float32)[None], (b, s_full)
+                )  # plain global positions — same ALiBi semantics as ring
+                return flash_attention(
+                    qh, kh, vh, alibi_slopes=sub_slopes,
+                    kv_pos=kv_pos, kv_neg=mask_to_kv_bias(full_mask)[1],
+                    causal=True,
+                )
+            bias_fn = make_causal_alibi_bias_fn(
+                s_full, None, alibi_slopes=sub_slopes
+            )
+            return ring_attention(qh, kh, vh, None, bias_fn, kv_side=full_mask)
+
+        ctx = ulysses_attention(q, k, v, sp_axis, attn_fn)
+    elif config.use_flash:
         # fused chunk kernel per ring step — no (S_local, S_local) score
         # materialization in the forward
         ctx = ring_flash_attention(
@@ -642,7 +693,7 @@ def _attention_sp(
     else:
         bias_fn = make_causal_alibi_bias_fn(s_local, sp_axis, alibi_slopes=slopes)
         ctx = ring_attention(q, k, v, sp_axis, bias_fn, kv_side=pad_mask_local)
-    ctx = ctx.reshape(b, s_local, local_heads * hd)
+    ctx = ctx.astype(x.dtype).reshape(b, s_local, local_heads * hd)
     return row_parallel_linear(blk["out"], ctx, tp_axis)
 
 
@@ -654,9 +705,11 @@ def loss_fn_sp(
     config: BloomConfig,
     tp_axis: Optional[str] = None,
     sp_axis: str = "seq",
+    variant: str = "ring",
 ) -> jax.Array:
     """Sequence-parallel causal-LM loss: every activation tensor lives
-    sequence-sharded; attention is the ring; the next-token target at
+    sequence-sharded; attention is the ring (or Ulysses all_to_all with
+    ``variant="ulysses"`` — see _attention_sp); the next-token target at
     each chunk boundary arrives by one ppermute of the label chunk.
     Gradients of (seq-replicated) params are partial per rank — sum them
     over ``sp_axis`` (grad_sync_axes=(("seq","sum"),))."""
@@ -669,7 +722,9 @@ def loss_fn_sp(
     x = embed_tokens(params, input_ids, config, tp_axis)
 
     def scan_fn(carry, blk):
-        return _sp_block(blk, carry, config, tp_axis, sp_axis, attention_mask), None
+        return _sp_block(
+            blk, carry, config, tp_axis, sp_axis, attention_mask, variant
+        ), None
 
     step = jax.checkpoint(scan_fn) if config.remat else scan_fn
     x, _ = jax.lax.scan(step, x, params["blocks"])
@@ -683,12 +738,15 @@ def loss_fn_sp(
     return reduce_from_tensor_group(total / jnp.maximum(count, 1), sp_axis)
 
 
-def _sp_block(blk, h, config, tp_axis, sp_axis, pad_mask_local):
+def _sp_block(blk, h, config, tp_axis, sp_axis, pad_mask_local,
+              variant: str = "ring"):
     """One transformer block on sequence-sharded activations (shared by
     the plain SP and the PP x SP compositions)."""
     ln1 = layer_norm(blk["ln_1"], h, config.layer_norm_epsilon)
     attn_blk = {"qkv": blk["attn"]["qkv"], "out": blk["attn"]["out"]}
-    h = h + _attention_sp(attn_blk, ln1, config, tp_axis, sp_axis, pad_mask_local)
+    h = h + _attention_sp(
+        attn_blk, ln1, config, tp_axis, sp_axis, pad_mask_local, variant
+    )
     return h + _mlp(blk, h, config, tp_axis)
 
 
@@ -696,22 +754,16 @@ def _sp_head_sums(params, x, attention_mask, labels, config, tp_axis, sp_axis):
     """Final LN -> logits -> SP-shifted CE sums. Returns the LOCAL
     (weighted-loss sum, weight sum) for this sequence shard.
 
-    Global shift-by-one on a sharded sequence: within-chunk shift + the
-    first element of the NEXT chunk arrives by one ppermute of the label
-    chunk (the last rank's trailing target is padding-masked)."""
-    from pipegoose_tpu.distributed.functional import shift_left
+    Global shift-by-one on a sharded sequence: see
+    nn/sequence_parallel/targets.py (shared by all families)."""
+    from pipegoose_tpu.nn.sequence_parallel.targets import sp_shifted_targets
 
     x = layer_norm(params["ln_f"], x, config.layer_norm_epsilon)
     logits = logits_fn(params, x, tp_axis)  # (B, S_local, V/tp)
 
-    sp = jax.lax.axis_size(sp_axis)
-    rank = jax.lax.axis_index(sp_axis)
-    next_first_label = shift_left(labels[:, :1], sp_axis)  # (B, 1)
-    next_first_w = shift_left(attention_mask[:, :1], sp_axis)
-    shifted_labels = jnp.concatenate([labels[:, 1:], next_first_label], axis=1)
-    shifted_w = jnp.concatenate([attention_mask[:, 1:], next_first_w], axis=1)
-    is_last = rank == sp - 1
-    shifted_w = shifted_w.at[:, -1].multiply(jnp.where(is_last, 0, 1))
+    shifted_labels, shifted_w = sp_shifted_targets(
+        labels, attention_mask, sp_axis
+    )
 
     per_tok = vocab_parallel_cross_entropy(
         logits, shifted_labels, tp_axis, valid_size=config.valid_vocab_size

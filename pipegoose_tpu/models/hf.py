@@ -194,8 +194,14 @@ LLAMA_RULES = [
 def llama_config_from_hf(hf_config, **overrides):
     from pipegoose_tpu.models.llama import LlamaConfig
 
-    if getattr(hf_config, "rope_scaling", None):
-        raise NotImplementedError("rope_scaling checkpoints not supported yet")
+    from pipegoose_tpu.models.mixtral import RopeScaling
+
+    rope_scaling = RopeScaling.from_hf(
+        getattr(hf_config, "rope_scaling", None),
+        # HF 'dynamic' checkpoints omit original_max_position_embeddings
+        # and rescale relative to the model's max_position_embeddings
+        default_original_max=getattr(hf_config, "max_position_embeddings", 8192),
+    )
     if getattr(hf_config, "attention_bias", False):
         raise NotImplementedError("attention_bias=True checkpoints not supported")
     derived_hd = hf_config.hidden_size // hf_config.num_attention_heads
@@ -212,6 +218,7 @@ def llama_config_from_hf(hf_config, **overrides):
         n_head=hf_config.num_attention_heads,
         n_kv_head=hf_config.num_key_value_heads,
         rope_theta=getattr(hf_config, "rope_theta", 1e4),
+        rope_scaling=rope_scaling,
         rms_eps=hf_config.rms_norm_eps,
         tie_word_embeddings=getattr(hf_config, "tie_word_embeddings", False),
         **overrides,

@@ -23,6 +23,7 @@ import jax
 import jax.numpy as jnp
 
 from pipegoose_tpu.models.mixtral import (
+    RopeScaling,
     _attention,
     rms_norm,
     rope_attention_bias,
@@ -45,6 +46,8 @@ class LlamaConfig:
     n_head: int = 32
     n_kv_head: int = 32
     rope_theta: float = 1e4
+    # HF rope_scaling (linear / dynamic / llama3) — None = plain RoPE
+    rope_scaling: Optional["RopeScaling"] = None
     rms_eps: float = 1e-5
     initializer_range: float = 0.02
     tie_word_embeddings: bool = False
@@ -135,7 +138,9 @@ def forward_hidden(
     x = vocab_parallel_embedding(params["embed"], input_ids, tp_axis).astype(
         config.dtype
     )
-    cos, sin = rope_cos_sin(s, config.head_dim, config.rope_theta)
+    cos, sin = rope_cos_sin(
+        s, config.head_dim, config.rope_theta, config.rope_scaling
+    )
     bias = rope_attention_bias(attention_mask, config)
 
     block = partial(_block, config=config, tp_axis=tp_axis)
@@ -201,7 +206,9 @@ def loss_fn_pp(
             config.dtype
         )
     )(mbs["ids"])
-    cos, sin = rope_cos_sin(s, config.head_dim, config.rope_theta)
+    cos, sin = rope_cos_sin(
+        s, config.head_dim, config.rope_theta, config.rope_scaling
+    )
     side = {"bias": jax.vmap(lambda m: rope_attention_bias(m, config))(mbs["mask"])}
 
     def stage_fn(blocks, h, side):
@@ -254,6 +261,66 @@ def specs(params: dict, tp_axis: str = "tensor") -> dict:
     return spec_tree(params, spec_fn)
 
 
+# -- sequence-parallel composition ------------------------------------------
+
+def loss_fn_sp(
+    params: dict,
+    input_ids: jax.Array,  # (B, S_local) — sequence sharded over sp_axis
+    attention_mask: Optional[jax.Array],
+    labels: jax.Array,
+    config: LlamaConfig,
+    tp_axis: Optional[str] = None,
+    sp_axis: str = "seq",
+) -> jax.Array:
+    """Sequence-parallel Llama loss: ring attention over ``sp_axis``
+    with RoPE at global positions (rope_scaling honored). Shares
+    mixtral._attention_sp — the RoPE/GQA ring path is family-agnostic;
+    only the dense SwiGLU block body differs from Mixtral's MoE.
+
+    Grad sync for replicated params: ``grad_sync_axes=(("seq","sum"),)``.
+    """
+    from pipegoose_tpu.distributed.functional import reduce_from_tensor_group
+    from pipegoose_tpu.models.mixtral import _attention_sp
+    from pipegoose_tpu.nn.sequence_parallel.targets import sp_shifted_targets
+
+    b, s_local = input_ids.shape
+    if attention_mask is None:
+        attention_mask = jnp.ones((b, s_local), jnp.int32)
+
+    x = vocab_parallel_embedding(params["embed"], input_ids, tp_axis).astype(
+        config.dtype
+    )
+
+    def block(blk, h):
+        ln1 = rms_norm(blk["ln_1"], h, config.rms_eps)
+        h = h + _attention_sp(
+            blk["attn"], ln1, config, tp_axis, sp_axis, attention_mask
+        )
+        ln2 = rms_norm(blk["ln_2"], h, config.rms_eps)
+        return h + _mlp(blk["mlp"], ln2, tp_axis)
+
+    def scan_fn(carry, blk):
+        return block(blk, carry), None
+
+    step = jax.checkpoint(scan_fn) if config.remat else scan_fn
+    x, _ = jax.lax.scan(step, x, params["blocks"])
+
+    x = rms_norm(params["ln_f"], x, config.rms_eps)
+    logits = logits_fn(params, x, config, tp_axis)
+
+    shifted_labels, shifted_w = sp_shifted_targets(
+        labels, attention_mask, sp_axis
+    )
+    per_tok = vocab_parallel_cross_entropy(
+        logits, shifted_labels, tp_axis, valid_size=config.valid_vocab_size
+    )
+    w = shifted_w.astype(per_tok.dtype)
+    count = jax.lax.psum(w.sum(), sp_axis)
+    return reduce_from_tensor_group(
+        (per_tok * w).sum() / jnp.maximum(count, 1), sp_axis
+    )
+
+
 def pp_specs(params: dict, tp_axis: str = "tensor", pipe_axis: str = "pipe") -> dict:
     from pipegoose_tpu.nn.pipeline_parallel.pipeline import pipe_stage_specs
 
@@ -277,7 +344,17 @@ def forward_cached(params, ids, cache, start, config):
 
     x = vocab_parallel_embedding(params["embed"], ids, None).astype(config.dtype)
     max_len = cache["k"].shape[2]
-    cos_full, sin_full = rope_cos_sin(max_len, config.head_dim, config.rope_theta)
+    if config.rope_scaling is not None and config.rope_scaling.rope_type == "dynamic":
+        # dynamic NTK makes inv_freq a function of the CURRENT length;
+        # precomputing at cache capacity would rescale short prompts HF
+        # leaves unscaled — reject rather than silently diverge
+        raise NotImplementedError(
+            "rope_scaling type 'dynamic' is not supported in the KV-cache "
+            "decode path (length-dependent frequencies)"
+        )
+    cos_full, sin_full = rope_cos_sin(
+        max_len, config.head_dim, config.rope_theta, config.rope_scaling
+    )
 
     def scan_fn(carry, blk_and_cache):
         h = carry

@@ -295,11 +295,12 @@ def make_causal_alibi_bias_fn(
     axis_name: Optional[str],
     alibi_slopes: Optional[jax.Array] = None,  # (nh,)
     q_rank: Optional[jax.Array] = None,
+    window: Optional[int] = None,  # sliding window (Mistral semantics)
 ):
-    """Block bias for BLOOM-style attention under sequence sharding:
-    causal mask on GLOBAL positions + ALiBi (slope * global key position)
-    + padding mask from the K/V chunk's attention mask (rides the ring
-    as ``kv_side``)."""
+    """Block bias for attention under sequence sharding: causal mask on
+    GLOBAL positions (+ optional sliding window) + ALiBi (slope * global
+    key position; omit for RoPE families) + padding mask from the K/V
+    chunk's attention mask (rides the ring as ``kv_side``)."""
     rank = (
         q_rank
         if q_rank is not None
@@ -309,15 +310,17 @@ def make_causal_alibi_bias_fn(
 
     def bias_fn(kv_rank, kv_pad_mask=None):
         kv_pos = kv_rank * seq_local + jnp.arange(seq_local)  # (Skv,)
-        causal = q_pos[:, None] >= kv_pos[None, :]  # (Sq, Skv)
-        bias = jnp.where(causal, 0.0, NEG_INF)[None, None]  # (1,1,Sq,Skv)
+        keep = q_pos[:, None] >= kv_pos[None, :]  # (Sq, Skv)
+        if window is not None:
+            keep = keep & (q_pos[:, None] - kv_pos[None, :] < window)
+        bias = jnp.where(keep, 0.0, NEG_INF)[None, None]  # (1,1,Sq,Skv)
         if alibi_slopes is not None:
             # NOTE: mask-aware position (cumsum) needs global context; for
             # right-padded batches plain positions match HF's alibi
             bias = bias + alibi_slopes[None, :, None, None] * kv_pos[None, None, None, :].astype(jnp.float32)
         if kv_pad_mask is not None:
-            keep = kv_pad_mask[:, None, None, :] > 0  # (B,1,1,Skv)
-            bias = bias + jnp.where(keep, 0.0, NEG_INF)
+            keep_pad = kv_pad_mask[:, None, None, :] > 0  # (B,1,1,Skv)
+            bias = bias + jnp.where(keep_pad, 0.0, NEG_INF)
         return bias
 
     return bias_fn

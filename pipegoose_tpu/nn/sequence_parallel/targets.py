@@ -1,0 +1,33 @@
+"""Next-token target alignment on a sequence-sharded axis.
+
+The global shift-by-one of causal-LM labels crosses chunk boundaries
+under sequence parallelism: each rank's last target is the FIRST label
+of the next rank's chunk. One ``ppermute`` of the leading label/mask
+column delivers it; the final rank's trailing target is weight-masked.
+
+Shared by every model family's ``loss_fn_sp`` (the shift is family-
+independent). The reference has no SP at all (SURVEY.md §5), so this
+logic has no analog there.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from pipegoose_tpu.distributed.functional import shift_left
+
+
+def sp_shifted_targets(labels: jax.Array, attention_mask: jax.Array,
+                       sp_axis: str):
+    """(labels, mask) of shape (B, S_local) -> (shifted_labels,
+    shifted_weights) aligned to next-token prediction across the
+    sequence shards."""
+    sp = jax.lax.axis_size(sp_axis)
+    rank = jax.lax.axis_index(sp_axis)
+    next_first_label = shift_left(labels[:, :1], sp_axis)  # (B, 1)
+    next_first_w = shift_left(attention_mask[:, :1], sp_axis)
+    shifted_labels = jnp.concatenate([labels[:, 1:], next_first_label], axis=1)
+    shifted_w = jnp.concatenate([attention_mask[:, 1:], next_first_w], axis=1)
+    is_last = rank == sp - 1
+    shifted_w = shifted_w.at[:, -1].multiply(jnp.where(is_last, 0, 1))
+    return shifted_labels, shifted_w

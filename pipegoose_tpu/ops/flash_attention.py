@@ -61,8 +61,8 @@ def _bias_block(slope, kpos_ref, kneg_ref, q_start, k_start, block_q, block_k,
     """Additive bias for one (BQ, BK) score block: ALiBi + padding +
     causal (+ optional sliding window: key within ``window`` positions
     behind the query, Mistral/Mixtral semantics)."""
-    kp = kpos_ref[0].astype(jnp.float32)  # (BK,)
-    kn = kneg_ref[0].astype(jnp.float32)
+    kp = kpos_ref[0, 0].astype(jnp.float32)  # (BK,)
+    kn = kneg_ref[0, 0].astype(jnp.float32)
     bias = slope * kp[None, :] + kn[None, :]
     if causal or window is not None:
         q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
@@ -91,6 +91,7 @@ def _flash_fwd_pallas(q, k, v, slopes, kpos, kneg, scale, causal,
                o_ref, lse_ref, m_sc, l_sc, acc_sc):
         qi = pl.program_id(1)
         ki = pl.program_id(2)
+        slope = slope_ref[pl.program_id(0)]
 
         @pl.when(ki == 0)
         def _init():
@@ -117,7 +118,7 @@ def _flash_fwd_pallas(q, k, v, slopes, kpos, kneg, scale, causal,
                 preferred_element_type=jnp.float32,
             ) * scale  # (BQ, BK)
             s_blk = s_blk + _bias_block(
-                slope_ref[0], kpos_ref, kneg_ref,
+                slope, kpos_ref, kneg_ref,
                 q_start, k_start, block_q, block_k, causal, window,
             )
 
@@ -136,7 +137,7 @@ def _flash_fwd_pallas(q, k, v, slopes, kpos, kneg, scale, causal,
         def _finish():
             l = jnp.maximum(l_sc[:, 0], 1e-30)
             o_ref[0] = (acc_sc[:] / l[:, None]).astype(o_ref.dtype)
-            lse_ref[0] = m_sc[:, 0] + jnp.log(l)
+            lse_ref[0, 0] = m_sc[:, 0] + jnp.log(l)
 
     grid = (bh, nq, nk)
     out, lse = pl.pallas_call(
@@ -145,16 +146,16 @@ def _flash_fwd_pallas(q, k, v, slopes, kpos, kneg, scale, causal,
             num_scalar_prefetch=0,
             grid=grid,
             in_specs=[
-                pl.BlockSpec((1,), lambda b, i, j: (b,), memory_space=pltpu.SMEM),
+                pl.BlockSpec((bh,), lambda b, i, j: (0,), memory_space=pltpu.SMEM),
                 pl.BlockSpec((1, block_q, hd), lambda b, i, j: (b, i, 0)),
                 pl.BlockSpec((1, block_k, hd), lambda b, i, j: (b // g, j, 0)),
                 pl.BlockSpec((1, block_k, hd), lambda b, i, j: (b // g, j, 0)),
-                pl.BlockSpec((1, block_k), lambda b, i, j: (b // g, j)),
-                pl.BlockSpec((1, block_k), lambda b, i, j: (b // g, j)),
+                pl.BlockSpec((1, 1, block_k), lambda b, i, j: (b // g, 0, j)),
+                pl.BlockSpec((1, 1, block_k), lambda b, i, j: (b // g, 0, j)),
             ],
             out_specs=[
                 pl.BlockSpec((1, block_q, hd), lambda b, i, j: (b, i, 0)),
-                pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+                pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
             ],
             scratch_shapes=[
                 pltpu.VMEM((block_q, 1), jnp.float32),
@@ -164,14 +165,14 @@ def _flash_fwd_pallas(q, k, v, slopes, kpos, kneg, scale, causal,
         ),
         out_shape=[
             jax.ShapeDtypeStruct(q.shape, q.dtype),
-            jax.ShapeDtypeStruct((bh, s), jnp.float32),
+            jax.ShapeDtypeStruct((bh, 1, s), jnp.float32),
         ],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
-    )(slopes, q, k, v, kpos, kneg)
-    return out, lse
+    )(slopes, q, k, v, kpos[:, None, :], kneg[:, None, :])
+    return out, lse[:, 0, :]
 
 
 def _flash_dq_pallas(q, k, v, do, lse, delta, slopes, kpos, kneg,
@@ -186,6 +187,7 @@ def _flash_dq_pallas(q, k, v, do, lse, delta, slopes, kpos, kneg,
                kpos_ref, kneg_ref, dq_ref, dq_sc):
         qi = pl.program_id(1)
         ki = pl.program_id(2)
+        slope = slope_ref[pl.program_id(0)]
 
         @pl.when(ki == 0)
         def _init():
@@ -209,15 +211,15 @@ def _flash_dq_pallas(q, k, v, do, lse, delta, slopes, kpos, kneg,
                 preferred_element_type=jnp.float32,
             ) * scale
             s_blk = s_blk + _bias_block(
-                slope_ref[0], kpos_ref, kneg_ref,
+                slope, kpos_ref, kneg_ref,
                 q_start, k_start, block_q, block_k, causal, window,
             )
-            p = jnp.exp(s_blk - lse_ref[0][:, None])  # (BQ, BK)
+            p = jnp.exp(s_blk - lse_ref[0, 0][:, None])  # (BQ, BK)
             dp = jax.lax.dot_general(
                 dob, vb, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32,
             )  # (BQ, BK)
-            ds = p * (dp - delta_ref[0][:, None])
+            ds = p * (dp - delta_ref[0, 0][:, None])
             dq_sc[:] += scale * jax.lax.dot_general(
                 ds, kb, (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32,
@@ -234,15 +236,15 @@ def _flash_dq_pallas(q, k, v, do, lse, delta, slopes, kpos, kneg,
             num_scalar_prefetch=0,
             grid=grid,
             in_specs=[
-                pl.BlockSpec((1,), lambda b, i, j: (b,), memory_space=pltpu.SMEM),
+                pl.BlockSpec((bh,), lambda b, i, j: (0,), memory_space=pltpu.SMEM),
                 pl.BlockSpec((1, block_q, hd), lambda b, i, j: (b, i, 0)),
                 pl.BlockSpec((1, block_k, hd), lambda b, i, j: (b // g, j, 0)),
                 pl.BlockSpec((1, block_k, hd), lambda b, i, j: (b // g, j, 0)),
                 pl.BlockSpec((1, block_q, hd), lambda b, i, j: (b, i, 0)),
-                pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
-                pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
-                pl.BlockSpec((1, block_k), lambda b, i, j: (b // g, j)),
-                pl.BlockSpec((1, block_k), lambda b, i, j: (b // g, j)),
+                pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
+                pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
+                pl.BlockSpec((1, 1, block_k), lambda b, i, j: (b // g, 0, j)),
+                pl.BlockSpec((1, 1, block_k), lambda b, i, j: (b // g, 0, j)),
             ],
             out_specs=pl.BlockSpec((1, block_q, hd), lambda b, i, j: (b, i, 0)),
             scratch_shapes=[pltpu.VMEM((block_q, hd), jnp.float32)],
@@ -252,7 +254,8 @@ def _flash_dq_pallas(q, k, v, do, lse, delta, slopes, kpos, kneg,
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
-    )(slopes, q, k, v, do, lse, delta, kpos, kneg)
+    )(slopes, q, k, v, do, lse[:, None, :], delta[:, None, :],
+      kpos[:, None, :], kneg[:, None, :])
 
 
 def _flash_dkv_pallas(q, k, v, do, lse, delta, slopes, kpos, kneg,
@@ -270,6 +273,7 @@ def _flash_dkv_pallas(q, k, v, do, lse, delta, slopes, kpos, kneg,
                kpos_ref, kneg_ref, dk_ref, dv_ref, dk_sc, dv_sc):
         kj = pl.program_id(1)
         qi = pl.program_id(2)
+        slope = slope_ref[pl.program_id(0)]
 
         @pl.when(qi == 0)
         def _init():
@@ -294,10 +298,10 @@ def _flash_dkv_pallas(q, k, v, do, lse, delta, slopes, kpos, kneg,
                 preferred_element_type=jnp.float32,
             ) * scale
             s_blk = s_blk + _bias_block(
-                slope_ref[0], kpos_ref, kneg_ref,
+                slope, kpos_ref, kneg_ref,
                 q_start, k_start, block_q, block_k, causal, window,
             )
-            p = jnp.exp(s_blk - lse_ref[0][:, None])  # (BQ, BK)
+            p = jnp.exp(s_blk - lse_ref[0, 0][:, None])  # (BQ, BK)
             dv_sc[:] += jax.lax.dot_general(
                 p, dob, (((0,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32,
@@ -306,7 +310,7 @@ def _flash_dkv_pallas(q, k, v, do, lse, delta, slopes, kpos, kneg,
                 dob, vb, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32,
             )
-            ds = p * (dp - delta_ref[0][:, None])
+            ds = p * (dp - delta_ref[0, 0][:, None])
             dk_sc[:] += scale * jax.lax.dot_general(
                 ds, qb, (((0,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32,
@@ -324,15 +328,15 @@ def _flash_dkv_pallas(q, k, v, do, lse, delta, slopes, kpos, kneg,
             num_scalar_prefetch=0,
             grid=grid,
             in_specs=[
-                pl.BlockSpec((1,), lambda b, j, i: (b,), memory_space=pltpu.SMEM),
+                pl.BlockSpec((bh,), lambda b, j, i: (0,), memory_space=pltpu.SMEM),
                 pl.BlockSpec((1, block_q, hd), lambda b, j, i: (b, i, 0)),
                 pl.BlockSpec((1, block_k, hd), lambda b, j, i: (b // g, j, 0)),
                 pl.BlockSpec((1, block_k, hd), lambda b, j, i: (b // g, j, 0)),
                 pl.BlockSpec((1, block_q, hd), lambda b, j, i: (b, i, 0)),
-                pl.BlockSpec((1, block_q), lambda b, j, i: (b, i)),
-                pl.BlockSpec((1, block_q), lambda b, j, i: (b, i)),
-                pl.BlockSpec((1, block_k), lambda b, j, i: (b // g, j)),
-                pl.BlockSpec((1, block_k), lambda b, j, i: (b // g, j)),
+                pl.BlockSpec((1, 1, block_q), lambda b, j, i: (b, 0, i)),
+                pl.BlockSpec((1, 1, block_q), lambda b, j, i: (b, 0, i)),
+                pl.BlockSpec((1, 1, block_k), lambda b, j, i: (b // g, 0, j)),
+                pl.BlockSpec((1, 1, block_k), lambda b, j, i: (b // g, 0, j)),
             ],
             out_specs=[
                 pl.BlockSpec((1, block_k, hd), lambda b, j, i: (b, j, 0)),
@@ -351,7 +355,8 @@ def _flash_dkv_pallas(q, k, v, do, lse, delta, slopes, kpos, kneg,
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
-    )(slopes, q, k, v, do, lse, delta, kpos, kneg)
+    )(slopes, q, k, v, do, lse[:, None, :], delta[:, None, :],
+      kpos[:, None, :], kneg[:, None, :])
 
 
 def _flash_chunk_pallas(q, k, v, slopes, qpos, kpos, kneg, m0, l0, acc0,
@@ -373,15 +378,16 @@ def _flash_chunk_pallas(q, k, v, slopes, qpos, kpos, kneg, m0, l0, acc0,
                m0_ref, l0_ref, acc0_ref, m_ref, l_ref, acc_ref,
                m_sc, l_sc, acc_sc):
         ki = pl.program_id(2)
+        slope = slope_ref[pl.program_id(0)]
 
         @pl.when(ki == 0)
         def _init():
-            m_sc[:, 0] = m0_ref[0]
-            l_sc[:, 0] = l0_ref[0]
+            m_sc[:, 0] = m0_ref[0, 0]
+            l_sc[:, 0] = l0_ref[0, 0]
             acc_sc[:] = acc0_ref[0].astype(jnp.float32)
 
-        qp = qpos_ref[0].astype(jnp.float32)  # (BQ,)
-        kp = kpos_ref[0].astype(jnp.float32)  # (BK,)
+        qp = qpos_ref[0, 0].astype(jnp.float32)  # (BQ,)
+        kp = kpos_ref[0, 0].astype(jnp.float32)  # (BK,)
 
         # value-based causal block skip (positions are dynamic here, so
         # the non-ring kernel's static index skip doesn't apply): a block
@@ -396,8 +402,8 @@ def _flash_chunk_pallas(q, k, v, slopes, qpos, kpos, kneg, m0, l0, acc0,
                 qb, kb, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32,
             ) * scale
-            kn = kneg_ref[0].astype(jnp.float32)
-            s_blk = s_blk + slope_ref[0] * kp[None, :] + kn[None, :]
+            kn = kneg_ref[0, 0].astype(jnp.float32)
+            s_blk = s_blk + slope * kp[None, :] + kn[None, :]
             s_blk = s_blk + jnp.where(kp[None, :] <= qp[:, None], 0.0, NEG_INF)
 
             m_prev = m_sc[:, 0]
@@ -413,31 +419,31 @@ def _flash_chunk_pallas(q, k, v, slopes, qpos, kpos, kneg, m0, l0, acc0,
 
         @pl.when(ki == nk - 1)
         def _finish():
-            m_ref[0] = m_sc[:, 0]
-            l_ref[0] = l_sc[:, 0]
+            m_ref[0, 0] = m_sc[:, 0]
+            l_ref[0, 0] = l_sc[:, 0]
             acc_ref[0] = acc_sc[:]
 
     grid = (bh, nq, nk)
-    return pl.pallas_call(
+    m, l, acc = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=0,
             grid=grid,
             in_specs=[
-                pl.BlockSpec((1,), lambda b, i, j: (b,), memory_space=pltpu.SMEM),
+                pl.BlockSpec((bh,), lambda b, i, j: (0,), memory_space=pltpu.SMEM),
                 pl.BlockSpec((1, block_q, hd), lambda b, i, j: (b, i, 0)),
                 pl.BlockSpec((1, block_k, hd), lambda b, i, j: (b, j, 0)),
                 pl.BlockSpec((1, block_k, hd), lambda b, i, j: (b, j, 0)),
-                pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
-                pl.BlockSpec((1, block_k), lambda b, i, j: (b, j)),
-                pl.BlockSpec((1, block_k), lambda b, i, j: (b, j)),
-                pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
-                pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+                pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
+                pl.BlockSpec((1, 1, block_k), lambda b, i, j: (b, 0, j)),
+                pl.BlockSpec((1, 1, block_k), lambda b, i, j: (b, 0, j)),
+                pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
+                pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
                 pl.BlockSpec((1, block_q, hd), lambda b, i, j: (b, i, 0)),
             ],
             out_specs=[
-                pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
-                pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+                pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
+                pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
                 pl.BlockSpec((1, block_q, hd), lambda b, i, j: (b, i, 0)),
             ],
             scratch_shapes=[
@@ -447,15 +453,17 @@ def _flash_chunk_pallas(q, k, v, slopes, qpos, kpos, kneg, m0, l0, acc0,
             ],
         ),
         out_shape=[
-            jax.ShapeDtypeStruct((bh, sq), jnp.float32),
-            jax.ShapeDtypeStruct((bh, sq), jnp.float32),
+            jax.ShapeDtypeStruct((bh, 1, sq), jnp.float32),
+            jax.ShapeDtypeStruct((bh, 1, sq), jnp.float32),
             jax.ShapeDtypeStruct((bh, sq, hd), jnp.float32),
         ],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
-    )(slopes, q, k, v, qpos, kpos, kneg, m0, l0, acc0)
+    )(slopes, q, k, v, qpos[:, None, :], kpos[:, None, :], kneg[:, None, :],
+      m0[:, None, :], l0[:, None, :], acc0)
+    return m[:, 0, :], l[:, 0, :], acc
 
 
 def _xla_chunk(q, k, v, slopes, qpos, kpos, kneg, m, l, acc, scale):
@@ -510,13 +518,14 @@ def _chunk_dq_pallas(q, k, v, do, lse, delta, slopes, qpos, kpos, kneg,
     def kernel(slope_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                qpos_ref, kpos_ref, kneg_ref, dq_ref, dq_sc):
         ki = pl.program_id(2)
+        slope = slope_ref[pl.program_id(0)]
 
         @pl.when(ki == 0)
         def _init():
             dq_sc[:] = jnp.zeros_like(dq_sc)
 
-        qp = qpos_ref[0].astype(jnp.float32)
-        kp = kpos_ref[0].astype(jnp.float32)
+        qp = qpos_ref[0, 0].astype(jnp.float32)
+        kp = kpos_ref[0, 0].astype(jnp.float32)
 
         @pl.when(jnp.min(kp) <= jnp.max(qp))
         def _compute():
@@ -528,14 +537,14 @@ def _chunk_dq_pallas(q, k, v, do, lse, delta, slopes, qpos, kpos, kneg,
                 qb, kb, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32,
             ) * scale
-            s_blk = s_blk + slope_ref[0] * kp[None, :] + kneg_ref[0][None, :]
+            s_blk = s_blk + slope * kp[None, :] + kneg_ref[0, 0][None, :]
             s_blk = s_blk + jnp.where(kp[None, :] <= qp[:, None], 0.0, NEG_INF)
-            p = jnp.exp(s_blk - lse_ref[0][:, None])
+            p = jnp.exp(s_blk - lse_ref[0, 0][:, None])
             dp = jax.lax.dot_general(
                 dob, vb, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32,
             )
-            ds = p * (dp - delta_ref[0][:, None])
+            ds = p * (dp - delta_ref[0, 0][:, None])
             dq_sc[:] += scale * jax.lax.dot_general(
                 ds, kb, (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32,
@@ -552,16 +561,16 @@ def _chunk_dq_pallas(q, k, v, do, lse, delta, slopes, qpos, kpos, kneg,
             num_scalar_prefetch=0,
             grid=grid,
             in_specs=[
-                pl.BlockSpec((1,), lambda b, i, j: (b,), memory_space=pltpu.SMEM),
+                pl.BlockSpec((bh,), lambda b, i, j: (0,), memory_space=pltpu.SMEM),
                 pl.BlockSpec((1, block_q, hd), lambda b, i, j: (b, i, 0)),
                 pl.BlockSpec((1, block_k, hd), lambda b, i, j: (b, j, 0)),
                 pl.BlockSpec((1, block_k, hd), lambda b, i, j: (b, j, 0)),
                 pl.BlockSpec((1, block_q, hd), lambda b, i, j: (b, i, 0)),
-                pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
-                pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
-                pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
-                pl.BlockSpec((1, block_k), lambda b, i, j: (b, j)),
-                pl.BlockSpec((1, block_k), lambda b, i, j: (b, j)),
+                pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
+                pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
+                pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
+                pl.BlockSpec((1, 1, block_k), lambda b, i, j: (b, 0, j)),
+                pl.BlockSpec((1, 1, block_k), lambda b, i, j: (b, 0, j)),
             ],
             out_specs=pl.BlockSpec((1, block_q, hd), lambda b, i, j: (b, i, 0)),
             scratch_shapes=[pltpu.VMEM((block_q, hd), jnp.float32)],
@@ -571,7 +580,8 @@ def _chunk_dq_pallas(q, k, v, do, lse, delta, slopes, qpos, kpos, kneg,
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
-    )(slopes, q, k, v, do, lse, delta, qpos, kpos, kneg)
+    )(slopes, q, k, v, do, lse[:, None, :], delta[:, None, :],
+      qpos[:, None, :], kpos[:, None, :], kneg[:, None, :])
 
 
 def _chunk_dkv_pallas(q, k, v, do, lse, delta, slopes, qpos, kpos, kneg,
@@ -588,14 +598,15 @@ def _chunk_dkv_pallas(q, k, v, do, lse, delta, slopes, qpos, kpos, kneg,
     def kernel(slope_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                qpos_ref, kpos_ref, kneg_ref, dk_ref, dv_ref, dk_sc, dv_sc):
         qi = pl.program_id(2)
+        slope = slope_ref[pl.program_id(0)]
 
         @pl.when(qi == 0)
         def _init():
             dk_sc[:] = jnp.zeros_like(dk_sc)
             dv_sc[:] = jnp.zeros_like(dv_sc)
 
-        qp = qpos_ref[0].astype(jnp.float32)
-        kp = kpos_ref[0].astype(jnp.float32)
+        qp = qpos_ref[0, 0].astype(jnp.float32)
+        kp = kpos_ref[0, 0].astype(jnp.float32)
 
         @pl.when(jnp.min(kp) <= jnp.max(qp))
         def _compute():
@@ -607,9 +618,9 @@ def _chunk_dkv_pallas(q, k, v, do, lse, delta, slopes, qpos, kpos, kneg,
                 qb, kb, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32,
             ) * scale
-            s_blk = s_blk + slope_ref[0] * kp[None, :] + kneg_ref[0][None, :]
+            s_blk = s_blk + slope * kp[None, :] + kneg_ref[0, 0][None, :]
             s_blk = s_blk + jnp.where(kp[None, :] <= qp[:, None], 0.0, NEG_INF)
-            p = jnp.exp(s_blk - lse_ref[0][:, None])
+            p = jnp.exp(s_blk - lse_ref[0, 0][:, None])
             dv_sc[:] += jax.lax.dot_general(
                 p, dob, (((0,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32,
@@ -618,7 +629,7 @@ def _chunk_dkv_pallas(q, k, v, do, lse, delta, slopes, qpos, kpos, kneg,
                 dob, vb, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32,
             )
-            ds = p * (dp - delta_ref[0][:, None])
+            ds = p * (dp - delta_ref[0, 0][:, None])
             dk_sc[:] += scale * jax.lax.dot_general(
                 ds, qb, (((0,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32,
@@ -636,16 +647,16 @@ def _chunk_dkv_pallas(q, k, v, do, lse, delta, slopes, qpos, kpos, kneg,
             num_scalar_prefetch=0,
             grid=grid,
             in_specs=[
-                pl.BlockSpec((1,), lambda b, j, i: (b,), memory_space=pltpu.SMEM),
+                pl.BlockSpec((bh,), lambda b, j, i: (0,), memory_space=pltpu.SMEM),
                 pl.BlockSpec((1, block_q, hd), lambda b, j, i: (b, i, 0)),
                 pl.BlockSpec((1, block_k, hd), lambda b, j, i: (b, j, 0)),
                 pl.BlockSpec((1, block_k, hd), lambda b, j, i: (b, j, 0)),
                 pl.BlockSpec((1, block_q, hd), lambda b, j, i: (b, i, 0)),
-                pl.BlockSpec((1, block_q), lambda b, j, i: (b, i)),
-                pl.BlockSpec((1, block_q), lambda b, j, i: (b, i)),
-                pl.BlockSpec((1, block_q), lambda b, j, i: (b, i)),
-                pl.BlockSpec((1, block_k), lambda b, j, i: (b, j)),
-                pl.BlockSpec((1, block_k), lambda b, j, i: (b, j)),
+                pl.BlockSpec((1, 1, block_q), lambda b, j, i: (b, 0, i)),
+                pl.BlockSpec((1, 1, block_q), lambda b, j, i: (b, 0, i)),
+                pl.BlockSpec((1, 1, block_q), lambda b, j, i: (b, 0, i)),
+                pl.BlockSpec((1, 1, block_k), lambda b, j, i: (b, 0, j)),
+                pl.BlockSpec((1, 1, block_k), lambda b, j, i: (b, 0, j)),
             ],
             out_specs=[
                 pl.BlockSpec((1, block_k, hd), lambda b, j, i: (b, j, 0)),
@@ -664,7 +675,8 @@ def _chunk_dkv_pallas(q, k, v, do, lse, delta, slopes, qpos, kpos, kneg,
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
-    )(slopes, q, k, v, do, lse, delta, qpos, kpos, kneg)
+    )(slopes, q, k, v, do, lse[:, None, :], delta[:, None, :],
+      qpos[:, None, :], kpos[:, None, :], kneg[:, None, :])
 
 
 def flash_chunk_dq(q, k, v, do, lse, delta, slopes, qpos, kpos, kneg,

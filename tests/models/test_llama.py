@@ -194,3 +194,65 @@ def test_upcycle_to_moe_matches_dense(hf_model, inputs):
     assert np.isfinite(float(loss))
     for path, g in jax.tree_util.tree_leaves_with_path(grads):
         assert np.all(np.isfinite(np.asarray(g))), path
+
+
+@pytest.mark.parametrize("scaling", [
+    {"rope_type": "linear", "factor": 2.0},
+    {"rope_type": "llama3", "factor": 4.0, "low_freq_factor": 1.0,
+     "high_freq_factor": 4.0, "original_max_position_embeddings": 16},
+])
+def test_rope_scaling_matches_hf(inputs, scaling):
+    """rope_scaling checkpoints (Llama-3.1+ use 'llama3'; older long-ctx
+    finetunes use 'linear') load and match HF logits. The converter
+    previously rejected these outright (models/hf.py)."""
+    torch = pytest.importorskip("torch")
+    from transformers import LlamaConfig as HFC, LlamaForCausalLM
+
+    torch.manual_seed(7)
+    m = LlamaForCausalLM(
+        HFC(
+            vocab_size=128, hidden_size=64, intermediate_size=112,
+            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+            max_position_embeddings=64, rope_scaling=dict(scaling),
+            tie_word_embeddings=False, use_cache=False,
+        )
+    )
+    m.eval()
+    cfg, params = llama_params_from_hf(m)
+    assert cfg.rope_scaling is not None
+    assert cfg.rope_scaling.rope_type == scaling["rope_type"]
+    with torch.no_grad():
+        ref = m(input_ids=torch.tensor(inputs)).logits.numpy()
+    out = llama.forward(params, jnp.asarray(inputs), None, cfg)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-4, atol=2e-4)
+
+
+def test_rope_scaling_generate_matches_hf():
+    """KV-cache decode honors rope_scaling too (cos/sin precomputed at
+    max_len with the scaled frequencies)."""
+    torch = pytest.importorskip("torch")
+    from transformers import LlamaConfig as HFC, LlamaForCausalLM
+
+    torch.manual_seed(11)
+    m = LlamaForCausalLM(
+        HFC(
+            vocab_size=128, hidden_size=64, intermediate_size=112,
+            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+            max_position_embeddings=64,
+            rope_scaling={"rope_type": "llama3", "factor": 4.0,
+                          "low_freq_factor": 1.0, "high_freq_factor": 4.0,
+                          "original_max_position_embeddings": 16},
+            tie_word_embeddings=False, use_cache=True,
+        )
+    )
+    m.eval()
+    cfg, params = llama_params_from_hf(m)
+    ids = np.random.RandomState(29).randint(0, 128, (2, 5))
+    with torch.no_grad():
+        hf_out = m.generate(
+            torch.tensor(ids), max_new_tokens=5, do_sample=False
+        ).numpy()
+    ours = np.asarray(
+        llama.generate(params, jnp.asarray(ids), cfg, max_new_tokens=5, eos_token_id=2)
+    )
+    np.testing.assert_array_equal(ours, hf_out)
