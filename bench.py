@@ -151,6 +151,14 @@ def run_bench(force_cpu: bool) -> None:
             "flash": bloom.BloomConfig.bloom_560m(
                 dtype=jnp.bfloat16, remat=True, use_flash=True
             ),
+            # chunked CE keeps the 8 GB fp32 logits buffer off HBM
+            # (docs/perf_tpu_v5e.md) — enables the no-remat variant
+            "flash+ce8": bloom.BloomConfig.bloom_560m(
+                dtype=jnp.bfloat16, remat=True, use_flash=True, ce_chunks=8
+            ),
+            "noremat+flash+ce8": bloom.BloomConfig.bloom_560m(
+                dtype=jnp.bfloat16, remat=False, use_flash=True, ce_chunks=8
+            ),
         }
     else:  # CPU smoke fallback
         batch, seq, steps = 2, 128, 3

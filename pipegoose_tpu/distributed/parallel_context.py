@@ -10,13 +10,16 @@ pipeline transport becomes ``lax.ppermute`` inside a compiled program —
 no process groups, no RPC, no per-rank bookkeeping.
 
 Rank-layout parity with the reference (so tests and checkpoints line up):
-a global rank r in the reference decomposes as
+within one DiLoCo worker block, a global rank r decomposes exactly as in
+the reference:
 
     r = pipe_rank * (dp*sp*ep*tp) + data_rank * (sp*ep*tp)
         + seq_rank * (ep*tp) + expert_rank * tp + tensor_rank
 
-which is exactly ``devices.reshape(pp, dp, sp, ep, tp)`` with axis names
-``(pipe, data, seq, expert, tensor)``:
+realized as ``devices.reshape(w, pp, dp, sp, ep, tp)`` with axis names
+``(diloco, pipe, data, seq, expert, tensor)`` — the leading ``diloco``
+axis (worker replicas over DCN, size 1 unless DiLoCo is on) multiplies
+the whole layout and preserves the reference's intra-worker order:
 
 - TENSOR groups = contiguous blocks of size tp (initialize_tensor.py:27-56)
 - PIPELINE groups = strided by world//pp (initialize_pipeline.py:27-56)
@@ -53,6 +56,9 @@ class ParallelContext:
     data_parallel_size: int = 1
     expert_parallel_size: int = 1
     sequence_parallel_size: int = 1
+    # DiLoCo worker replicas (outermost axis; only the sync step
+    # communicates over it — optim/diloco.py)
+    diloco_parallel_size: int = 1
     devices: Optional[Sequence[jax.Device]] = None
     mesh: Mesh = dataclasses.field(init=False)
 
@@ -62,20 +68,23 @@ class ParallelContext:
         dp = self.data_parallel_size
         ep = self.expert_parallel_size
         sp = self.sequence_parallel_size
+        w = self.diloco_parallel_size
         for name, size in [("tensor", tp), ("pipeline", pp), ("data", dp),
-                           ("expert", ep), ("sequence", sp)]:
+                           ("expert", ep), ("sequence", sp), ("diloco", w)]:
             if size < 1:
                 raise ValueError(f"{name}_parallel_size must be >= 1, got {size}")
 
         devices = list(self.devices) if self.devices is not None else jax.devices()
-        world = tp * pp * dp * ep * sp
+        world = w * tp * pp * dp * ep * sp
         if len(devices) < world:
             raise ValueError(
-                f"need tp*pp*dp*ep*sp = {tp}*{pp}*{dp}*{ep}*{sp} = {world} devices, "
-                f"have {len(devices)}"
+                f"need diloco*tp*pp*dp*ep*sp = {w}*{tp}*{pp}*{dp}*{ep}*{sp} = "
+                f"{world} devices, have {len(devices)}"
                 # mirrors the reference's world-size assert (parallel_context.py:101-113)
             )
-        dev_array = np.asarray(devices[:world], dtype=object).reshape(pp, dp, sp, ep, tp)
+        dev_array = np.asarray(devices[:world], dtype=object).reshape(
+            w, pp, dp, sp, ep, tp
+        )
         self.mesh = Mesh(dev_array, MESH_AXIS_ORDER)
         _set_context(self)
 
@@ -97,24 +106,45 @@ class ParallelContext:
         ctx.data_parallel_size = sizes.get("data", 1)
         ctx.expert_parallel_size = sizes.get("expert", 1)
         ctx.sequence_parallel_size = sizes.get("seq", 1)
+        ctx.diloco_parallel_size = sizes.get("diloco", 1)
         ctx.devices = list(mesh.devices.flat)
         ctx.mesh = mesh
         _set_context(ctx)
         return ctx
 
     @classmethod
-    def init_multihost(cls, **kwargs) -> "ParallelContext":
+    def init_multihost(
+        cls,
+        coordinator_address: Optional[str] = None,
+        num_processes: Optional[int] = None,
+        process_id: Optional[int] = None,
+        **kwargs,
+    ) -> "ParallelContext":
         """Multi-host bring-up: the analog of the reference's torchrun env-var
-        path (from_torch, parallel_context.py:55-84). ``jax.distributed`` uses
-        its own coordinator discovery (TPU metadata / env vars)."""
+        path (from_torch, parallel_context.py:55-84). With no explicit
+        coordinator args, ``jax.distributed`` uses its own discovery (TPU
+        metadata / cluster env vars); pass them explicitly for generic
+        clusters (tested by tests/distributed/test_multihost.py's
+        two-process localhost smoke)."""
         import warnings
 
         import jax.distributed
 
         if not jax.distributed.is_initialized():
+            init_kw = {
+                k: v
+                for k, v in dict(
+                    coordinator_address=coordinator_address,
+                    num_processes=num_processes,
+                    process_id=process_id,
+                ).items()
+                if v is not None
+            }
             try:
-                jax.distributed.initialize()
+                jax.distributed.initialize(**init_kw)
             except (RuntimeError, ValueError) as e:
+                if init_kw:
+                    raise  # explicit coordinator config failing is an error
                 # jax raises ValueError('coordinator_address should be
                 # defined.') when no coordinator is configured
                 # no coordinator configured — single-process dev run

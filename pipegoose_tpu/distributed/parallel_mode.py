@@ -21,6 +21,10 @@ class ParallelMode(str, enum.Enum):
     PIPELINE = "pipe"
     DATA = "data"
     EXPERT = "expert"
+    # DiLoCo worker axis — outermost, spans pod slices over DCN; inner
+    # steps never emit a collective over it (optim/diloco.py). The
+    # reference only aspires to DiLoCo (its README cites the paper).
+    DILOCO = "diloco"
     # Long-context/sequence axis — new capability, absent from the reference
     # (SURVEY.md §5: sequence parallelism advertised but unimplemented).
     SEQUENCE = "seq"
@@ -36,4 +40,7 @@ class ParallelMode(str, enum.Enum):
 # where TENSOR groups are contiguous rank blocks
 # (initialize_tensor.py:27-56) and PIPELINE groups are strided by
 # world//pp (initialize_pipeline.py:27-56).
-MESH_AXIS_ORDER = ("pipe", "data", "seq", "expert", "tensor")
+# ``diloco`` sits OUTSIDE even pipe: worker replicas are whole pod
+# slices connected over DCN, and the only traffic crossing it is the
+# sync step's pmean every H steps.
+MESH_AXIS_ORDER = ("diloco", "pipe", "data", "seq", "expert", "tensor")

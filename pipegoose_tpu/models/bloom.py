@@ -70,6 +70,11 @@ class BloomConfig:
     # set when the embedding was padded for TP divisibility (pad_for_tp):
     # the true vocab size; padded logit slots are masked out of the CE
     valid_vocab_size: Optional[int] = None
+    # chunk the loss over the sequence so the (B, S, V) fp32 logits
+    # buffer (8 GB at bench shapes) never materializes — backward
+    # rematerializes per chunk (nn/tensor_parallel/layers.py:
+    # chunked_ce_sums). None = plain full-logits path.
+    ce_chunks: Optional[int] = None
 
     @property
     def head_dim(self) -> int:
@@ -350,7 +355,24 @@ def loss_fn(
     tp_axis: Optional[str] = None,
 ) -> jax.Array:
     """Next-token cross entropy (shift-by-one), masked by attention_mask,
-    vocab-parallel over ``tp_axis``."""
+    vocab-parallel over ``tp_axis``. With ``config.ce_chunks`` the loss
+    is computed chunk-by-chunk over the sequence (the full logits buffer
+    never exists — see chunked_ce_sums)."""
+    if config.ce_chunks:
+        from pipegoose_tpu.nn.tensor_parallel.layers import chunked_ce_sums
+
+        hidden = forward_hidden(params, input_ids, attention_mask, config, tp_axis)
+        w = (
+            attention_mask[:, 1:]
+            if attention_mask is not None
+            else jnp.ones_like(labels[:, 1:])
+        ).astype(jnp.float32)
+        tot, cnt = chunked_ce_sums(
+            hidden[:, :-1], labels[:, 1:], w,
+            lambda h: logits_fn(params, h, tp_axis),
+            tp_axis, config.valid_vocab_size, config.ce_chunks,
+        )
+        return tot / jnp.maximum(cnt, 1)
     logits = forward(params, input_ids, attention_mask, config, tp_axis)
     shift_logits = logits[:, :-1]
     shift_labels = labels[:, 1:]
@@ -433,6 +455,7 @@ def loss_fn_pp(
     n_microbatches: int,
     tp_axis: Optional[str] = None,
     pipe_axis: str = "pipe",
+    stage_layer_counts=None,
 ) -> jax.Array:
     """Pipeline-parallel loss: embed (vectorized over all microbatches on
     every rank — replicated compute off the critical path), GPipe over
@@ -442,8 +465,16 @@ def loss_fn_pp(
     Replaces the reference's PipelineEngine.run + scheduled backward
     (pipeline_engine.py:60-134, _job/creator.py:182-277) with one
     differentiable program.
+
+    ``stage_layer_counts`` (len-P ints): UNEVEN stages — ``params`` must
+    carry the padded block layout from ``repartition_blocks`` (driven by
+    the cost-DP ``partition_costs``); each stage runs only its own live
+    layers (lax.cond skip — see nn/pipeline_parallel/partitioner.py).
+    The analog of the reference's cost-balanced partitioning incl. its
+    embedding/head exclusions (reference partitioner.py:73-144).
     """
     from pipegoose_tpu.nn.pipeline_parallel import microbatch as mb
+    from pipegoose_tpu.nn.pipeline_parallel.partitioner import masked_stage_scan
     from pipegoose_tpu.nn.pipeline_parallel.pipeline import gpipe, last_stage_value
 
     b, s = input_ids.shape
@@ -461,12 +492,22 @@ def loss_fn_pp(
     # per-microbatch side inputs: alibi + combined mask bias
     side = jax.vmap(lambda m: attention_bias(m, config))(mbs["mask"])
 
-    def stage_fn(blocks, h, side):
-        def scan_fn(carry, blk):
-            return _block(blk, carry, side, config, tp_axis), None
+    if stage_layer_counts is not None:
+        counts = jnp.asarray(stage_layer_counts, jnp.int32)
+        n_valid = counts[jax.lax.axis_index(pipe_axis)]
 
-        h, _ = jax.lax.scan(scan_fn, h, blocks)
-        return h
+        def stage_fn(blocks, h, side):
+            return masked_stage_scan(
+                lambda blk, hh: _block(blk, hh, side, config, tp_axis),
+                blocks, h, n_valid,
+            )
+    else:
+        def stage_fn(blocks, h, side):
+            def scan_fn(carry, blk):
+                return _block(blk, carry, side, config, tp_axis), None
+
+            h, _ = jax.lax.scan(scan_fn, h, blocks)
+            return h
 
     outs = gpipe(
         stage_fn,

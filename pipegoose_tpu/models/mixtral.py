@@ -764,11 +764,10 @@ def _attention_sp(blk, x, config, tp_axis, sp_axis, pad_mask_local):
     positions — each rank slices the full cos/sin tables at its chunk
     offset (rope_scaling honored via the shared rope_cos_sin).
 
-    K/V heads are repeated to the query-head count before the ring, so
-    ring hops carry g x more bytes than a GQA-native chunk kernel
-    would — correctness first; grouped chunk index maps are a future
-    bandwidth optimization. Sliding-window configs use the dense-math
-    ring (the window is a value-based position mask in the block bias).
+    GQA is NATIVE on the flash-ring path: the nkv-headed K/V rotate the
+    ring and the chunk kernels read them via grouped index maps — hop
+    bytes shrink by g. The dense-math ring (sliding-window configs, or
+    use_flash=False) repeats K/V heads for its einsum.
 
     Shared by Mixtral and Llama (llama.loss_fn_sp imports this)."""
     from pipegoose_tpu.nn.sequence_parallel.ring_attention import (
@@ -796,15 +795,16 @@ def _attention_sp(blk, x, config, tp_axis, sp_axis, pad_mask_local):
     cos = jax.lax.dynamic_slice_in_dim(cos_f, rank * s_local, s_local, 0)
     sin = jax.lax.dynamic_slice_in_dim(sin_f, rank * s_local, s_local, 0)
     q, k = apply_rope(q, k, cos, sin)
-    k = jnp.repeat(k, groups, axis=2)
-    v = jnp.repeat(v, groups, axis=2)
 
     window = getattr(config, "sliding_window", None)
     if config.use_flash and window is None:
+        # native GQA: nkv-headed K/V ride the ring
         ctx = ring_flash_attention(
             q, k, v, sp_axis, alibi_slopes=None, kv_side=pad_mask_local
         )
     else:
+        k = jnp.repeat(k, groups, axis=2)
+        v = jnp.repeat(v, groups, axis=2)
         # no ALiBi term (RoPE carries position in q/k); window is a
         # value-based position mask in the shared block bias
         bias_fn = make_causal_alibi_bias_fn(s_local, sp_axis, window=window)
@@ -904,6 +904,123 @@ def loss_fn_sp(
     z_t = reduce_from_tensor_group(z.mean() / sp, sp_axis)
     return ExpertLoss(config.aux_loss_weight, config.z_loss_weight)(
         task, aux_t, z_t
+    )
+
+
+def loss_fn_pp_sp(
+    params: dict,
+    input_ids: jax.Array,  # (B, S_local) — sequence sharded over sp_axis
+    attention_mask: Optional[jax.Array],
+    labels: jax.Array,
+    config: MixtralConfig,
+    n_microbatches: int,
+    tp_axis: Optional[str] = None,
+    ep_axis: Optional[str] = None,
+    pipe_axis: str = "pipe",
+    sp_axis: str = "seq",
+    rng=None,
+    train: bool = True,
+) -> jax.Array:
+    """Pipeline x sequence parallel Mixtral: ring attention (RoPE at
+    global positions) runs INSIDE compiled GPipe stages, with MoE
+    routing on each rank's local tokens — the long-context + deep-model
+    composition for the RoPE/GQA/MoE family (bloom.loss_fn_pp_sp is the
+    ALiBi analog). All sp peers of a stage advance in lockstep (uniform
+    SPMD), so the ring's ppermutes and the pipeline's ppermutes compose
+    without any scheduling interaction.
+
+    Loss terms follow loss_fn_sp: cross-chunk target shift; z is exact
+    (per-token mean over equal chunks); aux is the Megatron-style rank/
+    microbatch average — zero-weight it for strict equivalence tests.
+
+    Grad sync: ``grad_sync_axes=(("pipe","sum"), ("seq","sum"))`` (+
+    ``("expert","mean")`` when expert-data replicas carry different
+    tokens)."""
+    from pipegoose_tpu.distributed.functional import reduce_from_tensor_group
+    from pipegoose_tpu.nn.pipeline_parallel import microbatch as mb
+    from pipegoose_tpu.nn.pipeline_parallel.pipeline import gpipe, last_stage_value
+    from pipegoose_tpu.nn.sequence_parallel.targets import sp_shifted_targets
+
+    M = n_microbatches
+    b, s_local = input_ids.shape
+    if attention_mask is None:
+        attention_mask = jnp.ones((b, s_local), jnp.int32)
+
+    P_pipe = jax.lax.axis_size(pipe_axis)
+    L = config.n_layer
+    if L % P_pipe:
+        raise ValueError(
+            f"n_layer={L} must be divisible by the pipe axis size {P_pipe}"
+        )
+    L_local = L // P_pipe
+    stage = jax.lax.axis_index(pipe_axis)
+    if rng is None:
+        if train and config.router_jitter:
+            raise ValueError("train=True with router jitter needs an explicit rng")
+        rng = jax.random.PRNGKey(0)
+    layer_keys = jax.random.split(rng, L)
+    local_keys = jax.lax.dynamic_slice_in_dim(layer_keys, stage * L_local, L_local, 0)
+
+    mbs = mb.split(
+        {"ids": input_ids, "mask": attention_mask, "labels": labels}, M
+    )
+    h0 = jax.vmap(
+        lambda ids: vocab_parallel_embedding(params["embed"], ids, tp_axis).astype(
+            config.dtype
+        )
+    )(mbs["ids"])
+    side = {"mask": mbs["mask"]}
+
+    def stage_fn(blocks_and_keys, h, side):
+        blocks, keys = blocks_and_keys
+
+        def scan_fn(carry, blk_key):
+            blk, key = blk_key
+            out, aux, z = _sp_block(
+                blk, carry, key, config, tp_axis, ep_axis, sp_axis,
+                side["mask"], train,
+            )
+            return out, (aux, z)
+
+        h, (aux, z) = jax.lax.scan(scan_fn, h, (blocks, keys))
+        return h, (aux.sum(), z.sum())
+
+    outs, (aux_sum, z_sum) = gpipe(
+        stage_fn,
+        (params["blocks"], local_keys),
+        h0,
+        side_inputs=side,
+        axis_name=pipe_axis,
+        remat=config.remat,
+        with_aux=True,
+    )
+
+    def head_one(h, mask_mb, labels_mb):
+        h = rms_norm(params["ln_f"], h, config.rms_eps)
+        logits = column_parallel_linear(params["lm_head"], h, tp_axis)
+        sl, sw = sp_shifted_targets(labels_mb, mask_mb, sp_axis)
+        per_tok = vocab_parallel_cross_entropy(
+            logits, sl, tp_axis, valid_size=config.valid_vocab_size
+        )
+        w = sw.astype(per_tok.dtype)
+        return (per_tok * w).sum(), w.sum()
+
+    tot, cnt = jax.vmap(head_one)(outs, mbs["mask"], mbs["labels"])
+    count = jax.lax.psum(cnt.sum(), sp_axis)
+    task_local = reduce_from_tensor_group(
+        tot.sum() / jnp.maximum(count, 1), sp_axis
+    )
+    task = last_stage_value(task_local, pipe_axis)
+
+    sp = jax.lax.axis_size(sp_axis)
+    aux_mean = reduce_from_tensor_group(
+        reduce_from_tensor_group(aux_sum, pipe_axis), sp_axis
+    ) / (L * M * sp)
+    z_mean = reduce_from_tensor_group(
+        reduce_from_tensor_group(z_sum, pipe_axis), sp_axis
+    ) / (L * M * sp)
+    return ExpertLoss(config.aux_loss_weight, config.z_loss_weight)(
+        task, aux_mean, z_mean
     )
 
 

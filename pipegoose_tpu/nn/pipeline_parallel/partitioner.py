@@ -14,24 +14,27 @@ from the profiler), compute the contiguous assignment minimizing the
 bottleneck stage cost (exact interval-partition DP, not the reference's
 greedy running-total heuristic, partitioner.py:101-144).
 
-Why the RUNTIME uses equal stages only (gpipe/one_f_one_b consume an
-evenly pipe-sharded stacked dim): the pipeline is ONE compiled SPMD
-program — every pipe rank runs the same executable over identically-
-shaped param shards, which is exactly what makes the thread/RPC engine
-of the reference unnecessary. Genuinely uneven stages need per-rank
-DIFFERENT param shapes (an MPMD runtime) or padding every stage to the
-longest (which costs the padded compute on every stage and erases the
-balancing win). For transformer stacks — identical per-layer cost by
-construction — equal split IS the DP optimum; this partitioner is for
-cost analysis and for heterogeneous-cost stacks feeding a future
-per-stage-compiled (MPMD) runtime.
+How UNEVEN stages run under SPMD (repartition_blocks + masked_stage_scan):
+one compiled program requires identically-shaped param shards per pipe
+rank, so stage p's ``n_p`` layers are padded to ``L_max = max_p n_p``
+slots — but the pad slots are NOT computed-and-masked: ``lax.cond`` on
+the runtime predicate ``slot < counts[stage]`` genuinely skips the block
+at run time (the same device-varying-branch mechanism the 1F1B runtime
+uses for its fwd/bwd/idle ``lax.switch``). Per-clock wall time on a
+stage is therefore proportional to its OWN layer cost, and the DP split
+minimizes the bottleneck stage — the balancing win is real, at the price
+of ``P * L_max - L`` zero-weight pad slots in HBM. For transformer
+stacks with identical per-layer cost the equal split IS the DP optimum
+and the plain evenly-sharded path stays the default.
 """
 from __future__ import annotations
 
 from typing import Any, List, Sequence
 
 import jax
+import jax.numpy as jnp
 import numpy as np
+from jax import lax
 
 
 def layer_param_counts(stacked_params: Any) -> np.ndarray:
@@ -73,6 +76,51 @@ def partition_costs(costs: Sequence[float], n_partitions: int) -> List[range]:
         bounds.append(cut[p][bounds[-1]])
     bounds.reverse()
     return [range(bounds[i], bounds[i + 1]) for i in range(P)]
+
+
+def repartition_blocks(blocks: Any, ranges: Sequence[range]):
+    """Stacked ``(L, ...)`` block params -> padded ``(P * L_max, ...)``
+    layout for UNEVEN pipeline stages: stage p's local slice (after
+    pipe-sharding the leading dim) holds its ``len(ranges[p])`` layers in
+    slots ``[0, n_p)``; pad slots are zeros and are SKIPPED at runtime by
+    :func:`masked_stage_scan`. Returns ``(padded_blocks, counts)`` where
+    ``counts[p]`` is stage p's live-layer count (pass it as the
+    ``stage_layer_counts`` of the model's pipeline loss).
+
+    The layer ORDER is preserved across stages (ranges must be the
+    contiguous, sorted output of :func:`partition_costs`)."""
+    P = len(ranges)
+    lens = [len(r) for r in ranges]
+    L_max = max(lens)
+    counts = np.asarray(lens, dtype=np.int32)
+
+    def f(x):
+        x = np.asarray(x)
+        out = np.zeros((P, L_max) + x.shape[1:], dtype=x.dtype)
+        for p, r in enumerate(ranges):
+            out[p, : len(r)] = x[list(r)]
+        return jnp.asarray(out.reshape((P * L_max,) + x.shape[1:]))
+
+    return jax.tree_util.tree_map(f, blocks), counts
+
+
+def masked_stage_scan(block_fn, blocks_local: Any, h: Any, n_valid: jax.Array):
+    """Scan this stage's ``L_max`` padded layer slots, applying
+    ``block_fn(blk, h) -> h`` only to the first ``n_valid`` — the
+    ``lax.cond`` predicate is a runtime value (``counts[axis_index]``),
+    so pad slots genuinely skip the block's FLOPs instead of computing
+    and masking them."""
+    L_max = jax.tree_util.tree_leaves(blocks_local)[0].shape[0]
+
+    def scan_fn(carry, xs):
+        blk, i = xs
+        out = lax.cond(
+            i < n_valid, lambda hh: block_fn(blk, hh), lambda hh: hh, carry
+        )
+        return out, None
+
+    h, _ = lax.scan(scan_fn, h, (blocks_local, jnp.arange(L_max)))
+    return h
 
 
 class UniformPartitioner:

@@ -114,7 +114,7 @@ def ring_attention(
 
 def ring_flash_attention(
     q: jax.Array,  # (B, S_local, nh, hd)
-    k: jax.Array,
+    k: jax.Array,  # (B, S_local, nh | nkv, hd) — fewer kv heads = native GQA
     v: jax.Array,
     axis_name: Optional[str],
     alibi_slopes: Optional[jax.Array] = None,  # (nh,)
@@ -140,26 +140,33 @@ def ring_flash_attention(
     Semantics match ``ring_attention(..., make_causal_alibi_bias_fn)``
     exactly: causal on GLOBAL positions, ALiBi slope * global key
     position, padding from the chunk's mask.
+
+    GQA: when ``k``/``v`` carry fewer heads than ``q`` (``nh = g *
+    nkv``), the chunk kernels read the shared K/V via grouped index
+    maps AND the ring rotates only the nkv-headed K/V — hop bytes
+    shrink by g, exactly the traffic long-context GQA models care
+    about. dK/dV contributions are computed per query head and
+    group-summed into nkv-headed carriers riding the ring.
     """
     b, s_local, nh, hd = q.shape
+    nkv = k.shape[2]
+    if nh % nkv:
+        raise ValueError(f"n_head={nh} must be a multiple of n_kv_head={nkv}")
+    g = nh // nkv
     if scale is None:
         scale = hd**-0.5
     if alibi_slopes is None:
         alibi_slopes = jnp.zeros((nh,), jnp.float32)
 
     def flat(x):
-        return x.transpose(0, 2, 1, 3).reshape(b * nh, s_local, hd)
-
-    def flat_bs(x):  # (B, S) -> (B*nh, S)
-        return jnp.broadcast_to(
-            x.astype(jnp.float32)[:, None, :], (b, nh, s_local)
-        ).reshape(b * nh, s_local)
+        h = x.shape[2]
+        return x.transpose(0, 2, 1, 3).reshape(b * h, s_local, hd)
 
     slopes = jnp.broadcast_to(
         alibi_slopes.astype(jnp.float32)[None], (b, nh)
     ).reshape(b * nh)
     # the pad bias rides the ring PER BATCH (B, S_local) — broadcasting
-    # to (B*nh, S_local) happens per chunk call, not per hop
+    # to (B*nkv, S_local) happens per chunk call, not per hop
     if kv_side is not None:
         kneg = (1.0 - kv_side.astype(jnp.float32)) * NEG_INF
     else:
@@ -167,7 +174,7 @@ def ring_flash_attention(
 
     out = _ring_flash(
         flat(q), flat(k), flat(v), slopes, kneg,
-        axis_name, float(scale), interpret,
+        axis_name, float(scale), interpret, g,
     )
     return out.reshape(b, nh, s_local, hd).transpose(0, 2, 1, 3).astype(q.dtype)
 
@@ -195,16 +202,17 @@ def _expand_heads(x_b, bh):
     return jnp.broadcast_to(x_b[:, None, :], (b, nh, s)).reshape(bh, s)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
-def _ring_flash(q, k, v, slopes, kneg, axis_name, scale, interpret):
-    out, _ = _ring_flash_fwd_pass(q, k, v, slopes, kneg, axis_name, scale, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
+def _ring_flash(q, k, v, slopes, kneg, axis_name, scale, interpret, g=1):
+    out, _ = _ring_flash_fwd_pass(q, k, v, slopes, kneg, axis_name, scale, interpret, g)
     return out
 
 
-def _ring_flash_fwd_pass(q, k, v, slopes, kneg, axis_name, scale, interpret):
+def _ring_flash_fwd_pass(q, k, v, slopes, kneg, axis_name, scale, interpret, g=1):
     from pipegoose_tpu.ops.flash_attention import flash_ring_chunk
 
     bh, s_local, hd = q.shape
+    bkv = k.shape[0]  # b * nkv rows under GQA
     _, qpos = _ring_positions(axis_name, bh, s_local)
     state0 = (
         jnp.full((bh, s_local), NEG_INF, jnp.float32),
@@ -215,8 +223,8 @@ def _ring_flash_fwd_pass(q, k, v, slopes, kneg, axis_name, scale, interpret):
     def chunk(state, k_t, v_t, kv_rank, kneg_t):
         m, l, acc = state
         return flash_ring_chunk(
-            q, k_t, v_t, slopes, qpos, _kpos_for(kv_rank, bh, s_local),
-            _expand_heads(kneg_t, bh), m, l, acc, scale, interpret,
+            q, k_t, v_t, slopes, qpos, _kpos_for(kv_rank, bkv, s_local),
+            _expand_heads(kneg_t, bkv), m, l, acc, scale, interpret, g,
         )
 
     m, l, acc = _ring_scan(chunk, state0, k, v, kneg, axis_name)
@@ -226,35 +234,41 @@ def _ring_flash_fwd_pass(q, k, v, slopes, kneg, axis_name, scale, interpret):
     return out, lse
 
 
-def _ring_flash_vjp_fwd(q, k, v, slopes, kneg, axis_name, scale, interpret):
+def _ring_flash_vjp_fwd(q, k, v, slopes, kneg, axis_name, scale, interpret, g=1):
     out, lse = _ring_flash_fwd_pass(
-        q, k, v, slopes, kneg, axis_name, scale, interpret
+        q, k, v, slopes, kneg, axis_name, scale, interpret, g
     )
     # O(S_local) residuals only — no per-ring-step stacking
     return out, (q, k, v, slopes, kneg, out, lse)
 
 
-def _ring_flash_vjp_bwd(axis_name, scale, interpret, res, dout):
+def _ring_flash_vjp_bwd(axis_name, scale, interpret, g, res, dout):
     from pipegoose_tpu.ops.flash_attention import flash_chunk_dq, flash_chunk_dkv
 
     q, k, v, slopes, kneg, out, lse = res
     bh, s_local, hd = q.shape
+    bkv = k.shape[0]
     rank, qpos = _ring_positions(axis_name, bh, s_local)
     sp = lax.axis_size(axis_name) if axis_name else 1
     delta = (dout.astype(jnp.float32) * out.astype(jnp.float32)).sum(-1)
 
     def contributions(dq, dk, dv, k_t, v_t, kneg_t, t):
         kv_rank = (rank - t) % sp
-        kpos = _kpos_for(kv_rank, bh, s_local)
-        kneg_h = _expand_heads(kneg_t, bh)
+        kpos = _kpos_for(kv_rank, bkv, s_local)
+        kneg_h = _expand_heads(kneg_t, bkv)
         dq = dq + flash_chunk_dq(
             q, k_t, v_t, dout, lse, delta, slopes, qpos, kpos, kneg_h,
-            scale, interpret,
+            scale, interpret, g,
         )
         dkc, dvc = flash_chunk_dkv(
             q, k_t, v_t, dout, lse, delta, slopes, qpos, kpos, kneg_h,
-            scale, interpret,
+            scale, interpret, g,
         )
+        if g > 1:
+            # per-query-head contributions -> shared kv-head carriers
+            # (rows ordered so g consecutive query heads share one kv row)
+            dkc = dkc.reshape(-1, g, s_local, hd).sum(1)
+            dvc = dvc.reshape(-1, g, s_local, hd).sum(1)
         return dq, dk + dkc, dv + dvc
 
     def step(carry, t):
@@ -268,7 +282,7 @@ def _ring_flash_vjp_bwd(axis_name, scale, interpret, res, dout):
         dv = shift_right(dv, axis_name) if axis_name else dv
         return (k_t, v_t, kneg_t, dk, dv, dq), None
 
-    zeros_kv = jnp.zeros((bh, s_local, hd), jnp.float32)
+    zeros_kv = jnp.zeros((bkv, s_local, hd), jnp.float32)
     dq0 = jnp.zeros((bh, s_local, hd), jnp.float32)
     if sp == 1:
         dq, dk, dv = contributions(dq0, zeros_kv, zeros_kv, k, v, kneg, 0)

@@ -148,6 +148,55 @@ def vocab_parallel_cross_entropy(
     return _vp_ce(logits, targets, axis_name)
 
 
+def chunked_ce_sums(
+    hidden: jax.Array,   # (B, T, H) — already shifted to align with labels
+    labels: jax.Array,   # (B, T)
+    weights: jax.Array,  # (B, T) float mask
+    logits_fn,           # (B, C, H) -> (B, C, V/tp) local-shard logits
+    axis_name: Optional[str],
+    valid_size: Optional[int],
+    n_chunks: int,
+):
+    """(weighted loss sum, weight sum) without ever materializing the
+    (B, T, V) logits: scan over T/n_chunks sequence chunks, computing
+    each chunk's logits + CE inside ``jax.checkpoint`` so backward
+    rematerializes them chunk by chunk. Bounds the logits working set to
+    1/n_chunks — at bloom-560m bench shapes the full fp32 buffer is
+    ~8 GB (b8 x s1024 x v250880), the single largest HBM consumer of
+    the train step (docs/perf_tpu_v5e.md).
+
+    The reference computes full logits then its VocabParallelCrossEntropy
+    (loss.py:14-89); chunking composes with the same vocab-parallel CE,
+    so the TP semantics (incl. padded-vocab masking) are unchanged."""
+    b, t, h = hidden.shape
+    if t % n_chunks:
+        pad = n_chunks - t % n_chunks
+        hidden = jnp.pad(hidden, ((0, 0), (0, pad), (0, 0)))
+        labels = jnp.pad(labels, ((0, 0), (0, pad)))
+        weights = jnp.pad(weights, ((0, 0), (0, pad)))  # pad weight 0
+        t += pad
+    c = t // n_chunks
+    hs = hidden.reshape(b, n_chunks, c, h).transpose(1, 0, 2, 3)
+    ls = labels.reshape(b, n_chunks, c).transpose(1, 0, 2)
+    ws = weights.reshape(b, n_chunks, c).transpose(1, 0, 2)
+
+    def chunk(carry, xs):
+        tot, cnt = carry
+        h_c, l_c, w_c = xs
+        logits = logits_fn(h_c)
+        per_tok = vocab_parallel_cross_entropy(
+            logits, l_c, axis_name, valid_size=valid_size
+        )
+        w_c = w_c.astype(per_tok.dtype)
+        return (tot + (per_tok * w_c).sum(), cnt + w_c.sum()), None
+
+    zero = jnp.zeros((), jnp.float32)
+    (tot, cnt), _ = jax.lax.scan(
+        jax.checkpoint(chunk), (zero, zero), (hs, ls, ws)
+    )
+    return tot, cnt
+
+
 def mask_padded_vocab(
     logits: jax.Array, axis_name: Optional[str], valid_size: int
 ) -> jax.Array:

@@ -138,16 +138,21 @@ def zero_param_spec(param_spec, param_ndim: int, axis_name: str = "data"):
     return P(new0, *rest)
 
 
-def state_specs(state_tree, params, param_specs, axis_name: str = "data"):
+def state_specs(state_tree, params, param_specs, axis_name: str = "data",
+                leaf_spec_fn=None):
     """PartitionSpec pytree for a ZeroState (or a shape-struct of one).
 
     optax states are nested (Named)tuples whose momentum-like members are
     whole pytrees with the SAME treedef as params (e.g. adam's mu/nu);
     those get per-param ZeRO specs, every other leaf (counts, scalars)
     replicates. Use with ``init_shapes``/``jax.eval_shape``.
+
+    ``leaf_spec_fn(param_spec, param_ndim) -> spec`` overrides the
+    per-param mapping (default: ZeRO dim-0 sharding over ``axis_name``).
     """
     from jax.sharding import PartitionSpec as P
 
+    fn = leaf_spec_fn or (lambda s, nd: zero_param_spec(s, nd, axis_name))
     params_def = jax.tree_util.tree_structure(params)
     spec_leaves = jax.tree_util.tree_leaves(param_specs, is_leaf=lambda x: isinstance(x, P))
     ndim_leaves = [getattr(p, "ndim", 0) for p in jax.tree_util.tree_leaves(params)]
@@ -161,10 +166,7 @@ def state_specs(state_tree, params, param_specs, axis_name: str = "data"):
     def rec(node):
         if is_params_like(node):
             leaves, treedef = jax.tree_util.tree_flatten(node)
-            mapped = [
-                zero_param_spec(s, nd, axis_name)
-                for s, nd in zip(spec_leaves, ndim_leaves)
-            ]
+            mapped = [fn(s, nd) for s, nd in zip(spec_leaves, ndim_leaves)]
             return jax.tree_util.tree_unflatten(treedef, mapped)
         if isinstance(node, (tuple, list)) and not hasattr(node, "shape"):
             mapped = [rec(c) for c in node]
@@ -177,6 +179,15 @@ def state_specs(state_tree, params, param_specs, axis_name: str = "data"):
         return P()
 
     return rec(state_tree)
+
+
+def plain_state_specs(state_tree, params, param_specs):
+    """Specs for an UNSHARDED optax state: momentum-like members follow
+    the param specs directly, scalars replicate (e.g. the DiLoCo outer
+    optimizer's Nesterov momentum on the anchor)."""
+    return state_specs(
+        state_tree, params, param_specs, leaf_spec_fn=lambda s, nd: s
+    )
 
 
 def shard_shapes(params, dp_size: int):

@@ -127,17 +127,20 @@ class Trainer:
         self,
         batches: Iterable[Any],
         rng: Optional[jax.Array] = None,
+        weight_fn: Optional[Any] = None,
     ) -> float:
         """Mean loss over ``batches`` with the CURRENT params — no
         gradients, no optimizer update (the eval half the reference's
         Trainer stub never got, trainer.py:13-35). Runs the same
         sharded loss_fn as training, jitted once.
 
-        Per-batch losses average with EQUAL weight; for attention-masked
-        batches with very different valid-token counts this is not the
-        corpus token-weighted mean (same caveat as
-        core/accumulation.py:make_accumulating_loss) — keep eval batches
-        comparably full or weight externally."""
+        ``weight_fn(batch) -> float`` weights each batch's (internally
+        normalized) loss in the running mean. For ragged eval sets pass
+        the batch's valid-token count — e.g.
+        ``lambda b: float(b["attention_mask"][:, 1:].sum())`` — and the
+        result is the corpus TOKEN-weighted mean, the number eval
+        reports should quote. Default: equal batch weights (exact when
+        every batch carries the same token count)."""
         if self._eval_fn is None:
             from pipegoose_tpu.parallel.hybrid import shard_map  # jax<0.6-safe
 
@@ -167,17 +170,19 @@ class Trainer:
             )
         if rng is None:
             rng = jax.random.PRNGKey(0)
-        total, n = 0.0, 0
+        total, n = 0.0, 0.0
         for i, batch in enumerate(batches):
             args = (self.params, batch)
             if self.with_rng:
                 args = args + (jax.random.fold_in(rng, i),)
-            total += float(self._eval_fn(*args))
-            n += 1
+            w = float(weight_fn(batch)) if weight_fn is not None else 1.0
+            total += w * float(self._eval_fn(*args))
+            n += w
         if n == 0:
             raise ValueError(
-                "evaluate() received no batches (an exhausted generator?) — "
-                "0.0 would be indistinguishable from perfect convergence"
+                "evaluate() received no batches (an exhausted generator?) or "
+                "all batch weights were zero — 0.0 would be "
+                "indistinguishable from perfect convergence"
             )
         return total / n
 

@@ -1,0 +1,165 @@
+"""Single-chip perf sweep on the real TPU: flash block sizes + model
+config levers (remat, flash on/off) for the bloom-560m bench shape.
+
+Timing recipe per bench.py: loop inside jit (lax.scan), scalar fetch,
+RTT subtracted. One attach per run (tunnel is single-client).
+
+    python scripts/sweep_tpu_perf.py [kernel|model]
+"""
+from __future__ import annotations
+
+import functools
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+def measure_rtt():
+    tiny = jax.jit(lambda x: x + 1.0)
+    z = jnp.zeros(())
+    float(tiny(z))
+    t0 = time.perf_counter()
+    for _ in range(3):
+        float(tiny(z))
+    return (time.perf_counter() - t0) / 3
+
+
+def timed_chain(step_fn, x0, iters):
+    """step_fn: x -> x (same shape/dtype). Returns ms/iter."""
+
+    @jax.jit
+    def chain(x):
+        def body(c, _):
+            return step_fn(c), ()
+        o, _ = lax.scan(body, x, None, length=iters)
+        return jax.tree_util.tree_map(
+            lambda a: a.astype(jnp.float32).sum(), o
+        )
+
+    r = chain(x0)
+    jax.tree_util.tree_map(lambda a: float(a), r)  # compile+warm
+    rtt = measure_rtt()
+    t0 = time.perf_counter()
+    r = chain(x0)
+    jax.tree_util.tree_map(lambda a: float(a), r)
+    return max(time.perf_counter() - t0 - rtt, 1e-9) / iters * 1e3
+
+
+def kernel_sweep():
+    from pipegoose_tpu.ops import flash_attention as fa
+
+    b, s, nh, hd = 8, 2048, 16, 64
+    key = jax.random.PRNGKey(0)
+    kq, kk, kv_ = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (b, s, nh, hd), jnp.bfloat16)
+    k = jax.random.normal(kk, (b, s, nh, hd), jnp.bfloat16)
+    v = jax.random.normal(kv_, (b, s, nh, hd), jnp.bfloat16)
+    slopes = jnp.asarray([2.0 ** (-(i + 1)) for i in range(nh)], jnp.float32)
+
+    results = {}
+    orig = fa._pick_block
+    for bq in (128, 256, 512):
+        for bk in (128, 256, 512, 1024):
+            if bq > s or bk > s:
+                continue
+
+            # the production call sites pass target=128 for q blocks and
+            # target=512 for kv blocks — dispatch the override on that
+            def pick(n, target=128, _bq=bq, _bk=bk):
+                return _bq if target == 128 else _bk
+
+            fa._pick_block = pick
+
+            def fwd(x):
+                return fa.flash_attention(
+                    x, k, v, alibi_slopes=slopes, causal=True, interpret=False
+                ).astype(jnp.bfloat16)
+
+            def fwdbwd(x):
+                return jax.grad(
+                    lambda y: (fwd(y).astype(jnp.float32) ** 2).sum()
+                )(x).astype(jnp.bfloat16)
+
+            try:
+                ms_f = timed_chain(fwd, q, 20)
+                ms_fb = timed_chain(fwdbwd, q, 10)
+                results[f"bq{bq}_bk{bk}"] = {
+                    "fwd_ms": round(ms_f, 3), "fwd_bwd_ms": round(ms_fb, 3)
+                }
+            except Exception as e:  # noqa: BLE001
+                results[f"bq{bq}_bk{bk}"] = {
+                    "error": f"{type(e).__name__}: {e}"[:200]
+                }
+            print(f"bq{bq}_bk{bk}", json.dumps(results[f"bq{bq}_bk{bk}"]),
+                  flush=True)
+    fa._pick_block = orig
+    print(json.dumps(results))
+
+
+def model_sweep():
+    import optax
+
+    from pipegoose_tpu.models import bloom
+
+    batch, seq, steps = 8, 1024, 8
+    variants = {
+        "remat+xla": dict(remat=True, use_flash=False),
+        "noremat+xla": dict(remat=False, use_flash=False),
+        "remat+flash": dict(remat=True, use_flash=True),
+        "noremat+flash": dict(remat=False, use_flash=True),
+    }
+    results = {}
+    for name, kw in variants.items():
+        cfg = bloom.BloomConfig.bloom_560m(dtype=jnp.bfloat16, **kw)
+        b = batch
+        while True:
+            try:
+                params = bloom.init_params(cfg, jax.random.PRNGKey(0))
+                opt = optax.adam(1e-4)
+                opt_state = opt.init(params)
+                ids = jnp.asarray(
+                    np.random.RandomState(0).randint(0, cfg.vocab_size, (b, seq))
+                )
+
+                @functools.partial(jax.jit, donate_argnums=(0, 1))
+                def run(params, opt_state, ids, cfg=cfg):
+                    def body(carry, _):
+                        p, o = carry
+                        loss, g = jax.value_and_grad(bloom.loss_fn)(
+                            p, ids, None, ids, cfg
+                        )
+                        u, o = opt.update(g, o, p)
+                        return (optax.apply_updates(p, u), o), loss
+                    (p, o), losses = lax.scan(
+                        body, (params, opt_state), None, length=steps
+                    )
+                    return p, o, losses[-1]
+
+                params, opt_state, loss = run(params, opt_state, ids)
+                float(loss)
+                rtt = measure_rtt()
+                t0 = time.perf_counter()
+                params, opt_state, loss = run(params, opt_state, ids)
+                float(loss)
+                dt = max(time.perf_counter() - t0 - rtt, 1e-9)
+                tps = b * seq * steps / dt
+                results[name] = {"tokens_per_sec": round(tps, 1), "batch": b}
+                break
+            except Exception as e:  # noqa: BLE001
+                if "RESOURCE_EXHAUSTED" in str(e) and b > 1:
+                    b //= 2
+                    continue
+                results[name] = {"error": f"{type(e).__name__}: {e}"[:300]}
+                break
+        print(name, json.dumps(results[name]), flush=True)
+    print(json.dumps(results))
+
+
+if __name__ == "__main__":
+    mode = sys.argv[1] if len(sys.argv) > 1 else "kernel"
+    (kernel_sweep if mode == "kernel" else model_sweep)()

@@ -206,3 +206,52 @@ def test_padded_vocab_ce_matches_unpadded(ctx):
         check_vma=False,
     )
     np.testing.assert_allclose(fn(padded_logits, targets), ref, rtol=1e-5, atol=1e-6)
+
+
+def test_chunked_ce_matches_plain(ctx):
+    """chunked_ce_sums == full-logits CE (loss AND grads), single-device
+    and under TP, with a ragged mask and a chunk-count that doesn't
+    divide the sequence (pad path). The chunking bounds the logits
+    working set to 1/n_chunks — the 8 GB fp32 buffer fix of
+    docs/perf_tpu_v5e.md."""
+    import dataclasses
+
+    from pipegoose_tpu.models import bloom
+
+    cfg = bloom.BloomConfig(vocab_size=128, hidden_size=64, n_layer=2, n_head=4)
+    params = bloom.init_params(cfg, jax.random.PRNGKey(0))
+    ids = jnp.asarray(np.random.RandomState(1).randint(0, 128, (2, 13)))
+    mask = np.ones((2, 13), np.int32)
+    mask[0, -4:] = 0
+    mask = jnp.asarray(mask)
+
+    ref_l, ref_g = jax.value_and_grad(bloom.loss_fn)(params, ids, mask, ids, cfg)
+    cfg_c = dataclasses.replace(cfg, ce_chunks=4)  # 12 % 4 == 0, but 13-1... pad exercised with 5
+    got_l, got_g = jax.value_and_grad(bloom.loss_fn)(params, ids, mask, ids, cfg_c)
+    assert abs(float(ref_l) - float(got_l)) < 1e-5
+    for (p, r), g in zip(
+        jax.tree_util.tree_leaves_with_path(ref_g),
+        jax.tree_util.tree_leaves(got_g),
+    ):
+        np.testing.assert_allclose(
+            np.asarray(g), np.asarray(r), rtol=1e-4, atol=1e-6, err_msg=str(p)
+        )
+
+    # pad path: 5 chunks over 12 shifted tokens
+    cfg_p = dataclasses.replace(cfg, ce_chunks=5)
+    pad_l = float(bloom.loss_fn(params, ids, mask, ids, cfg_p))
+    assert abs(float(ref_l) - pad_l) < 1e-5
+
+    # TP: vocab-parallel CE inside the chunk scan
+    specs = bloom.tp_specs(params)
+    fn = jax.jit(
+        shard_map(
+            lambda p, i, m: bloom.loss_fn(p, i, m, i, cfg_c, tp_axis="tensor"),
+            mesh=ctx.mesh,
+            in_specs=(specs, P(), P()),
+            out_specs=P(),
+            check_vma=False,
+        )
+    )
+    tp_l = float(fn(params, ids, mask))
+    assert abs(tp_l - float(ref_l)) < 2e-4, (tp_l, float(ref_l))
