@@ -145,31 +145,55 @@ def run_bench(force_cpu: bool) -> None:
     )
 
     if on_tpu:
-        batch, seq, steps = 8, 1024, 10
+        steps = 10
+        # variant -> (config, batch, seq)
         variants = {
-            "xla": bloom.BloomConfig.bloom_560m(dtype=jnp.bfloat16, remat=True),
-            "flash": bloom.BloomConfig.bloom_560m(
-                dtype=jnp.bfloat16, remat=True, use_flash=True
+            "xla": (
+                bloom.BloomConfig.bloom_560m(dtype=jnp.bfloat16, remat=True),
+                8, 1024,
+            ),
+            "flash": (
+                bloom.BloomConfig.bloom_560m(
+                    dtype=jnp.bfloat16, remat=True, use_flash=True
+                ),
+                8, 1024,
+            ),
+            # longer sequence, same token count: the flash kernels' edge
+            # over XLA attention grows with S (docs/perf_tpu_v5e.md)
+            "flash_s2048": (
+                bloom.BloomConfig.bloom_560m(
+                    dtype=jnp.bfloat16, remat=True, use_flash=True
+                ),
+                4, 2048,
             ),
             # chunked CE keeps the 8 GB fp32 logits buffer off HBM
             # (docs/perf_tpu_v5e.md) — enables the no-remat variant
-            "flash+ce8": bloom.BloomConfig.bloom_560m(
-                dtype=jnp.bfloat16, remat=True, use_flash=True, ce_chunks=8
+            "flash+ce8": (
+                bloom.BloomConfig.bloom_560m(
+                    dtype=jnp.bfloat16, remat=True, use_flash=True, ce_chunks=8
+                ),
+                8, 1024,
             ),
-            "noremat+flash+ce8": bloom.BloomConfig.bloom_560m(
-                dtype=jnp.bfloat16, remat=False, use_flash=True, ce_chunks=8
+            "noremat+flash+ce8": (
+                bloom.BloomConfig.bloom_560m(
+                    dtype=jnp.bfloat16, remat=False, use_flash=True, ce_chunks=8
+                ),
+                8, 1024,
             ),
         }
     else:  # CPU smoke fallback
-        batch, seq, steps = 2, 128, 3
+        steps = 3
         variants = {
-            "xla": bloom.BloomConfig(
-                vocab_size=1024, hidden_size=256, n_layer=4, n_head=8,
-                dtype=jnp.float32,
+            "xla": (
+                bloom.BloomConfig(
+                    vocab_size=1024, hidden_size=256, n_layer=4, n_head=8,
+                    dtype=jnp.float32,
+                ),
+                2, 128,
             )
         }
 
-    def measure(cfg, batch):
+    def measure(cfg, batch, seq):
         params = bloom.init_params(cfg, jax.random.PRNGKey(0))
         opt = optax.adam(1e-4)
         opt_state = opt.init(params)
@@ -231,14 +255,15 @@ def run_bench(force_cpu: bool) -> None:
         }
 
     results = {}
-    for name, cfg in variants.items():
+    for name, (cfg, batch, seq) in variants.items():
         # a failing variant (e.g. an experimental kernel) must not discard
         # the other variants' measurements; OOM backs off the batch size
         b = batch
         while True:
             try:
-                results[name] = measure(cfg, b)
+                results[name] = measure(cfg, b, seq)
                 results[name]["batch"] = b
+                results[name]["seq"] = seq
                 break
             except Exception as e:  # noqa: BLE001
                 if "RESOURCE_EXHAUSTED" in str(e) and b > 1:

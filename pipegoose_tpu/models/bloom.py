@@ -33,6 +33,7 @@ from typing import Any, Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.ad_checkpoint import checkpoint_name
 
 from pipegoose_tpu.nn.parallel_mapping import (
     Column,
@@ -64,6 +65,14 @@ class BloomConfig:
     dtype: Any = jnp.float32
     # rematerialize each block's activations in backward (HBM for FLOPs)
     remat: bool = False
+    # selective-remat policy under remat=True: None saves nothing (full
+    # remat); "dots" saves matmul outputs except batch-dim ones
+    # (jax.checkpoint_policies.dots_with_no_batch_dims_saveable);
+    # "attn" saves only the per-block attention outputs
+    # (checkpoint_name "attn_out", present on every attention variant)
+    # so backward never re-runs attention — between full remat (slow,
+    # tiny HBM) and no remat (fast, 2x HBM)
+    remat_policy: Optional[str] = None
     # fused Pallas flash attention (ops/flash_attention.py): causal+alibi,
     # padding masks supported via the kernel's kv_pos/kv_neg bias inputs
     use_flash: bool = False
@@ -83,6 +92,25 @@ class BloomConfig:
     @classmethod
     def bloom_560m(cls, **kw) -> "BloomConfig":
         return cls(vocab_size=250880, hidden_size=1024, n_layer=24, n_head=16, **kw)
+
+
+def _remat_wrap(fn, config):
+    """``jax.checkpoint`` honoring ``config.remat_policy`` (caller gates
+    on ``config.remat``)."""
+    policy = getattr(config, "remat_policy", None)
+    if policy == "dots":
+        return jax.checkpoint(
+            fn, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+        )
+    if policy == "attn":
+        # save only the attention outputs (checkpoint_name "attn_out",
+        # set on every _attention/_attention_sp branch): backward
+        # recomputes the cheap elementwise/matmul parts but never
+        # re-runs attention — for ~(B,S,H) x n_layer extra HBM
+        return jax.checkpoint(
+            fn, policy=jax.checkpoint_policies.save_only_these_names("attn_out")
+        )
+    return jax.checkpoint(fn)
 
 
 # -- init ------------------------------------------------------------------
@@ -220,6 +248,7 @@ def _attention(
             q, k, v, slopes,
             kv_pos=bias["kv_pos"], kv_neg=bias["kv_neg"], causal=True,
         )
+        ctx = checkpoint_name(ctx, "attn_out")  # for remat_policy="attn"
         ctx = ctx.astype(x.dtype).reshape(b, s, local_heads * hd)
         return row_parallel_linear(blk["out"], ctx, tp_axis)
 
@@ -233,6 +262,7 @@ def _attention(
     scores = scores * (1.0 / math.sqrt(hd)) + alibi + bias["mask_bias"]
     probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(x.dtype)
     ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, v, preferred_element_type=jnp.float32)
+    ctx = checkpoint_name(ctx, "attn_out")
     ctx = ctx.astype(x.dtype).reshape(b, s, local_heads * hd)
     return row_parallel_linear(blk["out"], ctx, tp_axis)
 
@@ -303,7 +333,7 @@ def forward_hidden(
 
     block = partial(_block, config=config, tp_axis=tp_axis)
     if config.remat:
-        block = jax.checkpoint(block)
+        block = _remat_wrap(block, config)
 
     def scan_fn(carry, blk):
         return block(blk, carry, bias), None
@@ -474,7 +504,10 @@ def loss_fn_pp(
     embedding/head exclusions (reference partitioner.py:73-144).
     """
     from pipegoose_tpu.nn.pipeline_parallel import microbatch as mb
-    from pipegoose_tpu.nn.pipeline_parallel.partitioner import masked_stage_scan
+    from pipegoose_tpu.nn.pipeline_parallel.partitioner import (
+        masked_stage_scan,
+        stage_n_valid,
+    )
     from pipegoose_tpu.nn.pipeline_parallel.pipeline import gpipe, last_stage_value
 
     b, s = input_ids.shape
@@ -492,19 +525,29 @@ def loss_fn_pp(
     # per-microbatch side inputs: alibi + combined mask bias
     side = jax.vmap(lambda m: attention_bias(m, config))(mbs["mask"])
 
+    # with a selective remat_policy, checkpoint PER BLOCK (the policy's
+    # named values live inside _block) instead of letting gpipe wrap the
+    # whole stage — same semantics as the dense/1F1B paths
+    def block_call(blk, hh, side):
+        return _block(blk, hh, side, config, tp_axis)
+
+    if config.remat and getattr(config, "remat_policy", None):
+        block_call = _remat_wrap(block_call, config)
+        gpipe_remat = False
+    else:
+        gpipe_remat = config.remat
+
     if stage_layer_counts is not None:
-        counts = jnp.asarray(stage_layer_counts, jnp.int32)
-        n_valid = counts[jax.lax.axis_index(pipe_axis)]
+        n_valid = stage_n_valid(stage_layer_counts, config.n_layer, pipe_axis)
 
         def stage_fn(blocks, h, side):
             return masked_stage_scan(
-                lambda blk, hh: _block(blk, hh, side, config, tp_axis),
-                blocks, h, n_valid,
+                lambda blk, hh: block_call(blk, hh, side), blocks, h, n_valid
             )
     else:
         def stage_fn(blocks, h, side):
             def scan_fn(carry, blk):
-                return _block(blk, carry, side, config, tp_axis), None
+                return block_call(blk, carry, side), None
 
             h, _ = jax.lax.scan(scan_fn, h, blocks)
             return h
@@ -515,7 +558,7 @@ def loss_fn_pp(
         h0,
         side_inputs=side,
         axis_name=pipe_axis,
-        remat=config.remat,
+        remat=gpipe_remat,
     )  # (M, mb, S, H), valid on last stage
 
     # vectorized head over all microbatches
@@ -542,6 +585,7 @@ def loss_fn_1f1b(
     n_microbatches: int,
     tp_axis: Optional[str] = None,
     pipe_axis: str = "pipe",
+    stage_layer_counts=None,
 ) -> jax.Array:
     """Pipeline-parallel loss with the 1F1B (PipeDream-flush) runtime:
     same semantics as :func:`loss_fn_pp` (identical loss value and
@@ -549,6 +593,11 @@ def loss_fn_1f1b(
     instead of the microbatch count — each microbatch's backward starts
     as soon as its forward clears the last stage
     (nn/pipeline_parallel/pipeline.py:one_f_one_b).
+
+    ``stage_layer_counts``: UNEVEN stages exactly as in :func:`loss_fn_pp`
+    — ``params["blocks"]`` must carry the padded ``repartition_blocks``
+    layout; pad slots are lax.cond-skipped in both the forward and the
+    rematerialized backward of each stage.
 
     Implemented as a ``jax.custom_vjp`` whose forward runs the fused
     forward+backward pipeline and stashes the parameter gradients as
@@ -580,14 +629,27 @@ def loss_fn_1f1b(
 
     block = _partial(_block, config=config, tp_axis=tp_axis)
     if config.remat:
-        block = jax.checkpoint(block)
+        block = _remat_wrap(block, config)
 
-    def stage_fn(blocks, h, side):
-        def scan_fn(carry, blk):
-            return block(blk, carry, side), None
+    if stage_layer_counts is not None:
+        from pipegoose_tpu.nn.pipeline_parallel.partitioner import (
+            masked_stage_scan,
+            stage_n_valid,
+        )
 
-        h, _ = jax.lax.scan(scan_fn, h, blocks)
-        return h
+        n_valid = stage_n_valid(stage_layer_counts, config.n_layer, pipe_axis)
+
+        def stage_fn(blocks, h, side):
+            return masked_stage_scan(
+                lambda blk, hh: block(blk, hh, side), blocks, h, n_valid
+            )
+    else:
+        def stage_fn(blocks, h, side):
+            def scan_fn(carry, blk):
+                return block(blk, carry, side), None
+
+            h, _ = jax.lax.scan(scan_fn, h, blocks)
+            return h
 
     def head_fn(hp, h, side):
         h = layer_norm(hp["ln_f"], h, config.layer_norm_epsilon)
@@ -734,6 +796,7 @@ def _attention_sp(
     else:
         bias_fn = make_causal_alibi_bias_fn(s_local, sp_axis, alibi_slopes=slopes)
         ctx = ring_attention(q, k, v, sp_axis, bias_fn, kv_side=pad_mask_local)
+    ctx = checkpoint_name(ctx, "attn_out")
     ctx = ctx.astype(x.dtype).reshape(b, s_local, local_heads * hd)
     return row_parallel_linear(blk["out"], ctx, tp_axis)
 
@@ -767,7 +830,7 @@ def loss_fn_sp(
             blk, carry, config, tp_axis, sp_axis, attention_mask, variant
         ), None
 
-    step = jax.checkpoint(scan_fn) if config.remat else scan_fn
+    step = _remat_wrap(scan_fn, config) if config.remat else scan_fn
     x, _ = jax.lax.scan(step, x, params["blocks"])
 
     total, w_sum = _sp_head_sums(

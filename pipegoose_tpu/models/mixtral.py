@@ -21,6 +21,7 @@ from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from pipegoose_tpu.nn.expert_parallel.experts import moe_layer
 from pipegoose_tpu.nn.expert_parallel.loss import ExpertLoss
@@ -406,13 +407,19 @@ def loss_fn(params, input_ids, attention_mask, labels, config,
 
 
 def _pp_prologue(
-    input_ids, attention_mask, labels, config, n_microbatches, pipe_axis, rng, train
+    input_ids, attention_mask, labels, config, n_microbatches, pipe_axis, rng,
+    train, stage_layer_counts=None,
 ):
     """Shared pipeline setup for the GPipe and 1F1B Mixtral losses:
     validates the stage split, derives THIS stage's slice of the same
     L-layer router keys the dense path uses, splits microbatches, and
     builds the RoPE tables + per-microbatch attention bias (M-leading,
-    ready as gpipe/1F1B side inputs)."""
+    ready as gpipe/1F1B side inputs).
+
+    ``stage_layer_counts``: UNEVEN stages — the keys for stage p's live
+    slots are ``layer_keys[offset_p : offset_p + n_p]`` (layer ORDER as
+    in ``repartition_blocks``), padded to L_max; pad-slot keys are
+    zeros and never reach a router (the masked scan skips the block)."""
     from pipegoose_tpu.nn.pipeline_parallel import microbatch as mb
 
     b, s = input_ids.shape
@@ -421,11 +428,6 @@ def _pp_prologue(
 
     P_pipe = jax.lax.axis_size(pipe_axis)
     L = config.n_layer
-    if L % P_pipe:
-        raise ValueError(
-            f"n_layer={L} must be divisible by the pipe axis size {P_pipe}"
-        )
-    L_local = L // P_pipe
     stage = jax.lax.axis_index(pipe_axis)
 
     if rng is None:
@@ -433,7 +435,33 @@ def _pp_prologue(
             raise ValueError("train=True with router jitter needs an explicit rng")
         rng = jax.random.PRNGKey(0)
     layer_keys = jax.random.split(rng, L)  # (L, 2) — same keys as dense
-    local_keys = jax.lax.dynamic_slice_in_dim(layer_keys, stage * L_local, L_local, 0)
+
+    if stage_layer_counts is not None:
+        counts_np = np.asarray(stage_layer_counts, np.int64)
+        if len(counts_np) != P_pipe or counts_np.sum() != L:
+            raise ValueError(
+                f"stage_layer_counts {tuple(stage_layer_counts)} must have "
+                f"{P_pipe} entries summing to n_layer={L}"
+            )
+        L_max = int(counts_np.max())
+        offsets = jnp.asarray(
+            np.concatenate([[0], np.cumsum(counts_np)[:-1]]), jnp.int32
+        )
+        keys_padded = jnp.concatenate(
+            [layer_keys, jnp.zeros((L_max,) + layer_keys.shape[1:], layer_keys.dtype)]
+        )
+        local_keys = jax.lax.dynamic_slice_in_dim(
+            keys_padded, offsets[stage], L_max, 0
+        )
+    else:
+        if L % P_pipe:
+            raise ValueError(
+                f"n_layer={L} must be divisible by the pipe axis size {P_pipe}"
+            )
+        L_local = L // P_pipe
+        local_keys = jax.lax.dynamic_slice_in_dim(
+            layer_keys, stage * L_local, L_local, 0
+        )
 
     mbs = mb.split(
         {"ids": input_ids, "mask": attention_mask, "labels": labels}, n_microbatches
@@ -443,18 +471,43 @@ def _pp_prologue(
     return attention_mask, mbs, cos, sin, local_keys, L, side
 
 
-def _stage_scan(blocks, keys, h, bias, cos, sin, config, tp_axis, ep_axis, train):
+def _stage_scan(blocks, keys, h, bias, cos, sin, config, tp_axis, ep_axis,
+                train, n_valid=None):
     """Scan this stage's local layer slice; returns (h, aux (L_local,),
-    z (L_local,)). Shared by the GPipe and 1F1B stage functions."""
+    z (L_local,)). Shared by the GPipe and 1F1B stage functions.
 
-    def scan_fn(carry, blk_key):
-        blk, key = blk_key
+    ``n_valid`` (runtime scalar): UNEVEN stages — slots >= n_valid are
+    pad layers, genuinely skipped by ``lax.cond`` (zero aux/z, h passes
+    through). Collective-safe for the same reason as
+    ``masked_stage_scan``: the predicate varies only over the pipe
+    axis, so all tensor/expert peers of a stage take the same branch."""
+
+    def live(blk, key, hh):
         out, aux, z = _block(
-            blk, carry, cos, sin, bias, key, config, tp_axis, ep_axis, train
+            blk, hh, cos, sin, bias, key, config, tp_axis, ep_axis, train
         )
-        return out, (aux, z)
+        return out, (aux.astype(jnp.float32), z.astype(jnp.float32))
 
-    h, (aux, z) = jax.lax.scan(scan_fn, h, (blocks, keys))
+    if n_valid is None:
+        def scan_fn(carry, blk_key):
+            blk, key = blk_key
+            return live(blk, key, carry)
+
+        h, (aux, z) = jax.lax.scan(scan_fn, h, (blocks, keys))
+        return h, aux, z
+
+    L_max = jax.tree_util.tree_leaves(blocks)[0].shape[0]
+
+    def scan_fn(carry, xs):
+        blk, key, i = xs
+        return jax.lax.cond(
+            i < n_valid,
+            lambda hh: live(blk, key, hh),
+            lambda hh: (hh, (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32))),
+            carry,
+        )
+
+    h, (aux, z) = jax.lax.scan(scan_fn, h, (blocks, keys, jnp.arange(L_max)))
     return h, aux, z
 
 
@@ -470,11 +523,17 @@ def loss_fn_pp(
     ep_axis: Optional[str] = None,
     rng: Optional[jax.Array] = None,
     train: bool = True,
+    stage_layer_counts=None,
 ) -> jax.Array:
     """Pipeline-parallel Mixtral loss: the 4D TP x PP x DP x EP
     composition (BASELINE config 5 shape; the reference's group layout
     supports it at parallel_context.py:173-198 but never demonstrates it
     end-to-end).
+
+    ``stage_layer_counts``: UNEVEN stages exactly as in
+    ``bloom.loss_fn_pp`` — ``params["blocks"]`` must carry the padded
+    ``repartition_blocks`` layout; router keys follow the same layer
+    order (see ``_pp_prologue``).
 
     Structure mirrors bloom.loss_fn_pp (vectorized embed -> compiled
     GPipe over the pipe-sharded block stack -> vectorized head) plus the
@@ -495,7 +554,14 @@ def loss_fn_pp(
 
     M = n_microbatches
     attention_mask, mbs, cos, sin, local_keys, L, side = _pp_prologue(
-        input_ids, attention_mask, labels, config, M, pipe_axis, rng, train
+        input_ids, attention_mask, labels, config, M, pipe_axis, rng, train,
+        stage_layer_counts,
+    )
+    from pipegoose_tpu.nn.pipeline_parallel.partitioner import stage_n_valid
+
+    n_valid = (
+        stage_n_valid(stage_layer_counts, config.n_layer, pipe_axis)
+        if stage_layer_counts is not None else None
     )
 
     h0 = jax.vmap(
@@ -507,7 +573,8 @@ def loss_fn_pp(
     def stage_fn(blocks_and_keys, h, side):
         blocks, keys = blocks_and_keys
         h, aux, z = _stage_scan(
-            blocks, keys, h, side["bias"], cos, sin, config, tp_axis, ep_axis, train
+            blocks, keys, h, side["bias"], cos, sin, config, tp_axis, ep_axis,
+            train, n_valid,
         )
         return h, (aux.sum(), z.sum())
 
@@ -584,13 +651,15 @@ def loss_fn_1f1b(
     ep_axis: Optional[str] = None,
     rng: Optional[jax.Array] = None,
     train: bool = True,
+    stage_layer_counts=None,
 ) -> jax.Array:
     """Mixtral pipeline loss on the 1F1B runtime: same value/gradients
     as :func:`loss_fn_pp` with O(stages) activation memory. Router aux/z
     losses ride ``one_f_one_b``'s ``with_aux`` channel: each stage's
     pre-weighted aux scalar seeds its OWN backward, so router gradients
     never cross stages, and the per-rank loss sums combine with one
-    psum over the pipe axis."""
+    psum over the pipe axis. ``stage_layer_counts``: UNEVEN stages as in
+    :func:`loss_fn_pp`."""
     from pipegoose_tpu.nn.pipeline_parallel.pipeline import (
         manual_grads_loss,
         one_f_one_b,
@@ -598,7 +667,14 @@ def loss_fn_1f1b(
 
     M = n_microbatches
     attention_mask, mbs, cos, sin, local_keys, L, side = _pp_prologue(
-        input_ids, attention_mask, labels, config, M, pipe_axis, rng, train
+        input_ids, attention_mask, labels, config, M, pipe_axis, rng, train,
+        stage_layer_counts,
+    )
+    from pipegoose_tpu.nn.pipeline_parallel.partitioner import stage_n_valid
+
+    n_valid = (
+        stage_n_valid(stage_layer_counts, config.n_layer, pipe_axis)
+        if stage_layer_counts is not None else None
     )
     side = {**side, "labels": mbs["labels"], "mask": mbs["mask"]}
     inv_count = 1.0 / jnp.maximum(attention_mask[:, 1:].sum().astype(jnp.float32), 1)
@@ -608,7 +684,7 @@ def loss_fn_1f1b(
         # arrays must not enter the differentiated stage_params pytree
         h, aux, z = _stage_scan(
             blocks, local_keys, h, side["bias"], cos, sin,
-            config, tp_axis, ep_axis, train,
+            config, tp_axis, ep_axis, train, n_valid,
         )
         aux_scalar = (
             config.aux_loss_weight * aux.sum() + config.z_loss_weight * z.sum()
@@ -764,10 +840,10 @@ def _attention_sp(blk, x, config, tp_axis, sp_axis, pad_mask_local):
     positions — each rank slices the full cos/sin tables at its chunk
     offset (rope_scaling honored via the shared rope_cos_sin).
 
-    GQA is NATIVE on the flash-ring path: the nkv-headed K/V rotate the
-    ring and the chunk kernels read them via grouped index maps — hop
-    bytes shrink by g. The dense-math ring (sliding-window configs, or
-    use_flash=False) repeats K/V heads for its einsum.
+    GQA is NATIVE on both ring paths: the nkv-headed K/V rotate the
+    ring — the flash chunk kernels read them via grouped index maps,
+    the dense-math ring (sliding-window configs, or use_flash=False)
+    via a grouped einsum. Hop bytes shrink by g either way.
 
     Shared by Mixtral and Llama (llama.loss_fn_sp imports this)."""
     from pipegoose_tpu.nn.sequence_parallel.ring_attention import (
@@ -780,7 +856,6 @@ def _attention_sp(blk, x, config, tp_axis, sp_axis, pad_mask_local):
     hd = config.head_dim
     tp = jax.lax.axis_size(tp_axis) if tp_axis else 1
     nh_l, nkv_l = config.n_head // tp, config.n_kv_head // tp
-    groups = nh_l // nkv_l
 
     q = column_parallel_linear(blk["q"], x, tp_axis).reshape(b, s_local, nh_l, hd)
     k = column_parallel_linear(blk["k"], x, tp_axis).reshape(b, s_local, nkv_l, hd)
@@ -803,8 +878,6 @@ def _attention_sp(blk, x, config, tp_axis, sp_axis, pad_mask_local):
             q, k, v, sp_axis, alibi_slopes=None, kv_side=pad_mask_local
         )
     else:
-        k = jnp.repeat(k, groups, axis=2)
-        v = jnp.repeat(v, groups, axis=2)
         # no ALiBi term (RoPE carries position in q/k); window is a
         # value-based position mask in the shared block bias
         bias_fn = make_causal_alibi_bias_fn(s_local, sp_axis, window=window)

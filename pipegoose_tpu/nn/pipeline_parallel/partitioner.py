@@ -104,6 +104,21 @@ def repartition_blocks(blocks: Any, ranges: Sequence[range]):
     return jax.tree_util.tree_map(f, blocks), counts
 
 
+def stage_n_valid(stage_layer_counts, n_layer: int, axis_name: str = "pipe"):
+    """Validate ``stage_layer_counts`` against the pipe axis and return
+    THIS stage's live-layer count (traced scalar). Validation matters:
+    jnp's clamped gather would turn a wrong-length tuple into silently
+    wrong layer counts on the trailing stages."""
+    P = lax.axis_size(axis_name)
+    counts = np.asarray(stage_layer_counts, np.int64)
+    if len(counts) != P or counts.sum() != n_layer:
+        raise ValueError(
+            f"stage_layer_counts {tuple(int(c) for c in counts)} must have "
+            f"{P} entries (pipe axis size) summing to n_layer={n_layer}"
+        )
+    return jnp.asarray(counts, jnp.int32)[lax.axis_index(axis_name)]
+
+
 def masked_stage_scan(block_fn, blocks_local: Any, h: Any, n_valid: jax.Array):
     """Scan this stage's ``L_max`` padded layer slots, applying
     ``block_fn(blk, h) -> h`` only to the first ``n_valid`` — the

@@ -67,8 +67,8 @@ def _ring_scan(chunk_fn, state, k, v, kv_side, axis_name):
 
 def ring_attention(
     q: jax.Array,  # (B, Sq_local, nh, hd)
-    k: jax.Array,  # (B, Skv_local, nh, hd)
-    v: jax.Array,  # (B, Skv_local, nh, hd)
+    k: jax.Array,  # (B, Skv_local, nh | nkv, hd) — fewer kv heads = native GQA
+    v: jax.Array,
     axis_name: Optional[str],
     bias_fn: Callable[[jax.Array], jax.Array],
     kv_side: Optional[jax.Array] = None,  # e.g. (B, Skv_local) pad mask, rides the ring
@@ -80,8 +80,18 @@ def ring_attention(
     bias for the block where the resident K/V originated at ``kv_rank``.
     With ``axis_name=None`` this is single-device flash-style attention
     (one step, kv_rank = 0).
+
+    GQA: when ``k``/``v`` carry ``nkv < nh`` heads (``nh = g * nkv``,
+    query head h reads kv head h // g — the same grouping as
+    :func:`ring_flash_attention`), the grouped einsum reads the shared
+    K/V directly and only the nkv-headed K/V rides the ring — hop bytes
+    shrink by g, with no materialized head repetition.
     """
     b, sq, nh, hd = q.shape
+    nkv = k.shape[2]
+    if nh % nkv:
+        raise ValueError(f"n_head={nh} must be a multiple of n_kv_head={nkv}")
+    g = nh // nkv
     if scale is None:
         scale = hd**-0.5
 
@@ -89,7 +99,14 @@ def ring_attention(
 
     def block(state, k_t, v_t, kv_rank, side_t):
         m, l, o = state
-        s = jnp.einsum("bqhd,bkhd->bhqk", qf, k_t.astype(jnp.float32))
+        skv = k_t.shape[1]
+        if g == 1:
+            s = jnp.einsum("bqhd,bkhd->bhqk", qf, k_t.astype(jnp.float32))
+        else:
+            qg = qf.reshape(b, sq, nkv, g, hd)
+            s = jnp.einsum(
+                "bqhgd,bkhd->bhgqk", qg, k_t.astype(jnp.float32)
+            ).reshape(b, nh, sq, skv)
         bias = bias_fn(kv_rank, side_t) if side_t is not None else bias_fn(kv_rank)
         s = s + bias
         m_new = jnp.maximum(m, s.max(axis=-1))
@@ -97,7 +114,13 @@ def ring_attention(
         p = jnp.exp(s - m_new[..., None])
         alpha = jnp.exp(m - m_new)
         l_new = l * alpha + p.sum(axis=-1)
-        pv = jnp.einsum("bhqk,bkhd->bhqd", p, v_t.astype(jnp.float32))
+        if g == 1:
+            pv = jnp.einsum("bhqk,bkhd->bhqd", p, v_t.astype(jnp.float32))
+        else:
+            pg = p.reshape(b, nkv, g, sq, skv)
+            pv = jnp.einsum(
+                "bhgqk,bkhd->bhgqd", pg, v_t.astype(jnp.float32)
+            ).reshape(b, nh, sq, hd)
         o_new = o * alpha[..., None] + pv
         return m_new, l_new, o_new
 

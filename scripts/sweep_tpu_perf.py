@@ -108,15 +108,21 @@ def model_sweep():
 
     batch, seq, steps = 8, 1024, 8
     variants = {
-        "remat+xla": dict(remat=True, use_flash=False),
-        "noremat+xla": dict(remat=False, use_flash=False),
         "remat+flash": dict(remat=True, use_flash=True),
-        "noremat+flash": dict(remat=False, use_flash=True),
+        "attn+flash": dict(remat=True, remat_policy="attn", use_flash=True),
+        "attn+flash+ce8": dict(
+            remat=True, remat_policy="attn", use_flash=True, ce_chunks=8
+        ),
+        "attn+flash+ce8_b16": dict(
+            remat=True, remat_policy="attn", use_flash=True, ce_chunks=8,
+            _batch=16,
+        ),
     }
     results = {}
     for name, kw in variants.items():
+        kw = dict(kw)
+        b = kw.pop("_batch", batch)
         cfg = bloom.BloomConfig.bloom_560m(dtype=jnp.bfloat16, **kw)
-        b = batch
         while True:
             try:
                 params = bloom.init_params(cfg, jax.random.PRNGKey(0))

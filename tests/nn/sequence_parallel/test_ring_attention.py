@@ -324,6 +324,63 @@ def test_ring_flash_memory_bound(ctx):
     assert t_flash < 0.6 * t_ring, (t_flash, t_ring)
 
 
+def test_ring_dense_gqa_matches_repeated(ctx):
+    """Native-GQA dense-math ring (grouped einsum, nkv-headed K/V on the
+    ring) == repeated-heads ring — forward AND grads, with a sliding
+    window in the bias (the config that actually routes to the dense
+    ring in mixtral._attention_sp)."""
+    NKV = 2  # NH=4 query heads sharing 2 kv heads (g=2)
+    ks = jax.random.split(jax.random.PRNGKey(21), 3)
+    q = jax.random.normal(ks[0], (B, S, NH, HD))
+    k = jax.random.normal(ks[1], (B, S, NKV, HD))
+    v = jax.random.normal(ks[2], (B, S, NKV, HD))
+    pad = np.ones((B, S), np.int32)
+    pad[0, -6:] = 0
+    pad = jnp.asarray(pad)
+    g = NH // NKV
+
+    def make(native, with_loss):
+        def body(q, k, v, pad):
+            bias_fn = make_causal_alibi_bias_fn(S_LOCAL, "seq", window=12)
+            if native:
+                o = ring_attention(q, k, v, "seq", bias_fn, kv_side=pad)
+            else:
+                o = ring_attention(
+                    q, jnp.repeat(k, g, axis=2), jnp.repeat(v, g, axis=2),
+                    "seq", bias_fn, kv_side=pad,
+                )
+            if with_loss:
+                w = pad.astype(o.dtype)[:, :, None, None]
+                return jax.lax.psum(((o * w) ** 2).sum(), "seq")
+            return o
+
+        return shard_map(
+            body, mesh=ctx.mesh,
+            in_specs=(P(None, "seq"),) * 4,
+            out_specs=P() if with_loss else P(None, "seq"),
+            check_vma=False,
+        )
+
+    out_n = make(True, False)(q, k, v, pad)
+    out_r = make(False, False)(q, k, v, pad)
+    valid = np.asarray(pad, bool)
+    np.testing.assert_allclose(
+        np.asarray(out_n)[valid], np.asarray(out_r)[valid],
+        rtol=2e-5, atol=2e-6,
+    )
+
+    g_n = jax.grad(
+        lambda q, k, v: make(True, True)(q, k, v, pad), argnums=(0, 1, 2)
+    )(q, k, v)
+    g_r = jax.grad(
+        lambda q, k, v: make(False, True)(q, k, v, pad), argnums=(0, 1, 2)
+    )(q, k, v)
+    for a, b, name in zip(g_n, g_r, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5, err_msg=name
+        )
+
+
 def test_ring_flash_gqa_matches_repeated(ctx):
     """Native-GQA ring flash (nkv-headed K/V riding the ring, grouped
     chunk index maps) == the same attention with K/V heads repeated —
