@@ -436,13 +436,12 @@ def _pp_prologue(
         rng = jax.random.PRNGKey(0)
     layer_keys = jax.random.split(rng, L)  # (L, 2) — same keys as dense
 
+    from pipegoose_tpu.nn.pipeline_parallel.partitioner import stage_n_valid
+
+    n_valid = None
     if stage_layer_counts is not None:
+        n_valid = stage_n_valid(stage_layer_counts, L, pipe_axis)  # validates
         counts_np = np.asarray(stage_layer_counts, np.int64)
-        if len(counts_np) != P_pipe or counts_np.sum() != L:
-            raise ValueError(
-                f"stage_layer_counts {tuple(stage_layer_counts)} must have "
-                f"{P_pipe} entries summing to n_layer={L}"
-            )
         L_max = int(counts_np.max())
         offsets = jnp.asarray(
             np.concatenate([[0], np.cumsum(counts_np)[:-1]]), jnp.int32
@@ -468,7 +467,7 @@ def _pp_prologue(
     )
     cos, sin = rope_cos_sin(s, config.head_dim, config.rope_theta)
     side = {"bias": jax.vmap(lambda m: rope_attention_bias(m, config))(mbs["mask"])}
-    return attention_mask, mbs, cos, sin, local_keys, L, side
+    return attention_mask, mbs, cos, sin, local_keys, L, side, n_valid
 
 
 def _stage_scan(blocks, keys, h, bias, cos, sin, config, tp_axis, ep_axis,
@@ -553,15 +552,9 @@ def loss_fn_pp(
     from pipegoose_tpu.nn.pipeline_parallel.pipeline import gpipe, last_stage_value
 
     M = n_microbatches
-    attention_mask, mbs, cos, sin, local_keys, L, side = _pp_prologue(
+    attention_mask, mbs, cos, sin, local_keys, L, side, n_valid = _pp_prologue(
         input_ids, attention_mask, labels, config, M, pipe_axis, rng, train,
         stage_layer_counts,
-    )
-    from pipegoose_tpu.nn.pipeline_parallel.partitioner import stage_n_valid
-
-    n_valid = (
-        stage_n_valid(stage_layer_counts, config.n_layer, pipe_axis)
-        if stage_layer_counts is not None else None
     )
 
     h0 = jax.vmap(
@@ -666,15 +659,9 @@ def loss_fn_1f1b(
     )
 
     M = n_microbatches
-    attention_mask, mbs, cos, sin, local_keys, L, side = _pp_prologue(
+    attention_mask, mbs, cos, sin, local_keys, L, side, n_valid = _pp_prologue(
         input_ids, attention_mask, labels, config, M, pipe_axis, rng, train,
         stage_layer_counts,
-    )
-    from pipegoose_tpu.nn.pipeline_parallel.partitioner import stage_n_valid
-
-    n_valid = (
-        stage_n_valid(stage_layer_counts, config.n_layer, pipe_axis)
-        if stage_layer_counts is not None else None
     )
     side = {**side, "labels": mbs["labels"], "mask": mbs["mask"]}
     inv_count = 1.0 / jnp.maximum(attention_mask[:, 1:].sum().astype(jnp.float32), 1)

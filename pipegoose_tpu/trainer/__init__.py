@@ -1,5 +1,10 @@
 from pipegoose_tpu.trainer.callback import Callback, CheckpointCallback, LossLoggerCallback
 from pipegoose_tpu.trainer.logger import DistributedLogger
+from pipegoose_tpu.trainer.recovery import (
+    AutoRecovery,
+    FailureDetector,
+    TrainingDiverged,
+)
 from pipegoose_tpu.trainer.state import TrainerState, TrainerStatus
 from pipegoose_tpu.trainer.trainer import Trainer
 
@@ -11,4 +16,7 @@ __all__ = [
     "DistributedLogger",
     "TrainerState",
     "TrainerStatus",
+    "FailureDetector",
+    "AutoRecovery",
+    "TrainingDiverged",
 ]

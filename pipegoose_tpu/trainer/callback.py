@@ -68,7 +68,10 @@ class CheckpointCallback(Callback):
             cb.on_checkpoint(trainer, step, path)
 
     def on_step_end(self, trainer: Any, step: int, loss: float) -> None:
-        if step > 0 and step % self.every == 0:
+        # step <= _last_saved happens when AutoRecovery rewound the
+        # trainer to a checkpointed step in THIS callback round — that
+        # state is already on disk, and re-saving would collide
+        if step > 0 and step % self.every == 0 and step > self._last_saved:
             self._save(trainer, step)
 
     def on_fit_end(self, trainer: Any) -> None:

@@ -1,0 +1,142 @@
+"""Failure detection and automatic recovery for the training loop.
+
+The reference has NO failure handling of any kind — no retry, no health
+checks; ``destroy()`` is its only lifecycle management (SURVEY.md §5,
+reference parallel_context.py:390-407). This module fills that gap with
+the failure mode that actually ends large training runs: numerical
+divergence (NaN/Inf loss, loss spikes from bad batches or optimizer
+blow-ups).
+
+Two composable callbacks:
+
+- :class:`FailureDetector` watches the per-step loss and raises
+  :class:`TrainingDiverged` on non-finite values or spikes beyond
+  ``spike_factor`` x the running median. Detection costs one device
+  fetch per checked step (set ``check_every`` > 1 to keep JAX's async
+  dispatch pipelined between checks).
+- :class:`AutoRecovery` extends detection with self-healing: on failure
+  it restores params + optimizer state from the newest checkpoint in
+  ``directory`` (pair it with ``CheckpointCallback`` writing there),
+  rewinds ``trainer.state.step``, and lets ``fit`` continue with the
+  incoming data stream — the diverging update never reaches the
+  surviving state, and the batches that triggered it are naturally
+  skipped (the iterator has moved past them). After ``max_restores``
+  restores it re-raises: a deterministic NaN (bad lr, broken data) must
+  surface, not loop forever.
+
+Single-controller SPMD makes this simpler than the reference's world
+would have allowed: there is ONE process to detect and ONE state to
+restore — no distributed consensus about who failed.
+"""
+from __future__ import annotations
+
+import math
+from collections import deque
+from typing import Any, Optional
+
+from pipegoose_tpu.trainer.callback import Callback
+
+
+class TrainingDiverged(RuntimeError):
+    """Loss went non-finite (or spiked) and recovery was impossible or
+    exhausted."""
+
+
+class FailureDetector(Callback):
+    """Detect numerical divergence from the loss stream.
+
+    ``spike_factor``: optional; flag loss > spike_factor * median of the
+    last ``window`` finite losses (needs at least ``window // 2``
+    history before it arms — startup loss drops must not trip it).
+    """
+
+    order = -10  # run before logging/checkpoint callbacks see the step
+
+    def __init__(
+        self,
+        check_every: int = 1,
+        spike_factor: Optional[float] = None,
+        window: int = 50,
+    ):
+        if check_every < 1:
+            raise ValueError(f"check_every must be >= 1, got {check_every}")
+        if window < 2:
+            raise ValueError(f"window must be >= 2, got {window}")
+        self.check_every = check_every
+        self.spike_factor = spike_factor
+        self.window = window
+        self._history: deque = deque(maxlen=window)
+
+    def _is_divergent(self, loss: float) -> Optional[str]:
+        if not math.isfinite(loss):
+            return f"non-finite loss {loss}"
+        if self.spike_factor is not None and len(self._history) >= max(1, self.window // 2):
+            med = sorted(self._history)[len(self._history) // 2]
+            if loss > self.spike_factor * med:
+                return (
+                    f"loss spike {loss:.4g} > {self.spike_factor} x "
+                    f"median {med:.4g}"
+                )
+        self._history.append(loss)
+        return None
+
+    def on_step_end(self, trainer: Any, step: int, loss) -> None:
+        if step % self.check_every:
+            return
+        reason = self._is_divergent(float(loss))
+        if reason is not None:
+            self.handle_failure(trainer, step, reason)
+
+    def handle_failure(self, trainer: Any, step: int, reason: str) -> None:
+        raise TrainingDiverged(f"step {step}: {reason}")
+
+
+class AutoRecovery(FailureDetector):
+    """FailureDetector that restores the last checkpoint instead of
+    aborting. ``directory`` must be the ``CheckpointCallback`` target (or
+    any directory ``save_train_state`` wrote). If no checkpoint exists
+    yet when divergence hits, there is nothing to restore — raises."""
+
+    def __init__(
+        self,
+        directory: str,
+        max_restores: int = 3,
+        check_every: int = 1,
+        spike_factor: Optional[float] = None,
+        window: int = 50,
+    ):
+        super().__init__(check_every, spike_factor, window)
+        self.directory = directory
+        self.max_restores = max_restores
+        self.restores = 0
+
+    def handle_failure(self, trainer: Any, step: int, reason: str) -> None:
+        if self.restores >= self.max_restores:
+            raise TrainingDiverged(
+                f"step {step}: {reason} — {self.restores} restores already "
+                "spent; divergence is persistent (check lr/data), aborting"
+            )
+        trainer.logger.warning(f"step {step}: {reason} — restoring last checkpoint")
+        try:
+            restored_step = trainer.restore_from(self.directory)
+        except FileNotFoundError as e:
+            raise TrainingDiverged(
+                f"step {step}: {reason} — and no checkpoint under "
+                f"{self.directory!r} to restore from"
+            ) from e
+        self.restores += 1
+        self._history.clear()
+        # drop the post-restore-invalid tail of the loss record so later
+        # consumers (plots, early stopping) don't see the divergence.
+        # losses counts entries since THIS trainer started (a resumed
+        # trainer's list doesn't begin at step 0), so truncate by the
+        # number of rolled-back steps, not by the absolute step
+        rolled_back = step - restored_step
+        keep = max(len(trainer.state.losses) - rolled_back, 0)
+        del trainer.state.losses[keep:]
+        trainer.state.last_loss = (
+            trainer.state.losses[-1] if trainer.state.losses else None
+        )
+        trainer.logger.info(
+            f"restored step {restored_step} ({self.restores}/{self.max_restores})"
+        )

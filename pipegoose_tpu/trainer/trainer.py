@@ -96,14 +96,36 @@ class Trainer:
             self.opt_state = init_fn(params)
 
     def _try_resume(self, directory: str, opt_state_shapes) -> bool:
-        from pipegoose_tpu.parallel.hybrid import zero_state_spec
-        from pipegoose_tpu.utils.checkpoint import latest_step, restore_train_state
+        from pipegoose_tpu.utils.checkpoint import latest_step
 
         step = latest_step(directory)
         if step is None:
             self.logger.info(f"no checkpoint under {directory}; starting fresh")
             return False
-        like = {"params": self.params, "opt_state": opt_state_shapes}
+        self._restore(directory, step, opt_state_shapes)
+        self.logger.info(f"resumed from {directory} at step {step}")
+        return True
+
+    def restore_from(self, directory: str, step: Optional[int] = None) -> int:
+        """Restore params + optimizer state from a checkpoint into the
+        LIVE trainer (used by ``AutoRecovery`` to roll back a diverged
+        run mid-fit; also usable interactively). Rewinds
+        ``state.step``; returns the restored step. Raises
+        ``FileNotFoundError`` when the directory holds no checkpoint."""
+        from pipegoose_tpu.utils.checkpoint import latest_step
+
+        if step is None:
+            step = latest_step(directory)
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint under {directory!r}")
+        self._restore(directory, step, self.opt_state)
+        return step
+
+    def _restore(self, directory: str, step: int, opt_state_like) -> None:
+        from pipegoose_tpu.parallel.hybrid import zero_state_spec
+        from pipegoose_tpu.utils.checkpoint import restore_train_state
+
+        like = {"params": self.params, "opt_state": opt_state_like}
         # restore SHARDED onto this mesh — without specs every leaf (incl.
         # the ZeRO state, which exists precisely because it can't live
         # replicated) would materialize on all devices
@@ -120,8 +142,6 @@ class Trainer:
         self.params = restored["params"]
         self.opt_state = restored["opt_state"]
         self.state.step = step
-        self.logger.info(f"resumed from {directory} at step {step}")
-        return True
 
     def evaluate(
         self,

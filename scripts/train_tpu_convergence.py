@@ -55,16 +55,29 @@ def batches(data: np.ndarray, rng: np.random.RandomState, n: int, b: int, s: int
 
 
 def main() -> None:
-    out_path = sys.argv[1] if len(sys.argv) > 1 else "docs/acceptance/TRAIN_TPU_r03.json"
     steps = 300
     if "--steps" in sys.argv:
         steps = int(sys.argv[sys.argv.index("--steps") + 1])
+    model = "bloom"
+    if "--model" in sys.argv:
+        model = sys.argv[sys.argv.index("--model") + 1]
+    # per-model default paths so `--model mixtral` can never silently
+    # overwrite the bloom acceptance record
+    default_out = (
+        "docs/acceptance/TRAIN_TPU_r03.json" if model == "bloom"
+        else f"docs/acceptance/TRAIN_TPU_{model.upper()}_r03.json"
+    )
+    out_path = (
+        sys.argv[1]
+        if len(sys.argv) > 1 and not sys.argv[1].startswith("--")
+        else default_out
+    )
     if "--cpu" in sys.argv:
         # the sitecustomize pins jax_platforms to the axon plugin and
         # IGNORES the JAX_PLATFORMS env var; only this works
         jax.config.update("jax_platforms", "cpu")
 
-    from pipegoose_tpu.models import bloom
+    from pipegoose_tpu.models import bloom, mixtral
 
     dev = jax.devices()[0]
     on_tpu = dev.platform.lower() != "cpu"
@@ -76,16 +89,38 @@ def main() -> None:
     print(f"corpus {len(corpus)} bytes, train {split}, val {len(val_data)}",
           file=sys.stderr)
 
-    cfg = (
-        bloom.BloomConfig.bloom_560m(dtype=jnp.bfloat16, remat=True,
-                                     use_flash=True)
-        if on_tpu
-        else bloom.BloomConfig(vocab_size=512, hidden_size=128, n_layer=2,
-                               n_head=4)
-    )
-    # byte ids 0..255 live inside the real 250880 vocab; the model simply
-    # never sees the other ids (their embeddings stay at init)
-    params = bloom.init_params(cfg, jax.random.PRNGKey(0))
+    if model == "mixtral":
+        # ~450M-param sparse-MoE sibling: GQA + SwiGLU experts + top-2
+        # routing + the GQA flash kernels — the BASELINE config-5 family
+        # exercised end-to-end on hardware (single-chip, EP dense here)
+        cfg = (
+            mixtral.MixtralConfig(
+                vocab_size=32000, hidden_size=1024, intermediate_size=1792,
+                n_layer=8, n_head=16, n_kv_head=4, num_experts=8, top_k=2,
+                capacity_factor=1.25, dtype=jnp.bfloat16, remat=True,
+                use_flash=True,
+            )
+            if on_tpu
+            else mixtral.MixtralConfig(
+                vocab_size=512, hidden_size=64, intermediate_size=96,
+                n_layer=2, n_head=4, n_kv_head=2, num_experts=2, top_k=1,
+            )
+        )
+        mod = mixtral
+        model_name = "mixtral-moe-450m (8 experts, top-2, GQA, byte-level ids)"
+    else:
+        cfg = (
+            bloom.BloomConfig.bloom_560m(dtype=jnp.bfloat16, remat=True,
+                                         use_flash=True)
+            if on_tpu
+            else bloom.BloomConfig(vocab_size=512, hidden_size=128, n_layer=2,
+                                   n_head=4)
+        )
+        mod = bloom
+        model_name = "bloom-560m (byte-level ids over local text corpus)"
+    # byte ids 0..255 live inside the real vocab; the model simply never
+    # sees the other ids (their embeddings stay at init)
+    params = mod.init_params(cfg, jax.random.PRNGKey(0))
     opt = optax.chain(
         optax.clip_by_global_norm(1.0),
         optax.adamw(optax.linear_schedule(0.0, 2e-4, 20), weight_decay=0.01),
@@ -99,7 +134,7 @@ def main() -> None:
     def run_chunk(params, opt_state, ids_chunk):
         def body(carry, ids):
             params, opt_state = carry
-            loss, grads = jax.value_and_grad(bloom.loss_fn)(
+            loss, grads = jax.value_and_grad(mod.loss_fn)(
                 params, ids, None, ids, cfg
             )
             updates, opt_state = opt.update(grads, opt_state, params)
@@ -113,7 +148,7 @@ def main() -> None:
     @jax.jit
     def val_loss(params, val_ids):
         def one(ids):
-            return bloom.loss_fn(params, ids, None, ids, cfg)
+            return mod.loss_fn(params, ids, None, ids, cfg)
         # sequential over val batches: one (B,S,V) fp32 logits buffer at
         # a time (a vmap would materialize all of them at once — 32 GB)
         return jax.lax.map(one, val_ids).mean()
@@ -141,18 +176,18 @@ def main() -> None:
 
     record = {
         "record": "real-hardware-training-convergence",
+        "family": model,
         "device": getattr(dev, "device_kind", dev.platform),
-        "model": "bloom-560m (byte-level ids over local text corpus)"
-        if on_tpu else "bloom-tiny smoke",
+        "model": model_name if on_tpu else f"{model}-tiny smoke",
         "batch": b, "seq": s, "steps": steps,
         "corpus_bytes": int(len(corpus)),
         "val_loss_init": round(v0, 4),
         "val_loss_final": round(v1, 4),
         "train_curve": curve,
         "tokens_per_sec": round(tokens / dt, 1),
-        "note": "loss starts near ln(250880)=12.43 (uniform over full "
-                "vocab) and must fall toward byte-level text entropy; "
-                "val on a held-out 10% split of the corpus",
+        "note": "loss starts near ln(vocab_size) (uniform) and must "
+                "fall toward byte-level text entropy; val on a held-out "
+                "10% split of the corpus",
     }
     Path(out_path).write_text(json.dumps(record, indent=1))
     print(json.dumps({"val_loss_init": v0, "val_loss_final": v1,

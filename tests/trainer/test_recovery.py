@@ -1,0 +1,120 @@
+"""Failure detection + automatic recovery — a capability the reference
+lacks entirely (SURVEY.md §5: no retry, no health checks, no failure
+handling of any kind)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from pipegoose_tpu.distributed import ParallelContext
+from pipegoose_tpu.models import bloom
+from pipegoose_tpu.optim.zero import DistributedOptimizer
+from pipegoose_tpu.trainer import (
+    AutoRecovery,
+    CheckpointCallback,
+    FailureDetector,
+    Trainer,
+    TrainingDiverged,
+)
+
+POISON = 0  # batches whose FIRST token id is 0 produce a NaN loss
+
+
+@pytest.fixture()
+def parts(devices):
+    cfg = bloom.BloomConfig(vocab_size=64, hidden_size=32, n_layer=2, n_head=2)
+    params = bloom.init_params(cfg, jax.random.PRNGKey(0))
+    ctx = ParallelContext(tensor_parallel_size=2, data_parallel_size=4)
+    yield cfg, params, ctx
+    ctx.destroy()
+
+
+def _loss_fn(cfg):
+    def loss_fn(p, ids):
+        base = bloom.loss_fn(p, ids, None, ids, cfg, tp_axis="tensor")
+        # poison pill: deterministic NaN for sentinel batches — the
+        # injected stand-in for a bad-batch/optimizer blow-up
+        return jnp.where(ids[0, 0] == POISON, jnp.float32(jnp.nan), base)
+
+    return loss_fn
+
+
+def _batch(cfg, seed, poison=False):
+    ids = np.random.RandomState(seed).randint(1, cfg.vocab_size, (8, 8))
+    if poison:
+        ids[0, 0] = POISON
+    return jnp.asarray(ids)
+
+
+def _trainer(cfg, params, ctx, callbacks):
+    return Trainer(
+        _loss_fn(cfg), params, bloom.tp_specs(params),
+        DistributedOptimizer(optax.adam(1e-3), axis_name="data"), ctx,
+        callbacks=callbacks,
+    )
+
+
+def test_detector_raises_on_nan(parts):
+    cfg, params, ctx = parts
+    trainer = _trainer(cfg, params, ctx, [FailureDetector()])
+    batches = [_batch(cfg, 1), _batch(cfg, 2, poison=True), _batch(cfg, 3)]
+    with pytest.raises(TrainingDiverged, match="non-finite"):
+        trainer.fit(batches)
+    assert trainer.state.step == 2  # failed ON the poisoned step
+
+
+def test_detector_spike(parts):
+    cfg, params, ctx = parts
+    det = FailureDetector(spike_factor=10.0, window=4)
+    trainer = _trainer(cfg, params, ctx, [det])
+    # warm up the median window on clean batches, then fake a spike
+    trainer.fit([_batch(cfg, s) for s in range(1, 5)])
+    assert det._is_divergent(1e6) is not None
+    assert det._is_divergent(float(trainer.state.last_loss)) is None
+
+
+def test_auto_recovery_restores_and_continues(parts, tmp_path):
+    cfg, params, ctx = parts
+    run_dir = str(tmp_path / "run")
+    rec = AutoRecovery(run_dir, max_restores=2)
+    trainer = _trainer(
+        cfg, params, ctx, [CheckpointCallback(run_dir, every=2), rec]
+    )
+    batches = [
+        _batch(cfg, 1), _batch(cfg, 2),          # steps 1-2 (ckpt @2)
+        _batch(cfg, 3, poison=True),             # step 3 diverges -> restore @2
+        _batch(cfg, 4), _batch(cfg, 5),          # continue: steps 3-4 (ckpt @4)
+    ]
+    state = trainer.fit(batches)
+    assert rec.restores == 1
+    # the poisoned batch was consumed but its step was rolled back, so
+    # 5 batches yield 4 surviving steps
+    assert state.step == 4
+    assert np.isfinite(float(state.last_loss))
+    assert all(np.isfinite(float(l)) for l in state.losses)
+    # params stayed finite through the recovery
+    for leaf in jax.tree_util.tree_leaves(trainer.params):
+        assert np.isfinite(np.asarray(leaf)).all()
+
+
+def test_auto_recovery_exhausts(parts, tmp_path):
+    """Persistent divergence must surface after max_restores, not loop."""
+    cfg, params, ctx = parts
+    run_dir = str(tmp_path / "run")
+    rec = AutoRecovery(run_dir, max_restores=1)
+    trainer = _trainer(
+        cfg, params, ctx, [CheckpointCallback(run_dir, every=1), rec]
+    )
+    batches = [_batch(cfg, 1)] + [_batch(cfg, s, poison=True) for s in (2, 3)]
+    with pytest.raises(TrainingDiverged, match="persistent"):
+        trainer.fit(batches)
+    assert rec.restores == 1
+
+
+def test_auto_recovery_without_checkpoint_raises(parts, tmp_path):
+    cfg, params, ctx = parts
+    rec = AutoRecovery(str(tmp_path / "never_written"))
+    trainer = _trainer(cfg, params, ctx, [rec])
+    with pytest.raises(TrainingDiverged, match="no checkpoint"):
+        trainer.fit([_batch(cfg, 1, poison=True)])
