@@ -3,9 +3,9 @@ entrypoint (capability parity with the reference's
 examples/hybrid_parallelism.py, redesigned TPU-first: one mesh, one
 compiled train step, no torchrun/process groups).
 
-Run (any JAX device set; for a local smoke run on fake CPU devices):
-    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
-        python examples/hybrid_parallelism.py --tp 2 --dp 4 --steps 20
+Run (any JAX device set; for a local smoke run on fake CPU devices —
+works even where a sitecustomize pins an accelerator platform):
+    python examples/hybrid_parallelism.py --fake-devices 8 --tp 2 --dp 4 --steps 20
 
 With a HF checkpoint (needs network/cache):
     python examples/hybrid_parallelism.py --model bigscience/bloom-560m
@@ -60,7 +60,13 @@ def main():
                     help="HF checkpoint (e.g. bigscience/bloom-560m); default: tiny random")
     ap.add_argument("--bf16", action="store_true")
     ap.add_argument("--ckpt-dir", default=None)
+    ap.add_argument("--fake-devices", type=int, default=None,
+                    help="force N fake CPU devices (works even where a "
+                         "sitecustomize pins an accelerator platform)")
     args = ap.parse_args()
+    if args.fake_devices:
+        from pipegoose_tpu.testing import force_cpu_devices
+        force_cpu_devices(args.fake_devices)
 
     ctx = ParallelContext(tensor_parallel_size=args.tp, data_parallel_size=args.dp)
     dtype = jnp.bfloat16 if args.bf16 else jnp.float32

@@ -2,8 +2,7 @@
 the capability the reference advertised but never implemented
 (README.md:96; SURVEY.md §5).
 
-    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
-        python examples/long_context.py --sp 4 --dp 2 --seq 4096
+    python examples/long_context.py --fake-devices 8 --sp 4 --dp 2 --seq 4096
 """
 from __future__ import annotations
 
@@ -29,7 +28,13 @@ def main():
     ap.add_argument("--batch", type=int, default=2)
     ap.add_argument("--seq", type=int, default=2048)
     ap.add_argument("--steps", type=int, default=10)
+    ap.add_argument("--fake-devices", type=int, default=None,
+                    help="force N fake CPU devices (works even where a "
+                         "sitecustomize pins an accelerator platform)")
     args = ap.parse_args()
+    if args.fake_devices:
+        from pipegoose_tpu.testing import force_cpu_devices
+        force_cpu_devices(args.fake_devices)
 
     ctx = ParallelContext(
         sequence_parallel_size=args.sp,

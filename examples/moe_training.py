@@ -3,8 +3,7 @@ parity with the reference's MoE convergence script
 (tests/convergence/run_ep.py), TPU-first: EP x TP x DP on one mesh with
 static-shape all_to_all dispatch.
 
-    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
-        python examples/moe_training.py --ep 2 --tp 2 --dp 2 --steps 20
+    python examples/moe_training.py --fake-devices 8 --ep 2 --tp 2 --dp 2 --steps 20
 """
 from __future__ import annotations
 
@@ -32,7 +31,13 @@ def main():
     ap.add_argument("--batch", type=int, default=8)
     ap.add_argument("--seq", type=int, default=128)
     ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--fake-devices", type=int, default=None,
+                    help="force N fake CPU devices (works even where a "
+                         "sitecustomize pins an accelerator platform)")
     args = ap.parse_args()
+    if args.fake_devices:
+        from pipegoose_tpu.testing import force_cpu_devices
+        force_cpu_devices(args.fake_devices)
 
     ctx = ParallelContext(
         expert_parallel_size=args.ep,

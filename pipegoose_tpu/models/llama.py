@@ -190,9 +190,16 @@ def loss_fn(params, input_ids, attention_mask, labels, config, tp_axis=None):
 def loss_fn_pp(
     params, input_ids, attention_mask, labels, config, n_microbatches,
     tp_axis: Optional[str] = None, pipe_axis: str = "pipe",
+    stage_layer_counts=None,
 ):
-    """GPipe composition, structured like bloom.loss_fn_pp."""
+    """GPipe composition, structured like bloom.loss_fn_pp.
+    ``stage_layer_counts``: UNEVEN stages exactly as there (padded
+    ``repartition_blocks`` layout, lax.cond slot skip)."""
     from pipegoose_tpu.nn.pipeline_parallel import microbatch as mb
+    from pipegoose_tpu.nn.pipeline_parallel.partitioner import (
+        masked_stage_scan,
+        stage_n_valid,
+    )
     from pipegoose_tpu.nn.pipeline_parallel.pipeline import gpipe, last_stage_value
 
     b, s = input_ids.shape
@@ -211,12 +218,21 @@ def loss_fn_pp(
     )
     side = {"bias": jax.vmap(lambda m: rope_attention_bias(m, config))(mbs["mask"])}
 
-    def stage_fn(blocks, h, side):
-        def scan_fn(carry, blk):
-            return _block(blk, carry, cos, sin, side["bias"], config, tp_axis), None
+    if stage_layer_counts is not None:
+        n_valid = stage_n_valid(stage_layer_counts, config.n_layer, pipe_axis)
 
-        h, _ = jax.lax.scan(scan_fn, h, blocks)
-        return h
+        def stage_fn(blocks, h, side):
+            return masked_stage_scan(
+                lambda blk, hh: _block(blk, hh, cos, sin, side["bias"], config, tp_axis),
+                blocks, h, n_valid,
+            )
+    else:
+        def stage_fn(blocks, h, side):
+            def scan_fn(carry, blk):
+                return _block(blk, carry, cos, sin, side["bias"], config, tp_axis), None
+
+            h, _ = jax.lax.scan(scan_fn, h, blocks)
+            return h
 
     outs = gpipe(
         stage_fn, params["blocks"], h0, side_inputs=side,
@@ -234,6 +250,112 @@ def loss_fn_pp(
 
     tot, cnt = jax.vmap(head_one)(outs, mbs["mask"], mbs["labels"])
     return last_stage_value(tot.sum() / jnp.maximum(cnt.sum(), 1), pipe_axis)
+
+
+def loss_fn_1f1b(
+    params, input_ids, attention_mask, labels, config, n_microbatches,
+    tp_axis: Optional[str] = None, pipe_axis: str = "pipe",
+    stage_layer_counts=None,
+):
+    """Llama on the 1F1B (PipeDream-flush) runtime: same value/gradients
+    as :func:`loss_fn_pp` with O(stages) activation memory — the same
+    custom-vjp manual-gradient wrapper as ``bloom.loss_fn_1f1b``.
+    Handles both tied and untied heads (tied: the embedding gets input
+    AND head gradient contributions)."""
+    from pipegoose_tpu.nn.pipeline_parallel import microbatch as mb
+    from pipegoose_tpu.nn.pipeline_parallel.pipeline import (
+        manual_grads_loss,
+        one_f_one_b,
+    )
+
+    b, s = input_ids.shape
+    if attention_mask is None:
+        attention_mask = jnp.ones((b, s), jnp.int32)
+    mbs = mb.split(
+        {"ids": input_ids, "mask": attention_mask, "labels": labels}, n_microbatches
+    )
+    cos, sin = rope_cos_sin(
+        s, config.head_dim, config.rope_theta, config.rope_scaling
+    )
+    side = {
+        "bias": jax.vmap(lambda m: rope_attention_bias(m, config))(mbs["mask"]),
+        "labels": mbs["labels"],
+        "mask": mbs["mask"],
+    }
+    inv_count = 1.0 / jnp.maximum(attention_mask[:, 1:].sum().astype(jnp.float32), 1)
+
+    block = partial(_block, config=config, tp_axis=tp_axis)
+    if config.remat:
+        block = jax.checkpoint(block)
+
+    if stage_layer_counts is not None:
+        from pipegoose_tpu.nn.pipeline_parallel.partitioner import (
+            masked_stage_scan,
+            stage_n_valid,
+        )
+
+        n_valid = stage_n_valid(stage_layer_counts, config.n_layer, pipe_axis)
+
+        def stage_fn(blocks, h, side):
+            return masked_stage_scan(
+                lambda blk, hh: block(blk, hh, cos, sin, side["bias"]),
+                blocks, h, n_valid,
+            )
+    else:
+        def stage_fn(blocks, h, side):
+            def scan_fn(carry, blk):
+                return block(blk, carry, cos, sin, side["bias"]), None
+
+            h, _ = jax.lax.scan(scan_fn, h, blocks)
+            return h
+
+    tied = config.tie_word_embeddings
+
+    def head_fn(hp, h, side):
+        h = rms_norm(hp["ln_f"], h, config.rms_eps)
+        logits = logits_fn(hp, h, config, tp_axis)
+        per_tok = vocab_parallel_cross_entropy(
+            logits[:, :-1], side["labels"][:, 1:], tp_axis,
+            valid_size=config.valid_vocab_size,
+        )
+        w = side["mask"][:, 1:].astype(per_tok.dtype)
+        return ((per_tok * w).sum() * inv_count).astype(jnp.float32)
+
+    def run(params):
+        h0, embed_vjp = jax.vjp(
+            lambda ep: jax.vmap(
+                lambda ids: vocab_parallel_embedding(ep, ids, tp_axis).astype(
+                    config.dtype
+                )
+            )(mbs["ids"]),
+            params["embed"],
+        )
+        head_params = {"ln_f": params["ln_f"]}
+        if tied:
+            head_params["embed"] = params["embed"]
+        else:
+            head_params["lm_head"] = params["lm_head"]
+        loss_local, dh0, d_blocks, d_head = one_f_one_b(
+            stage_fn, params["blocks"], head_fn, head_params, h0, side, pipe_axis
+        )
+        (d_embed,) = embed_vjp(dh0)
+        P = jax.lax.axis_size(pipe_axis)
+        is_last = jax.lax.axis_index(pipe_axis) == P - 1
+        loss = jax.lax.psum(jnp.where(is_last, loss_local, 0.0), pipe_axis)
+        if tied:
+            d_embed = {
+                "weight": d_embed["weight"] + d_head["embed"]["weight"]
+            }
+        grads = {
+            "embed": d_embed,
+            "blocks": d_blocks,
+            "ln_f": d_head["ln_f"],
+        }
+        if not tied:
+            grads["lm_head"] = d_head["lm_head"]
+        return loss, grads
+
+    return manual_grads_loss(run, params)
 
 
 # -- TP/PP policy -----------------------------------------------------------

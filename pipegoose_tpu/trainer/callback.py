@@ -68,9 +68,12 @@ class CheckpointCallback(Callback):
             cb.on_checkpoint(trainer, step, path)
 
     def on_step_end(self, trainer: Any, step: int, loss: float) -> None:
-        # step <= _last_saved happens when AutoRecovery rewound the
-        # trainer to a checkpointed step in THIS callback round — that
-        # state is already on disk, and re-saving would collide
+        # trust the TRAINER's step, not the argument: AutoRecovery (which
+        # runs earlier in this callback round, order=-10) may have rolled
+        # state.step back — saving the restored old state under the
+        # failing step's label would poison later restores, and saving
+        # the already-on-disk step again would collide
+        step = trainer.state.step
         if step > 0 and step % self.every == 0 and step > self._last_saved:
             self._save(trainer, step)
 

@@ -62,11 +62,12 @@ def main() -> None:
     if "--model" in sys.argv:
         model = sys.argv[sys.argv.index("--model") + 1]
     # per-model default paths so `--model mixtral` can never silently
-    # overwrite the bloom acceptance record
-    default_out = (
-        "docs/acceptance/TRAIN_TPU_r03.json" if model == "bloom"
-        else f"docs/acceptance/TRAIN_TPU_{model.upper()}_r03.json"
-    )
+    # overwrite the bloom acceptance record; names match the committed
+    # records STATUS.md/PARITY.md cite
+    default_out = {
+        "bloom": "docs/acceptance/TRAIN_TPU_r03.json",
+        "mixtral": "docs/acceptance/TRAIN_TPU_MOE_r03.json",
+    }.get(model, f"docs/acceptance/TRAIN_TPU_{model.upper()}_r03.json")
     out_path = (
         sys.argv[1]
         if len(sys.argv) > 1 and not sys.argv[1].startswith("--")

@@ -256,3 +256,78 @@ def test_rope_scaling_generate_matches_hf():
         llama.generate(params, jnp.asarray(ids), cfg, max_new_tokens=5, eos_token_id=2)
     )
     np.testing.assert_array_equal(ours, hf_out)
+
+
+def test_1f1b_matches_dense_tied_and_untied(devices):
+    """llama.loss_fn_1f1b == dense loss_fn (value AND grads via the
+    custom-vjp wrapper) for both head modes; tied heads must see the
+    embedding gradient from BOTH the input lookup and the head matmul."""
+    from pipegoose_tpu.parallel.hybrid import sync_replicated_grads
+
+    for tied in (False, True):
+        cfg = llama.LlamaConfig(
+            vocab_size=128, hidden_size=64, intermediate_size=112,
+            n_layer=4, n_head=4, n_kv_head=2, tie_word_embeddings=tied,
+        )
+        params = llama.init_params(cfg, jax.random.PRNGKey(0))
+        ids = jnp.asarray(np.random.RandomState(7).randint(0, 128, (4, 12)))
+        ref = float(llama.loss_fn(params, ids, None, ids, cfg))
+        ref_grads = jax.grad(llama.loss_fn)(params, ids, None, ids, cfg)
+
+        ctx = ParallelContext(pipeline_parallel_size=2, data_parallel_size=4)
+        try:
+            sp = llama.pp_specs(params)
+
+            def vg(p, i):
+                loss, g = jax.value_and_grad(
+                    lambda p: llama.loss_fn_1f1b(p, i, None, i, cfg, n_microbatches=2)
+                )(p)
+                return loss, sync_replicated_grads(g, sp, (("pipe", "sum"),))
+
+            loss, grads = jax.jit(
+                shard_map(vg, mesh=ctx.mesh, in_specs=(sp, P()),
+                          out_specs=(P(), sp), check_vma=False)
+            )(params, ids)
+            assert abs(float(loss) - ref) < 2e-4, (tied, float(loss), ref)
+            for (path, a), b in zip(
+                jax.tree_util.tree_leaves_with_path(ref_grads),
+                jax.tree_util.tree_leaves(grads),
+            ):
+                np.testing.assert_allclose(
+                    np.asarray(b), np.asarray(a), rtol=2e-3, atol=2e-5,
+                    err_msg=f"tied={tied} {path}",
+                )
+        finally:
+            ctx.destroy()
+
+
+def test_uneven_stages_gpipe_matches_dense(devices):
+    """llama.loss_fn_pp with a 3/1 cost-DP split == dense loss."""
+    from pipegoose_tpu.nn.pipeline_parallel.partitioner import repartition_blocks
+
+    cfg = llama.LlamaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=112,
+        n_layer=4, n_head=4, n_kv_head=2,
+    )
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    ids = jnp.asarray(np.random.RandomState(9).randint(0, 128, (4, 12)))
+    ref = float(llama.loss_fn(params, ids, None, ids, cfg))
+
+    padded, counts = repartition_blocks(params["blocks"], [range(0, 3), range(3, 4)])
+    pu = {**params, "blocks": padded}
+    ctx = ParallelContext(pipeline_parallel_size=2, data_parallel_size=4)
+    try:
+        sp = llama.pp_specs(pu)
+        out = float(jax.jit(
+            shard_map(
+                lambda p, i: llama.loss_fn_pp(
+                    p, i, None, i, cfg, n_microbatches=2,
+                    stage_layer_counts=tuple(counts),
+                ),
+                mesh=ctx.mesh, in_specs=(sp, P()), out_specs=P(),
+                check_vma=False,
+            )
+        )(pu, ids))
+        assert abs(out - ref) < 2e-4, (out, ref)
+    finally:
+        ctx.destroy()

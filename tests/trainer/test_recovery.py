@@ -98,6 +98,38 @@ def test_auto_recovery_restores_and_continues(parts, tmp_path):
         assert np.isfinite(np.asarray(leaf)).all()
 
 
+def test_rollback_on_save_boundary_does_not_mislabel(parts, tmp_path):
+    """every=1 + divergence on a save boundary: the checkpoint callback
+    (running AFTER AutoRecovery in the same round) must not save the
+    rolled-back OLD state under the failing step's label — each step_N
+    checkpoint must hold genuinely distinct, advanced state."""
+    from pipegoose_tpu.utils.checkpoint import latest_step
+
+    cfg, params, ctx = parts
+    run_dir = str(tmp_path / "run")
+    rec = AutoRecovery(run_dir, max_restores=1)
+    trainer = _trainer(
+        cfg, params, ctx, [CheckpointCallback(run_dir, every=1), rec]
+    )
+    batches = [
+        _batch(cfg, 1),                 # step 1, ckpt@1
+        _batch(cfg, 2, poison=True),    # diverges -> restore @1, NO save
+        _batch(cfg, 3),                 # replayed step 2, ckpt@2
+        _batch(cfg, 4),                 # step 3, ckpt@3
+    ]
+    state = trainer.fit(batches)
+    assert state.step == 3 and rec.restores == 1
+    assert latest_step(run_dir) == 3
+
+    def leaf_at(step):
+        trainer.restore_from(run_dir, step)
+        return np.asarray(trainer.params["blocks"]["attn"]["qkv"]["kernel"]).copy()
+
+    p1, p2 = leaf_at(1), leaf_at(2)
+    # the buggy path saved step-1 state under the step-2 label
+    assert np.any(p1 != p2), "step_2 checkpoint holds step_1's params"
+
+
 def test_auto_recovery_exhausts(parts, tmp_path):
     """Persistent divergence must surface after max_restores, not loop."""
     cfg, params, ctx = parts
