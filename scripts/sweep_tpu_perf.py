@@ -109,13 +109,15 @@ def model_sweep():
     batch, seq, steps = 8, 1024, 8
     variants = {
         "remat+flash": dict(remat=True, use_flash=True),
+        "remat+xla": dict(remat=True, use_flash=False),
         "attn+flash": dict(remat=True, remat_policy="attn", use_flash=True),
-        "attn+flash+ce8": dict(
-            remat=True, remat_policy="attn", use_flash=True, ce_chunks=8
+        "dots+flash+ce8": dict(
+            remat=True, remat_policy="dots", use_flash=True, ce_chunks=8
         ),
-        "attn+flash+ce8_b16": dict(
-            remat=True, remat_policy="attn", use_flash=True, ce_chunks=8,
-            _batch=16,
+        # b8 no-remat reproducibly kills the remote compile helper
+        # (HTTP 500); b4 is the largest batch that compiles no-remat
+        "noremat+flash+ce8_b4": dict(
+            remat=False, use_flash=True, ce_chunks=8, _batch=4
         ),
     }
     results = {}
