@@ -750,43 +750,16 @@ def _attention_sp(
         slopes = jax.lax.dynamic_slice_in_dim(slopes, h0, local_heads, 0)
 
     if variant == "ulysses":
-        from pipegoose_tpu.distributed.functional import all_gather
-        from pipegoose_tpu.nn.sequence_parallel.ulysses import ulysses_attention
-        from pipegoose_tpu.ops.flash_attention import mask_to_kv_bias
-
-        sp = jax.lax.axis_size(sp_axis)
-        if local_heads % sp:
-            raise ValueError(
-                f"ulysses needs local heads {local_heads} divisible by "
-                f"sequence axis size {sp}"
-            )
-        nh_sub = local_heads // sp
-        sp_rank = jax.lax.axis_index(sp_axis)
-        # the all_to_all hands this device the sp_rank-th head subset
-        sub_slopes = jax.lax.dynamic_slice_in_dim(
-            slopes, sp_rank * nh_sub, nh_sub, 0
+        from pipegoose_tpu.nn.sequence_parallel.ulysses import (
+            ulysses_causal_attention,
         )
-        full_mask = all_gather(pad_mask_local, sp_axis, dim=1)  # (B, S)
 
-        def attn_fn(qh, kh, vh):  # (B, S_full, nh_sub, hd)
-            s_full = qh.shape[1]
-            if config.use_flash:
-                from pipegoose_tpu.ops.flash_attention import flash_attention
-
-                kv_pos = jnp.broadcast_to(
-                    jnp.arange(s_full, dtype=jnp.float32)[None], (b, s_full)
-                )  # plain global positions — same ALiBi semantics as ring
-                return flash_attention(
-                    qh, kh, vh, alibi_slopes=sub_slopes,
-                    kv_pos=kv_pos, kv_neg=mask_to_kv_bias(full_mask)[1],
-                    causal=True,
-                )
-            bias_fn = make_causal_alibi_bias_fn(
-                s_full, None, alibi_slopes=sub_slopes
-            )
-            return ring_attention(qh, kh, vh, None, bias_fn, kv_side=full_mask)
-
-        ctx = ulysses_attention(q, k, v, sp_axis, attn_fn)
+        # per-head slopes follow the heads through the all_to_all —
+        # device r serves the sp_rank-th subset (sliced inside)
+        ctx = ulysses_causal_attention(
+            q, k, v, sp_axis, pad_mask_local,
+            alibi_slopes=slopes, use_flash=config.use_flash,
+        )
     elif config.use_flash:
         # fused chunk kernel per ring step — no (S_local, S_local) score
         # materialization in the forward

@@ -393,11 +393,13 @@ def loss_fn_sp(
     config: LlamaConfig,
     tp_axis: Optional[str] = None,
     sp_axis: str = "seq",
+    variant: str = "ring",
 ) -> jax.Array:
-    """Sequence-parallel Llama loss: ring attention over ``sp_axis``
-    with RoPE at global positions (rope_scaling honored). Shares
-    mixtral._attention_sp — the RoPE/GQA ring path is family-agnostic;
-    only the dense SwiGLU block body differs from Mixtral's MoE.
+    """Sequence-parallel Llama loss: ring (or ``variant="ulysses"``)
+    attention over ``sp_axis`` with RoPE at global positions
+    (rope_scaling honored). Shares mixtral._attention_sp — the RoPE/GQA
+    SP paths are family-agnostic; only the dense SwiGLU block body
+    differs from Mixtral's MoE.
 
     Grad sync for replicated params: ``grad_sync_axes=(("seq","sum"),)``.
     """
@@ -416,7 +418,7 @@ def loss_fn_sp(
     def block(blk, h):
         ln1 = rms_norm(blk["ln_1"], h, config.rms_eps)
         h = h + _attention_sp(
-            blk["attn"], ln1, config, tp_axis, sp_axis, attention_mask
+            blk["attn"], ln1, config, tp_axis, sp_axis, attention_mask, variant
         )
         ln2 = rms_norm(blk["ln_2"], h, config.rms_eps)
         return h + _mlp(blk["mlp"], ln2, tp_axis)

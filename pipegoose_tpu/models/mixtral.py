@@ -821,16 +821,25 @@ def pp_specs(
 
 # -- sequence-parallel composition ------------------------------------------
 
-def _attention_sp(blk, x, config, tp_axis, sp_axis, pad_mask_local):
-    """RoPE/GQA attention with the sequence sharded over ``sp_axis``
-    (ring attention), heads over ``tp_axis``. RoPE is applied at GLOBAL
-    positions — each rank slices the full cos/sin tables at its chunk
-    offset (rope_scaling honored via the shared rope_cos_sin).
+def _attention_sp(blk, x, config, tp_axis, sp_axis, pad_mask_local,
+                  variant: str = "ring"):
+    """RoPE/GQA attention with the sequence sharded over ``sp_axis``,
+    heads over ``tp_axis``. RoPE is applied at GLOBAL positions — each
+    rank slices the full cos/sin tables at its chunk offset
+    (rope_scaling honored via the shared rope_cos_sin) — BEFORE any
+    head exchange, since RoPE travels with tokens, not heads.
 
-    GQA is NATIVE on both ring paths: the nkv-headed K/V rotate the
-    ring — the flash chunk kernels read them via grouped index maps,
-    the dense-math ring (sliding-window configs, or use_flash=False)
-    via a grouped einsum. Hop bytes shrink by g either way.
+    ``variant="ring"``: K/V rotate over the sp ring. GQA is NATIVE on
+    both ring paths: the nkv-headed K/V ride the ring — the flash chunk
+    kernels read them via grouped index maps, the dense-math ring
+    (sliding-window configs, or use_flash=False) via a grouped einsum.
+    Hop bytes shrink by g either way.
+
+    ``variant="ulysses"``: two all_to_alls re-shard seq -> heads so each
+    device runs FULL-sequence attention on nh_l/sp query heads and
+    nkv_l/sp kv heads (the grouped-head mapping stays consistent because
+    nh_l = g * nkv_l splits uniformly); needs both head counts divisible
+    by the sp size — use ring otherwise (it has no such constraint).
 
     Shared by Mixtral and Llama (llama.loss_fn_sp imports this)."""
     from pipegoose_tpu.nn.sequence_parallel.ring_attention import (
@@ -839,6 +848,8 @@ def _attention_sp(blk, x, config, tp_axis, sp_axis, pad_mask_local):
         ring_flash_attention,
     )
 
+    if variant not in ("ring", "ulysses"):
+        raise ValueError(f"unknown SP variant {variant!r} (ring, ulysses)")
     b, s_local, _ = x.shape
     hd = config.head_dim
     tp = jax.lax.axis_size(tp_axis) if tp_axis else 1
@@ -859,7 +870,16 @@ def _attention_sp(blk, x, config, tp_axis, sp_axis, pad_mask_local):
     q, k = apply_rope(q, k, cos, sin)
 
     window = getattr(config, "sliding_window", None)
-    if config.use_flash and window is None:
+    if variant == "ulysses":
+        from pipegoose_tpu.nn.sequence_parallel.ulysses import (
+            ulysses_causal_attention,
+        )
+
+        ctx = ulysses_causal_attention(
+            q, k, v, sp_axis, pad_mask_local,
+            window=window, use_flash=config.use_flash,
+        )
+    elif config.use_flash and window is None:
         # native GQA: nkv-headed K/V ride the ring
         ctx = ring_flash_attention(
             q, k, v, sp_axis, alibi_slopes=None, kv_side=pad_mask_local
@@ -874,9 +894,11 @@ def _attention_sp(blk, x, config, tp_axis, sp_axis, pad_mask_local):
 
 
 def _sp_block(blk, x, key, config, tp_axis, ep_axis, sp_axis,
-              pad_mask_local, train):
+              pad_mask_local, train, variant="ring"):
     h = rms_norm(blk["ln_1"], x, config.rms_eps)
-    x = x + _attention_sp(blk["attn"], h, config, tp_axis, sp_axis, pad_mask_local)
+    x = x + _attention_sp(
+        blk["attn"], h, config, tp_axis, sp_axis, pad_mask_local, variant
+    )
     h = rms_norm(blk["ln_2"], x, config.rms_eps)
 
     router = config.router()
@@ -900,10 +922,13 @@ def loss_fn_sp(
     sp_axis: str = "seq",
     rng=None,
     train: bool = True,
+    variant: str = "ring",
 ) -> jax.Array:
-    """Sequence-parallel Mixtral loss: ring attention over ``sp_axis``
-    with RoPE at global positions; MoE routing/dispatch stays on each
-    rank's local tokens (composes with ``ep_axis`` all_to_all as usual).
+    """Sequence-parallel Mixtral loss: ring (or, with
+    ``variant="ulysses"``, all_to_all head-exchange) attention over
+    ``sp_axis`` with RoPE at global positions; MoE routing/dispatch
+    stays on each rank's local tokens (composes with ``ep_axis``
+    all_to_all as usual).
     This is the long-context path for the RoPE/GQA families — the ring
     machinery previously served only BLOOM (VERDICT r2 weak #4).
 
@@ -936,7 +961,7 @@ def loss_fn_sp(
         blk, key = blk_key
         out, aux, z = _sp_block(
             blk, carry, key, config, tp_axis, ep_axis, sp_axis,
-            attention_mask, train,
+            attention_mask, train, variant,
         )
         return out, (aux, z)
 

@@ -41,3 +41,72 @@ def ulysses_attention(
 
     out = attn_fn(seq_to_heads(q), seq_to_heads(k), seq_to_heads(v))
     return heads_to_seq(out)
+
+
+def ulysses_causal_attention(
+    q: jax.Array,  # (B, S_local, nh, hd) — position encoding ALREADY applied
+    k: jax.Array,  # (B, S_local, nh | nkv, hd)
+    v: jax.Array,
+    axis_name: str,
+    pad_mask_local: Optional[jax.Array] = None,  # (B, S_local)
+    alibi_slopes: Optional[jax.Array] = None,  # (nh,) LOCAL head slopes
+    window: Optional[int] = None,
+    use_flash: bool = False,
+) -> jax.Array:
+    """Causal Ulysses attention shared by the model families (bloom:
+    ALiBi slopes; mixtral/llama: RoPE pre-applied, optional sliding
+    window). Handles GQA (nkv < nh): both head counts split across the
+    sp axis — the grouped-head mapping stays consistent because
+    ``nh = g * nkv`` splits uniformly. Per-head state (the ALiBi slopes)
+    follows the heads through the exchange: device r serves the r-th
+    head subset."""
+    from pipegoose_tpu.distributed.functional import all_gather
+    from pipegoose_tpu.nn.sequence_parallel.ring_attention import (
+        make_causal_alibi_bias_fn,
+        ring_attention,
+    )
+
+    sp = jax.lax.axis_size(axis_name)
+    nh, nkv = q.shape[2], k.shape[2]
+    if nh % sp or nkv % sp:
+        raise ValueError(
+            f"ulysses needs local q heads {nh} AND kv heads {nkv} divisible "
+            f"by the sequence axis size {sp}; use the ring variant (no "
+            "head-count constraint)"
+        )
+    full_mask = (
+        all_gather(pad_mask_local, axis_name, dim=1)
+        if pad_mask_local is not None else None
+    )
+    sub_slopes = None
+    if alibi_slopes is not None:
+        nh_sub = nh // sp
+        sub_slopes = jax.lax.dynamic_slice_in_dim(
+            alibi_slopes, jax.lax.axis_index(axis_name) * nh_sub, nh_sub, 0
+        )
+
+    def attn_fn(qh, kh, vh):  # full-seq, nh/sp q heads, nkv/sp kv heads
+        b, s_full = qh.shape[:2]
+        if use_flash:
+            from pipegoose_tpu.ops.flash_attention import (
+                flash_attention,
+                mask_to_kv_bias,
+            )
+
+            kv_pos = jnp.broadcast_to(
+                jnp.arange(s_full, dtype=jnp.float32)[None], (b, s_full)
+            )  # plain global positions — same ALiBi semantics as ring
+            kv_neg = (
+                mask_to_kv_bias(full_mask)[1] if full_mask is not None else None
+            )
+            return flash_attention(
+                qh, kh, vh, alibi_slopes=sub_slopes,
+                kv_pos=kv_pos, kv_neg=kv_neg, causal=True, window=window,
+            )
+        bias_fn = make_causal_alibi_bias_fn(
+            s_full, None, alibi_slopes=sub_slopes, window=window
+        )
+        # single-step ring == plain attention, with native GQA
+        return ring_attention(qh, kh, vh, None, bias_fn, kv_side=full_mask)
+
+    return ulysses_attention(q, k, v, axis_name, attn_fn)

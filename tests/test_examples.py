@@ -1,0 +1,35 @@
+"""The runnable examples stay runnable — each is executed as a real
+subprocess on a fake-device CPU mesh (the reference's examples are its
+de-facto user API too, README.md:82-85; ours must not bitrot)."""
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+# the examples import pipegoose_tpu from the repo; keep any existing
+# PYTHONPATH (e.g. the machine's sitecustomize dir) behind it
+ENV = {
+    **os.environ,
+    "PYTHONPATH": os.pathsep.join(
+        [str(REPO)] + [p for p in [os.environ.get("PYTHONPATH", "")] if p]
+    ),
+}
+
+CASES = [
+    ("hybrid_parallelism.py", ["--fake-devices", "4", "--tp", "2", "--dp", "2"]),
+    ("moe_training.py", ["--fake-devices", "8"]),
+    ("long_context.py", ["--fake-devices", "8"]),
+]
+
+
+@pytest.mark.parametrize("script,args", CASES, ids=[c[0] for c in CASES])
+def test_example_runs(script, args):
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "examples" / script), *args, "--steps", "2"],
+        capture_output=True, text=True, timeout=900, cwd=str(REPO), env=ENV,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "done:" in proc.stdout, proc.stdout[-500:]
