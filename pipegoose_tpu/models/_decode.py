@@ -112,3 +112,91 @@ def autoregressive_generate(
     rest = decode_all(params, first, cache, keys)
     out = jnp.concatenate([first[:, None], rest.T], axis=1)
     return jnp.concatenate([input_ids, out], axis=1)
+
+
+def global_greedy_pick(logits_local: jax.Array, tp_axis: str,
+                       valid_size: Optional[int] = None) -> jax.Array:
+    """Greedy argmax over a VOCAB-SHARDED logits row (B, V/tp): each
+    shard takes its local argmax, an all_gather compares shard maxima,
+    and the winner's local index is offset to the global id. Padded
+    vocab slots (>= valid_size) are masked by their GLOBAL column."""
+    b, vloc = logits_local.shape
+    logits_local = logits_local.astype(jnp.float32)
+    rank = lax.axis_index(tp_axis)
+    if valid_size is not None:
+        gcol = rank * vloc + jnp.arange(vloc)
+        logits_local = jnp.where(gcol[None, :] < valid_size, logits_local, -1e30)
+    local_idx = jnp.argmax(logits_local, axis=-1)  # (B,)
+    local_max = jnp.max(logits_local, axis=-1)
+    all_max = lax.all_gather(local_max, tp_axis)  # (tp, B)
+    all_idx = lax.all_gather(local_idx, tp_axis)
+    best = jnp.argmax(all_max, axis=0)  # (B,) winning shard per row
+    widx = jnp.take_along_axis(all_idx, best[None, :], axis=0)[0]
+    return best * vloc + widx
+
+
+def autoregressive_generate_sharded(
+    forward_cached: Callable,
+    init_cache: Callable,
+    params,
+    input_ids: jax.Array,
+    config,
+    max_new_tokens: int,
+    mesh,
+    param_specs,
+    tp_axis: str = "tensor",
+    eos_token_id: Optional[int] = None,
+) -> jax.Array:
+    """TENSOR-PARALLEL greedy decoding: the whole generation (prefill +
+    scanned decode) runs as one shard_map program over ``mesh`` with
+    vocab/head-sharded weights and a per-shard KV cache of nh/tp heads
+    — distributed inference, which the reference cannot do at all (its
+    re-classed modules break HF ``generate``).
+
+    ``forward_cached(params, ids, cache, start, config, tp_axis)`` must
+    return LOCAL vocab-shard logits (the model's TP decode path);
+    ``init_cache(config, b, max_len, tp)`` the local-head cache. Greedy
+    only: sampling under a sharded vocab needs a global categorical —
+    use the single-device path for temperature > 0.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    try:
+        from jax import shard_map
+    except ImportError:  # jax < 0.6
+        from jax.experimental.shard_map import shard_map
+
+    if max_new_tokens <= 0:
+        return input_ids
+    b, s = input_ids.shape
+    tp = mesh.shape[tp_axis]
+    eos = -1 if eos_token_id is None else int(eos_token_id)
+    valid = getattr(config, "valid_vocab_size", None)
+
+    def body(params, ids):
+        cache = init_cache(config, b, s + max_new_tokens, tp)
+        logits, cache = forward_cached(params, ids, cache, 0, config, tp_axis)
+        first = global_greedy_pick(logits, tp_axis, valid)
+
+        def step(carry, _):
+            tok, done, cache, pos = carry
+            logits, cache = forward_cached(
+                params, tok[:, None], cache, pos, config, tp_axis
+            )
+            nxt = global_greedy_pick(logits, tp_axis, valid)
+            nxt = jnp.where(done, eos, nxt)
+            done = done | (nxt == eos)
+            return (nxt, done, cache, pos + 1), nxt
+
+        init = (first, first == eos, cache, jnp.asarray(s))
+        _, rest = lax.scan(step, init, None, length=max_new_tokens - 1)
+        return jnp.concatenate([first[:, None], rest.T], axis=1)
+
+    fn = jax.jit(
+        shard_map(
+            body, mesh=mesh, in_specs=(param_specs, P()), out_specs=P(),
+            check_vma=False,
+        )
+    )
+    out = fn(params, input_ids)
+    return jnp.concatenate([input_ids, out], axis=1)

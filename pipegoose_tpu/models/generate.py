@@ -35,24 +35,29 @@ from pipegoose_tpu.nn.tensor_parallel.layers import (
 )
 
 
-def init_cache(config: BloomConfig, batch: int, max_len: int) -> dict:
+def init_cache(config: BloomConfig, batch: int, max_len: int, tp: int = 1) -> dict:
+    """KV cache; under TP the cache holds this shard's nh/tp heads."""
     L, nh, hd = config.n_layer, config.n_head, config.head_dim
-    shape = (L, batch, max_len, nh, hd)
+    shape = (L, batch, max_len, nh // tp, hd)
     return {
         "k": jnp.zeros(shape, config.dtype),
         "v": jnp.zeros(shape, config.dtype),
     }
 
 
-def _attn_cached(blk, x, k_cache, v_cache, start, config):
+def _attn_cached(blk, x, k_cache, v_cache, start, config, tp_axis=None):
     """Attend S new tokens against cache[:start] + themselves; returns
     (out, new_k_cache, new_v_cache). ``start`` is the number of tokens
-    already cached (traced scalar)."""
+    already cached (traced scalar). Under TP the qkv projection is
+    column-parallel, the cache and slopes carry the LOCAL head subset,
+    and the out projection's row-parallel psum recombines heads."""
     b, s, _ = x.shape
-    nh, hd = config.n_head, config.head_dim
+    hd = config.head_dim
+    tp = jax.lax.axis_size(tp_axis) if tp_axis else 1
+    nh = config.n_head // tp
     max_len = k_cache.shape[1]
 
-    fused = column_parallel_linear(blk["qkv"], x, None)
+    fused = column_parallel_linear(blk["qkv"], x, tp_axis)
     fused = fused.reshape(b, s, nh, 3, hd)
     q, k, v = fused[..., 0, :], fused[..., 1, :], fused[..., 2, :]
 
@@ -61,7 +66,11 @@ def _attn_cached(blk, x, k_cache, v_cache, start, config):
 
     key_pos = jnp.arange(max_len)
     q_pos = start + jnp.arange(s)
-    slopes = jnp.asarray(alibi_slopes(nh))
+    slopes = jnp.asarray(alibi_slopes(config.n_head))
+    if tp_axis:
+        slopes = lax.dynamic_slice_in_dim(
+            slopes, jax.lax.axis_index(tp_axis) * nh, nh, 0
+        )
     bias = slopes[None, :, None, None] * key_pos[None, None, None, :].astype(jnp.float32)
     keep = key_pos[None, :] <= q_pos[:, None]  # (S, max_len): causal + not-yet-written
     bias = bias + jnp.where(keep[None, None], 0.0, NEG_INF)
@@ -72,13 +81,14 @@ def _attn_cached(blk, x, k_cache, v_cache, start, config):
     probs = jax.nn.softmax(scores + bias, axis=-1).astype(x.dtype)
     ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, v_cache, preferred_element_type=jnp.float32)
     ctx = ctx.astype(x.dtype).reshape(b, s, nh * hd)
-    return row_parallel_linear(blk["out"], ctx, None), k_cache, v_cache
+    return row_parallel_linear(blk["out"], ctx, tp_axis), k_cache, v_cache
 
 
-def forward_cached(params, ids, cache, start, config):
+def forward_cached(params, ids, cache, start, config, tp_axis=None):
     """Forward S tokens with cache read/write. Returns (logits last
-    position, new cache)."""
-    x = vocab_parallel_embedding(params["embed"], ids, None).astype(config.dtype)
+    position, new cache). Under TP the returned logits are the LOCAL
+    vocab shard (pair with ``_decode.global_greedy_pick``)."""
+    x = vocab_parallel_embedding(params["embed"], ids, tp_axis).astype(config.dtype)
     x = layer_norm(params["embed_ln"], x, config.layer_norm_epsilon)
 
     def scan_fn(carry, blk_and_cache):
@@ -87,22 +97,22 @@ def forward_cached(params, ids, cache, start, config):
         ln1 = layer_norm(blk["ln_1"], h, config.layer_norm_epsilon)
         attn, kc, vc = _attn_cached(
             {"qkv": blk["attn"]["qkv"], "out": blk["attn"]["out"]},
-            ln1, kc, vc, start, config,
+            ln1, kc, vc, start, config, tp_axis,
         )
         h = h + attn
         ln2 = layer_norm(blk["ln_2"], h, config.layer_norm_epsilon)
-        up = column_parallel_linear(blk["mlp"]["up"], ln2, None)
-        h = h + row_parallel_linear(blk["mlp"]["down"], bloom_gelu(up), None)
+        up = column_parallel_linear(blk["mlp"]["up"], ln2, tp_axis)
+        h = h + row_parallel_linear(blk["mlp"]["down"], bloom_gelu(up), tp_axis)
         return h, (kc, vc)
 
     x, (k_new, v_new) = lax.scan(scan_fn, x, (params["blocks"], cache["k"], cache["v"]))
     x = layer_norm(params["ln_f"], x, config.layer_norm_epsilon)
-    logits = logits_fn(params, x[:, -1:], None)[:, 0]  # (B, V)
+    logits = logits_fn(params, x[:, -1:], tp_axis)[:, 0]  # (B, V/tp)
     return logits, {"k": k_new, "v": v_new}
 
 
-def _bloom_init_cache(config, batch, max_len):
-    return init_cache(config, batch, max_len)
+def _bloom_init_cache(config, batch, max_len, tp=1):
+    return init_cache(config, batch, max_len, tp)
 
 
 
@@ -125,4 +135,26 @@ def generate(
         forward_cached, _bloom_init_cache, params, input_ids, config,
         max_new_tokens, temperature, rng, eos_token_id,
         logits_mask=vocab_mask_for(config),
+    )
+
+
+def generate_tp(
+    params: dict,
+    input_ids: jax.Array,
+    config: BloomConfig,
+    max_new_tokens: int,
+    mesh,
+    param_specs,
+    tp_axis: str = "tensor",
+    eos_token_id: Optional[int] = None,
+) -> jax.Array:
+    """Tensor-parallel greedy decoding: vocab/head-sharded weights, a
+    per-shard KV cache, and a global argmax over the sharded vocab —
+    the whole generation compiled as one shard_map program
+    (models/_decode.py:autoregressive_generate_sharded)."""
+    from pipegoose_tpu.models._decode import autoregressive_generate_sharded
+
+    return autoregressive_generate_sharded(
+        forward_cached, _bloom_init_cache, params, input_ids, config,
+        max_new_tokens, mesh, param_specs, tp_axis, eos_token_id,
     )

@@ -379,6 +379,14 @@ def test_ulysses_sp_matches_dense(setup, devices):
         cfg_f = dataclasses.replace(cfg, use_flash=True)
         out_f = _sp_loss(cfg_f, params, ids, ctx, variant="ulysses")
         assert abs(out_f - ref) < 3e-4, (out_f, ref)
+        # sliding window through the helper, dense AND flash inner attn
+        for fl in (False, True):
+            cfg_w = dataclasses.replace(cfg, sliding_window=8, use_flash=fl)
+            ref_w = float(
+                mixtral.loss_fn(params, ids, None, ids, cfg_w, train=False)
+            )
+            out_w = _sp_loss(cfg_w, params, ids, ctx, variant="ulysses")
+            assert abs(out_w - ref_w) < 3e-4, (fl, out_w, ref_w)
     finally:
         ctx.destroy()
 
