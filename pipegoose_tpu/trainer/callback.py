@@ -59,8 +59,43 @@ class CheckpointCallback(Callback):
         self._last_saved = -1
 
     def _save(self, trainer: Any, step: int) -> None:
+        import math
+
+        import jax
+        import jax.numpy as jnp
+
         from pipegoose_tpu.utils.checkpoint import save_train_state
 
+        # persisting non-finite params would poison every later restore
+        # (AutoRecovery would loop restoring the poisoned checkpoint
+        # until max_restores). Two guards:
+        # 1. the last recorded loss — catches divergence that happened on
+        #    an earlier step (e.g. slipped past a FailureDetector with
+        #    check_every > 1) at zero extra device work;
+        if trainer.state.last_loss is not None and not math.isfinite(
+            float(trainer.state.last_loss)
+        ):
+            trainer.logger.warning(
+                f"step {step}: refusing to checkpoint non-finite state "
+                f"(loss {float(trainer.state.last_loss)})"
+            )
+            return
+        # 2. the params themselves — the loss canary is computed from
+        #    PRE-update params, so a step whose optimizer update itself
+        #    overflowed (finite loss, NaN update) would slip past it.
+        #    One fused reduction per checkpoint; negligible next to the
+        #    write itself.
+        import functools
+
+        finite = functools.reduce(
+            jnp.logical_and,
+            [jnp.isfinite(l).all() for l in jax.tree_util.tree_leaves(trainer.params)],
+        )
+        if not bool(finite):
+            trainer.logger.warning(
+                f"step {step}: refusing to checkpoint non-finite params"
+            )
+            return
         path = save_train_state(self.directory, step, trainer.params, trainer.opt_state)
         self._last_saved = step
         trainer.logger.info(f"checkpointed step {step} -> {path}")

@@ -16,6 +16,7 @@ class TrainerStatus(str, enum.Enum):
     RUNNING = "running"
     FINISHED = "finished"
     INTERRUPTED = "interrupted"
+    FAILED = "failed"
 
 
 @dataclasses.dataclass

@@ -253,6 +253,12 @@ class Trainer:
             self.state.status = TrainerStatus.INTERRUPTED
             self.logger.warning("interrupted")
             raise
+        except Exception:
+            # a divergence abort (TrainingDiverged from a callback) or any
+            # other mid-fit error must not leave state.status at RUNNING —
+            # callers inspect trainer.state after fit() raises
+            self.state.status = TrainerStatus.FAILED
+            raise
         self.state.status = TrainerStatus.FINISHED
         for cb in self.callbacks:
             cb.on_fit_end(self)

@@ -150,3 +150,35 @@ def test_auto_recovery_without_checkpoint_raises(parts, tmp_path):
     trainer = _trainer(cfg, params, ctx, [rec])
     with pytest.raises(TrainingDiverged, match="no checkpoint"):
         trainer.fit([_batch(cfg, 1, poison=True)])
+
+
+def test_failed_status_on_divergence(parts):
+    """TrainingDiverged escaping fit() must leave status=FAILED, not a
+    stale RUNNING (ADVICE r3: trainer.py:252)."""
+    from pipegoose_tpu.trainer.state import TrainerStatus
+
+    cfg, params, ctx = parts
+    trainer = _trainer(cfg, params, ctx, [FailureDetector()])
+    with pytest.raises(TrainingDiverged):
+        trainer.fit([_batch(cfg, 1, poison=True)])
+    assert trainer.state.status is TrainerStatus.FAILED
+
+
+def test_checkpoint_refuses_nonfinite_state(parts, tmp_path):
+    """A detector with check_every > 1 lets divergence slip past a check
+    boundary; the checkpoint callback must NOT persist state whose last
+    recorded loss is non-finite (ADVICE r3: recovery.py:117 — a NaN
+    checkpoint poisons every later restore). Covers both the periodic
+    save and the on_fit_end save_final path."""
+    from pipegoose_tpu.utils.checkpoint import latest_step
+
+    cfg, params, ctx = parts
+    run_dir = str(tmp_path / "run")
+    # check_every=2 → the step-1 divergence is never checked; fit ends
+    # normally with last_loss = NaN still recorded
+    det = FailureDetector(check_every=2)
+    trainer = _trainer(
+        cfg, params, ctx, [CheckpointCallback(run_dir, every=1), det]
+    )
+    trainer.fit([_batch(cfg, 1, poison=True)])
+    assert latest_step(run_dir) is None, "non-finite state was checkpointed"
