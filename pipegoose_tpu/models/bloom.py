@@ -248,6 +248,9 @@ def _attention(
             q, k, v, slopes,
             kv_pos=bias["kv_pos"], kv_neg=bias["kv_neg"], causal=True,
         )
+        # zero pad-query rows (see the XLA branch below: every attention
+        # path defines pad-query context as zero)
+        ctx = ctx * bias["qmask"][:, :, None, None].astype(ctx.dtype)
         ctx = checkpoint_name(ctx, "attn_out")  # for remat_policy="attn"
         ctx = ctx.astype(x.dtype).reshape(b, s, local_heads * hd)
         return row_parallel_linear(blk["out"], ctx, tp_axis)
@@ -262,6 +265,14 @@ def _attention(
     scores = scores * (1.0 / math.sqrt(hd)) + alibi + bias["mask_bias"]
     probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(x.dtype)
     ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, v, preferred_element_type=jnp.float32)
+    # fully-masked query rows (pad queries under LEFT padding attend
+    # nothing): softmax of an all-NEG_INF row is an accidental uniform
+    # average over keys. Define the context as ZERO there instead — the
+    # flash kernel's natural value — so the XLA, flash, ring, and
+    # Ulysses paths agree bit-for-bit. No loss-carrying position is
+    # affected under the reference's right-padded protocol (a valid
+    # target implies a valid query there).
+    ctx = ctx * bias["qmask"][:, :, None, None].astype(ctx.dtype)
     ctx = checkpoint_name(ctx, "attn_out")
     ctx = ctx.astype(x.dtype).reshape(b, s, local_heads * hd)
     return row_parallel_linear(blk["out"], ctx, tp_axis)
@@ -304,7 +315,7 @@ def attention_bias(attention_mask: jax.Array, config: BloomConfig) -> dict:
         from pipegoose_tpu.ops.flash_attention import mask_to_kv_bias
 
         kv_pos, kv_neg = mask_to_kv_bias(attention_mask)
-        return {"kv_pos": kv_pos, "kv_neg": kv_neg}
+        return {"kv_pos": kv_pos, "kv_neg": kv_neg, "qmask": attention_mask}
 
     s = attention_mask.shape[-1]
     alibi = build_alibi(attention_mask, config.n_head)
@@ -313,6 +324,7 @@ def attention_bias(attention_mask: jax.Array, config: BloomConfig) -> dict:
     return {
         "alibi": alibi,
         "mask_bias": jnp.where(keep, 0.0, NEG_INF).astype(jnp.float32),
+        "qmask": attention_mask,
     }
 
 
@@ -703,6 +715,25 @@ def pp_specs(params: dict, tp_axis: str = "tensor", pipe_axis: str = "pipe") -> 
 
 # -- sequence-parallel composition ------------------------------------------
 
+def _sp_alibi_pos(pad_mask_local: jax.Array, sp_axis: str) -> jax.Array:
+    """GLOBAL mask-aware ALiBi key positions for this sequence chunk:
+    BLOOM's ``(cumsum(mask)-1)*mask`` over the FULL sequence (HF
+    build_alibi_tensor semantics — matches :func:`build_alibi`), under
+    sequence sharding. One tiny all_gather of per-chunk mask counts
+    gives every rank the global prefix for its chunk; for unpadded or
+    right-padded batches the result equals plain global positions, for
+    LEFT-padded batches it is what HF computes and plain positions are
+    not. Compute ONCE per step and thread through the blocks."""
+    m = pad_mask_local.astype(jnp.float32)
+    counts = jax.lax.all_gather(m.sum(-1), sp_axis)  # (sp, B)
+    sp = jax.lax.axis_size(sp_axis)
+    rank = jax.lax.axis_index(sp_axis)
+    prefix = jnp.where(
+        jnp.arange(sp)[:, None] < rank, counts, 0.0
+    ).sum(0)  # (B,) non-pad tokens on earlier chunks
+    return (prefix[:, None] + jnp.cumsum(m, axis=-1) - 1.0) * m
+
+
 def _attention_sp(
     blk: dict,
     x: jax.Array,  # (B, S_local, H)
@@ -711,11 +742,13 @@ def _attention_sp(
     sp_axis: str,
     pad_mask_local: jax.Array,  # (B, S_local)
     variant: str = "ring",
+    alibi_pos: Optional[jax.Array] = None,  # (B, S_local) global positions
 ) -> jax.Array:
     """BLOOM attention with the sequence sharded over ``sp_axis`` and
-    heads over ``tp_axis``. ALiBi uses plain global key positions —
-    identical to HF's mask-aware positions for unpadded or right-padded
-    batches (the cumsum trick only differs under left/interior padding).
+    heads over ``tp_axis``. ALiBi positions come from ``alibi_pos``
+    (mask-aware global positions, HF semantics under ANY padding —
+    _sp_alibi_pos); when None, plain global key positions are used,
+    identical for unpadded or right-padded batches.
 
     ``variant``:
     - ``"ring"``: K/V blocks rotate over the sp ring (flash chunk
@@ -759,16 +792,24 @@ def _attention_sp(
         ctx = ulysses_causal_attention(
             q, k, v, sp_axis, pad_mask_local,
             alibi_slopes=slopes, use_flash=config.use_flash,
+            alibi_pos_local=alibi_pos,
         )
     elif config.use_flash:
         # fused chunk kernel per ring step — no (S_local, S_local) score
         # materialization in the forward
         ctx = ring_flash_attention(
-            q, k, v, sp_axis, alibi_slopes=slopes, kv_side=pad_mask_local
+            q, k, v, sp_axis, alibi_slopes=slopes, kv_side=pad_mask_local,
+            alibi_pos=alibi_pos,
         )
     else:
         bias_fn = make_causal_alibi_bias_fn(s_local, sp_axis, alibi_slopes=slopes)
-        ctx = ring_attention(q, k, v, sp_axis, bias_fn, kv_side=pad_mask_local)
+        side = (
+            (pad_mask_local, alibi_pos)
+            if alibi_pos is not None else pad_mask_local
+        )
+        ctx = ring_attention(q, k, v, sp_axis, bias_fn, kv_side=side)
+    # pad-query context is ZERO in every attention path (see _attention)
+    ctx = ctx * pad_mask_local[:, :, None, None].astype(ctx.dtype)
     ctx = checkpoint_name(ctx, "attn_out")
     ctx = ctx.astype(x.dtype).reshape(b, s_local, local_heads * hd)
     return row_parallel_linear(blk["out"], ctx, tp_axis)
@@ -797,10 +838,14 @@ def loss_fn_sp(
         attention_mask = jnp.ones((b, s_local), dtype=jnp.int32)
 
     x = embed_tokens(params, input_ids, config, tp_axis)
+    # global mask-aware ALiBi positions, once per step (HF semantics
+    # under any padding — left-padded batches included)
+    apos = _sp_alibi_pos(attention_mask, sp_axis)
 
     def scan_fn(carry, blk):
         return _sp_block(
-            blk, carry, config, tp_axis, sp_axis, attention_mask, variant
+            blk, carry, config, tp_axis, sp_axis, attention_mask, variant,
+            alibi_pos=apos,
         ), None
 
     step = _remat_wrap(scan_fn, config) if config.remat else scan_fn
@@ -816,13 +861,14 @@ def loss_fn_sp(
 
 
 def _sp_block(blk, h, config, tp_axis, sp_axis, pad_mask_local,
-              variant: str = "ring"):
+              variant: str = "ring", alibi_pos=None):
     """One transformer block on sequence-sharded activations (shared by
     the plain SP and the PP x SP compositions)."""
     ln1 = layer_norm(blk["ln_1"], h, config.layer_norm_epsilon)
     attn_blk = {"qkv": blk["attn"]["qkv"], "out": blk["attn"]["out"]}
     h = h + _attention_sp(
-        attn_blk, ln1, config, tp_axis, sp_axis, pad_mask_local, variant
+        attn_blk, ln1, config, tp_axis, sp_axis, pad_mask_local, variant,
+        alibi_pos=alibi_pos,
     )
     return h + _mlp(blk, h, config, tp_axis)
 
@@ -881,11 +927,17 @@ def loss_fn_pp_sp(
         {"ids": input_ids, "mask": attention_mask, "labels": labels}, n_microbatches
     )
     h0 = jax.vmap(lambda ids: embed_tokens(params, ids, config, tp_axis))(mbs["ids"])
-    side = {"mask": mbs["mask"]}
+    # mask-aware global ALiBi positions per microbatch (HF semantics
+    # under any padding), computed once and fed as a pipeline side input
+    apos = jax.vmap(lambda m: _sp_alibi_pos(m, sp_axis))(mbs["mask"])
+    side = {"mask": mbs["mask"], "apos": apos}
 
     def stage_fn(blocks, h, side):
         def scan_fn(carry, blk):
-            return _sp_block(blk, carry, config, tp_axis, sp_axis, side["mask"]), None
+            return _sp_block(
+                blk, carry, config, tp_axis, sp_axis, side["mask"],
+                alibi_pos=side["apos"],
+            ), None
 
         h, _ = jax.lax.scan(scan_fn, h, blocks)
         return h

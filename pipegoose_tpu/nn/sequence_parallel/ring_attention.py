@@ -144,6 +144,7 @@ def ring_flash_attention(
     kv_side: Optional[jax.Array] = None,  # (B, S_local) pad mask, rides the ring
     scale: Optional[float] = None,
     interpret: Optional[bool] = None,
+    alibi_pos: Optional[jax.Array] = None,  # (B, S_local) mask-aware GLOBAL pos
 ) -> jax.Array:
     """Ring attention with fused flash chunks, forward AND backward.
 
@@ -170,12 +171,26 @@ def ring_flash_attention(
     shrink by g, exactly the traffic long-context GQA models care
     about. dK/dV contributions are computed per query head and
     group-summed into nkv-headed carriers riding the ring.
+
+    ``alibi_pos``: mask-aware GLOBAL key positions for ALiBi — BLOOM's
+    ``(cumsum(mask)-1)*mask`` computed over the full sequence (the
+    caller supplies the global prefix, see models/bloom._sp_alibi_pos).
+    Needed for LEFT-padded batches, where plain ``rank*S_local +
+    arange`` positions diverge from HF. The chunk kernels keep using
+    plain positions for the causal mask; the per-key ALiBi correction
+    ``slope * (alibi_pos - plain_pos)`` folds into the additive key
+    bias outside the kernel (exact — ALiBi is constant per key).
     """
     b, s_local, nh, hd = q.shape
     nkv = k.shape[2]
     if nh % nkv:
         raise ValueError(f"n_head={nh} must be a multiple of n_kv_head={nkv}")
     g = nh // nkv
+    if alibi_pos is not None and g != 1:
+        # the fold needs per-head key bias rows; under GQA the kernels
+        # share one kneg row across g query heads (and no ALiBi model
+        # uses GQA — ALiBi is the BLOOM family, g == 1)
+        raise ValueError("alibi_pos requires n_head == n_kv_head (g == 1)")
     if scale is None:
         scale = hd**-0.5
     if alibi_slopes is None:
@@ -196,7 +211,7 @@ def ring_flash_attention(
         kneg = jnp.zeros((b, s_local), jnp.float32)
 
     out = _ring_flash(
-        flat(q), flat(k), flat(v), slopes, kneg,
+        flat(q), flat(k), flat(v), slopes, kneg, alibi_pos,
         axis_name, float(scale), interpret, g,
     )
     return out.reshape(b, nh, s_local, hd).transpose(0, 2, 1, 3).astype(q.dtype)
@@ -225,13 +240,29 @@ def _expand_heads(x_b, bh):
     return jnp.broadcast_to(x_b[:, None, :], (b, nh, s)).reshape(bh, s)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
-def _ring_flash(q, k, v, slopes, kneg, axis_name, scale, interpret, g=1):
-    out, _ = _ring_flash_fwd_pass(q, k, v, slopes, kneg, axis_name, scale, interpret, g)
+def _key_bias(kneg_t, apos_t, slopes, kv_rank, bkv, s_local):
+    """Per-head additive key bias for one chunk: padding NEG_INF plus —
+    when mask-aware ALiBi positions ride the ring — the correction
+    ``slope * (alibi_pos - plain_pos)`` (the kernel itself adds
+    ``slope * plain_pos``, so the sum is ``slope * alibi_pos``; plain
+    positions stay in the kernel for the causal mask)."""
+    kb = _expand_heads(kneg_t, bkv)
+    if apos_t is not None:
+        kpos = _kpos_for(kv_rank, bkv, s_local)
+        kb = kb + slopes[:, None] * (_expand_heads(apos_t, bkv) - kpos)
+    return kb
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8, 9))
+def _ring_flash(q, k, v, slopes, kneg, apos, axis_name, scale, interpret, g=1):
+    out, _ = _ring_flash_fwd_pass(
+        q, k, v, slopes, kneg, apos, axis_name, scale, interpret, g
+    )
     return out
 
 
-def _ring_flash_fwd_pass(q, k, v, slopes, kneg, axis_name, scale, interpret, g=1):
+def _ring_flash_fwd_pass(q, k, v, slopes, kneg, apos, axis_name, scale,
+                         interpret, g=1):
     from pipegoose_tpu.ops.flash_attention import flash_ring_chunk
 
     bh, s_local, hd = q.shape
@@ -243,42 +274,48 @@ def _ring_flash_fwd_pass(q, k, v, slopes, kneg, axis_name, scale, interpret, g=1
         jnp.zeros((bh, s_local, hd), jnp.float32),
     )
 
-    def chunk(state, k_t, v_t, kv_rank, kneg_t):
+    def chunk(state, k_t, v_t, kv_rank, side_t):
+        kneg_t, apos_t = side_t
         m, l, acc = state
         return flash_ring_chunk(
             q, k_t, v_t, slopes, qpos, _kpos_for(kv_rank, bkv, s_local),
-            _expand_heads(kneg_t, bkv), m, l, acc, scale, interpret, g,
+            _key_bias(kneg_t, apos_t, slopes, kv_rank, bkv, s_local),
+            m, l, acc, scale, interpret, g,
         )
 
-    m, l, acc = _ring_scan(chunk, state0, k, v, kneg, axis_name)
+    # the (kneg, apos) pair rides the ring together (ppermute on the
+    # pytree; apos=None is an empty subtree and costs nothing)
+    m, l, acc = _ring_scan(chunk, state0, k, v, (kneg, apos), axis_name)
     l = jnp.maximum(l, 1e-30)
     out = (acc / l[..., None]).astype(q.dtype)
     lse = m + jnp.log(l)
     return out, lse
 
 
-def _ring_flash_vjp_fwd(q, k, v, slopes, kneg, axis_name, scale, interpret, g=1):
+def _ring_flash_vjp_fwd(q, k, v, slopes, kneg, apos, axis_name, scale,
+                        interpret, g=1):
     out, lse = _ring_flash_fwd_pass(
-        q, k, v, slopes, kneg, axis_name, scale, interpret, g
+        q, k, v, slopes, kneg, apos, axis_name, scale, interpret, g
     )
     # O(S_local) residuals only — no per-ring-step stacking
-    return out, (q, k, v, slopes, kneg, out, lse)
+    return out, (q, k, v, slopes, kneg, apos, out, lse)
 
 
 def _ring_flash_vjp_bwd(axis_name, scale, interpret, g, res, dout):
     from pipegoose_tpu.ops.flash_attention import flash_chunk_dq, flash_chunk_dkv
 
-    q, k, v, slopes, kneg, out, lse = res
+    q, k, v, slopes, kneg, apos, out, lse = res
     bh, s_local, hd = q.shape
     bkv = k.shape[0]
     rank, qpos = _ring_positions(axis_name, bh, s_local)
     sp = lax.axis_size(axis_name) if axis_name else 1
     delta = (dout.astype(jnp.float32) * out.astype(jnp.float32)).sum(-1)
 
-    def contributions(dq, dk, dv, k_t, v_t, kneg_t, t):
+    def contributions(dq, dk, dv, k_t, v_t, side_t, t):
+        kneg_t, apos_t = side_t
         kv_rank = (rank - t) % sp
         kpos = _kpos_for(kv_rank, bkv, s_local)
-        kneg_h = _expand_heads(kneg_t, bkv)
+        kneg_h = _key_bias(kneg_t, apos_t, slopes, kv_rank, bkv, s_local)
         dq = dq + flash_chunk_dq(
             q, k_t, v_t, dout, lse, delta, slopes, qpos, kpos, kneg_h,
             scale, interpret, g,
@@ -295,33 +332,35 @@ def _ring_flash_vjp_bwd(axis_name, scale, interpret, g, res, dout):
         return dq, dk + dkc, dv + dvc
 
     def step(carry, t):
-        k_t, v_t, kneg_t, dk, dv, dq = carry
-        dq, dk, dv = contributions(dq, dk, dv, k_t, v_t, kneg_t, t)
+        k_t, v_t, side_t, dk, dv, dq = carry
+        dq, dk, dv = contributions(dq, dk, dv, k_t, v_t, side_t, t)
         # the dK/dV accumulators ride with their chunk toward home
         k_t = shift_right(k_t, axis_name) if axis_name else k_t
         v_t = shift_right(v_t, axis_name) if axis_name else v_t
-        kneg_t = shift_right(kneg_t, axis_name) if axis_name else kneg_t
+        side_t = shift_right(side_t, axis_name) if axis_name else side_t
         dk = shift_right(dk, axis_name) if axis_name else dk
         dv = shift_right(dv, axis_name) if axis_name else dv
-        return (k_t, v_t, kneg_t, dk, dv, dq), None
+        return (k_t, v_t, side_t, dk, dv, dq), None
 
     zeros_kv = jnp.zeros((bkv, s_local, hd), jnp.float32)
     dq0 = jnp.zeros((bh, s_local, hd), jnp.float32)
+    side = (kneg, apos)
     if sp == 1:
-        dq, dk, dv = contributions(dq0, zeros_kv, zeros_kv, k, v, kneg, 0)
+        dq, dk, dv = contributions(dq0, zeros_kv, zeros_kv, k, v, side, 0)
     else:
         # sp-1 full steps, then a final step that ships ONLY the dK/dV
         # accumulators home — rotating k/v/kneg on the last step would be
         # a dead collective per layer (same rationale as the forward
         # _ring_scan's skipped last rotation)
-        (k_t, v_t, kneg_t, dk, dv, dq), _ = lax.scan(
-            step, (k, v, kneg, zeros_kv, zeros_kv, dq0), jnp.arange(sp - 1)
+        (k_t, v_t, side_t, dk, dv, dq), _ = lax.scan(
+            step, (k, v, side, zeros_kv, zeros_kv, dq0), jnp.arange(sp - 1)
         )
-        dq, dk, dv = contributions(dq, dk, dv, k_t, v_t, kneg_t, sp - 1)
+        dq, dk, dv = contributions(dq, dk, dv, k_t, v_t, side_t, sp - 1)
         dk = shift_right(dk, axis_name)
         dv = shift_right(dv, axis_name)
+    d_apos = None if apos is None else jnp.zeros_like(apos)
     return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype),
-            jnp.zeros_like(slopes), jnp.zeros_like(kneg))
+            jnp.zeros_like(slopes), jnp.zeros_like(kneg), d_apos)
 
 
 _ring_flash.defvjp(_ring_flash_vjp_fwd, _ring_flash_vjp_bwd)
@@ -335,9 +374,17 @@ def make_causal_alibi_bias_fn(
     window: Optional[int] = None,  # sliding window (Mistral semantics)
 ):
     """Block bias for attention under sequence sharding: causal mask on
-    GLOBAL positions (+ optional sliding window) + ALiBi (slope * global
-    key position; omit for RoPE families) + padding mask from the K/V
-    chunk's attention mask (rides the ring as ``kv_side``)."""
+    GLOBAL positions (+ optional sliding window) + ALiBi (omit slopes for
+    RoPE families) + padding mask from the K/V chunk's attention mask
+    (rides the ring as ``kv_side``).
+
+    ALiBi positions: with a plain ``(B, Skv)`` mask as ``kv_side``, the
+    slope multiplies the plain global key position — identical to HF's
+    mask-aware ``(cumsum(mask)-1)*mask`` for unpadded/right-padded
+    batches. For LEFT-padded batches pass ``kv_side`` as the pair
+    ``(mask, alibi_pos)`` where ``alibi_pos`` holds the global
+    mask-aware positions (models/bloom._sp_alibi_pos) — the pair rides
+    the ring together and the slope multiplies ``alibi_pos`` instead."""
     rank = (
         q_rank
         if q_rank is not None
@@ -345,16 +392,23 @@ def make_causal_alibi_bias_fn(
     )
     q_pos = rank * seq_local + jnp.arange(seq_local)  # (Sq,)
 
-    def bias_fn(kv_rank, kv_pad_mask=None):
+    def bias_fn(kv_rank, kv_side=None):
+        if isinstance(kv_side, tuple):
+            kv_pad_mask, apos = kv_side
+        else:
+            kv_pad_mask, apos = kv_side, None
         kv_pos = kv_rank * seq_local + jnp.arange(seq_local)  # (Skv,)
         keep = q_pos[:, None] >= kv_pos[None, :]  # (Sq, Skv)
         if window is not None:
             keep = keep & (q_pos[:, None] - kv_pos[None, :] < window)
         bias = jnp.where(keep, 0.0, NEG_INF)[None, None]  # (1,1,Sq,Skv)
         if alibi_slopes is not None:
-            # NOTE: mask-aware position (cumsum) needs global context; for
-            # right-padded batches plain positions match HF's alibi
-            bias = bias + alibi_slopes[None, :, None, None] * kv_pos[None, None, None, :].astype(jnp.float32)
+            akp = (
+                apos[:, None, None, :]  # (B,1,1,Skv) mask-aware
+                if apos is not None
+                else kv_pos[None, None, None, :]  # plain global
+            ).astype(jnp.float32)
+            bias = bias + alibi_slopes[None, :, None, None] * akp
         if kv_pad_mask is not None:
             keep_pad = kv_pad_mask[:, None, None, :] > 0  # (B,1,1,Skv)
             bias = bias + jnp.where(keep_pad, 0.0, NEG_INF)

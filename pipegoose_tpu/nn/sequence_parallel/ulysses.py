@@ -52,6 +52,7 @@ def ulysses_causal_attention(
     alibi_slopes: Optional[jax.Array] = None,  # (nh,) LOCAL head slopes
     window: Optional[int] = None,
     use_flash: bool = False,
+    alibi_pos_local: Optional[jax.Array] = None,  # (B, S_local) mask-aware pos
 ) -> jax.Array:
     """Causal Ulysses attention shared by the model families (bloom:
     ALiBi slopes; mixtral/llama: RoPE pre-applied, optional sliding
@@ -78,6 +79,13 @@ def ulysses_causal_attention(
         all_gather(pad_mask_local, axis_name, dim=1)
         if pad_mask_local is not None else None
     )
+    # mask-aware global ALiBi positions (HF semantics for left-padded
+    # batches — see models/bloom._sp_alibi_pos); full sequence per device
+    # after the exchange, so they gather like the mask
+    full_apos = (
+        all_gather(alibi_pos_local, axis_name, dim=1)
+        if alibi_pos_local is not None else None
+    )
     sub_slopes = None
     if alibi_slopes is not None:
         nh_sub = nh // sp
@@ -93,9 +101,13 @@ def ulysses_causal_attention(
                 mask_to_kv_bias,
             )
 
-            kv_pos = jnp.broadcast_to(
-                jnp.arange(s_full, dtype=jnp.float32)[None], (b, s_full)
-            )  # plain global positions — same ALiBi semantics as ring
+            if full_apos is not None:
+                kv_pos = full_apos  # mask-aware (kv_pos is ALiBi-only here;
+                # causal comes from block indices inside the kernel)
+            else:
+                kv_pos = jnp.broadcast_to(
+                    jnp.arange(s_full, dtype=jnp.float32)[None], (b, s_full)
+                )  # plain global positions — same ALiBi semantics as ring
             kv_neg = (
                 mask_to_kv_bias(full_mask)[1] if full_mask is not None else None
             )
@@ -106,7 +118,8 @@ def ulysses_causal_attention(
         bias_fn = make_causal_alibi_bias_fn(
             s_full, None, alibi_slopes=sub_slopes, window=window
         )
+        side = (full_mask, full_apos) if full_apos is not None else full_mask
         # single-step ring == plain attention, with native GQA
-        return ring_attention(qh, kh, vh, None, bias_fn, kv_side=full_mask)
+        return ring_attention(qh, kh, vh, None, bias_fn, kv_side=side)
 
     return ulysses_attention(q, k, v, axis_name, attn_fn)
