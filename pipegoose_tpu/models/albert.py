@@ -1,0 +1,336 @@
+"""ALBERT, TPU-native — the encoder (bidirectional) model family.
+
+The reference's TP mapping covers albert alongside bloom
+(pipegoose/nn/tensor_parallel/parallel_mapping.py:33-52: query/key/value
+and ffn column-parallel, attention.dense and ffn_output row-parallel)
+and its DataParallel tests run on an encoder (bert-tiny,
+tests/nn/data_parallel/test_data_parallel.py:18) — so an encoder family
+with TP + DP coverage is part of the reference's demonstrated surface.
+Implemented from scratch in JAX with the same layer functions as the
+causal families:
+
+- BIDIRECTIONAL attention: no causal mask — only the key-padding bias
+  (every query attends all valid positions);
+- factorized embedding (vocab x E, then a dense E->H projection) and
+  CROSS-LAYER PARAMETER SHARING: one layer's params applied n_layer
+  times — expressed as ``lax.scan`` over a length-n_layer trip with the
+  SAME params in the carry closure (no stacked per-layer dim at all);
+- post-LN residuals (LayerNorm AFTER the residual add, BERT lineage),
+  vs the causal families' pre-LN;
+- MLM head: dense H->E + gelu + LN, then the decoder TIED to the word
+  embedding (vocab-sharded logits + vocab-parallel CE under TP).
+
+Semantics match HF ``modeling_albert`` (gelu-tanh ``gelu_new``,
+separate q/k/v projections, additive key mask, absolute position +
+token-type embeddings) so HF checkpoints load exactly; parity is tested
+against the torch implementation in tests/models/test_albert.py.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from pipegoose_tpu.nn.parallel_mapping import (
+    Column,
+    ParallelMapping,
+    Row,
+    Vocab,
+)
+from pipegoose_tpu.nn.tensor_parallel.layers import (
+    column_parallel_linear,
+    layer_norm,
+    row_parallel_linear,
+    vocab_parallel_cross_entropy,
+    vocab_parallel_embedding,
+)
+
+NEG_INF = -1e9
+
+
+@dataclasses.dataclass(frozen=True)
+class AlbertConfig:
+    vocab_size: int = 30000
+    embedding_size: int = 128
+    hidden_size: int = 768
+    n_layer: int = 12
+    n_head: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    layer_norm_eps: float = 1e-12
+    initializer_range: float = 0.02
+    dtype: Any = jnp.float32
+    remat: bool = False
+    # true vocab size when the embedding was padded for TP divisibility
+    valid_vocab_size: Optional[int] = None
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.n_head
+
+    @classmethod
+    def albert_base(cls, **kw) -> "AlbertConfig":
+        return cls(**kw)  # the defaults ARE albert-base-v2
+
+
+def gelu_new(x: jax.Array) -> jax.Array:
+    """HF ``gelu_new`` (full-precision tanh approximation — ALBERT's
+    activation; bloom uses a truncated-constant variant)."""
+    return 0.5 * x * (
+        1.0 + jnp.tanh(math.sqrt(2.0 / math.pi) * (x + 0.044715 * x**3))
+    )
+
+
+# -- init ------------------------------------------------------------------
+
+def init_params(config: AlbertConfig, key: jax.Array) -> dict:
+    """Random init matching HF's scheme. NOTE the layout: ``layer`` holds
+    ONE layer's params (cross-layer sharing) — no stacked n_layer dim."""
+    c = config
+    k = iter(jax.random.split(key, 16))
+
+    def dense(kk, din, dout):
+        return {
+            "kernel": (jax.random.normal(kk, (din, dout)) * c.initializer_range
+                       ).astype(c.dtype),
+            "bias": jnp.zeros((dout,), c.dtype),
+        }
+
+    def ln(d):
+        return {"scale": jnp.ones((d,), c.dtype), "bias": jnp.zeros((d,), c.dtype)}
+
+    emb = lambda kk, n, d: (jax.random.normal(kk, (n, d)) * c.initializer_range
+                            ).astype(c.dtype)
+    h, e, i = c.hidden_size, c.embedding_size, c.intermediate_size
+    return {
+        "embed": {
+            "word": {"weight": emb(next(k), c.vocab_size, e)},
+            "pos": emb(next(k), c.max_position_embeddings, e),
+            "type": emb(next(k), c.type_vocab_size, e),
+            "ln": ln(e),
+        },
+        "map_in": dense(next(k), e, h),
+        "layer": {
+            "attn": {
+                "q": dense(next(k), h, h),
+                "k": dense(next(k), h, h),
+                "v": dense(next(k), h, h),
+                "dense": dense(next(k), h, h),
+                "ln": ln(h),
+            },
+            "ffn": {
+                "up": dense(next(k), h, i),
+                "down": dense(next(k), i, h),
+                "ln": ln(h),
+            },
+        },
+        "mlm": {
+            "dense": dense(next(k), h, e),
+            "ln": ln(e),
+            "bias": jnp.zeros((c.vocab_size,), c.dtype),
+        },
+    }
+
+
+# -- forward ---------------------------------------------------------------
+
+def _attention(
+    blk: dict,
+    x: jax.Array,  # (B, S, H)
+    key_bias: jax.Array,  # (B, 1, 1, S) additive key-padding bias
+    config: AlbertConfig,
+    tp_axis: Optional[str],
+) -> jax.Array:
+    """Bidirectional self-attention, heads sharded over ``tp_axis``
+    (q/k/v column-parallel, output dense row-parallel — the reference's
+    albert mapping, parallel_mapping.py:33-43). Post-LN residual."""
+    b, s, _ = x.shape
+    hd = config.head_dim
+    tp = jax.lax.axis_size(tp_axis) if tp_axis else 1
+    if config.n_head % tp:
+        raise ValueError(f"n_head={config.n_head} not divisible by tp={tp}")
+    nh = config.n_head // tp
+
+    def heads(p):
+        return column_parallel_linear(p, x, tp_axis).reshape(b, s, nh, hd)
+
+    q, k, v = heads(blk["q"]), heads(blk["k"]), heads(blk["v"])
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32)
+    scores = scores * (1.0 / math.sqrt(hd)) + key_bias
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(x.dtype)
+    ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, v,
+                     preferred_element_type=jnp.float32)
+    ctx = ctx.astype(x.dtype).reshape(b, s, nh * hd)
+    proj = row_parallel_linear(blk["dense"], ctx, tp_axis)
+    return layer_norm(blk["ln"], x + proj, config.layer_norm_eps)
+
+
+def _layer(
+    layer: dict,
+    x: jax.Array,
+    key_bias: jax.Array,
+    config: AlbertConfig,
+    tp_axis: Optional[str],
+) -> jax.Array:
+    """One ALBERT layer (HF AlbertLayer): post-LN attention, then
+    post-LN FFN (ffn column-parallel, ffn_output row-parallel)."""
+    a = _attention(layer["attn"], x, key_bias, config, tp_axis)
+    hcol = column_parallel_linear(layer["ffn"]["up"], a, tp_axis)
+    down = row_parallel_linear(layer["ffn"]["down"], gelu_new(hcol), tp_axis)
+    return layer_norm(layer["ffn"]["ln"], a + down, config.layer_norm_eps)
+
+
+def embed_tokens(
+    params: dict,
+    input_ids: jax.Array,
+    config: AlbertConfig,
+    tp_axis: Optional[str],
+    token_type_ids: Optional[jax.Array] = None,
+) -> jax.Array:
+    """word (vocab-sharded) + position + token-type embeddings -> LN ->
+    the E->H projection. Returns (B, S, H)."""
+    b, s = input_ids.shape
+    x = vocab_parallel_embedding(params["embed"]["word"], input_ids, tp_axis)
+    x = x + params["embed"]["pos"][None, :s]
+    tt = (
+        token_type_ids
+        if token_type_ids is not None
+        else jnp.zeros((b, s), jnp.int32)
+    )
+    x = x + jnp.take(params["embed"]["type"], tt, axis=0)
+    x = layer_norm(params["embed"]["ln"], x.astype(config.dtype),
+                   config.layer_norm_eps)
+    h = jnp.einsum("bse,eh->bsh", x, params["map_in"]["kernel"],
+                   preferred_element_type=jnp.float32).astype(config.dtype)
+    return h + params["map_in"]["bias"]
+
+
+def forward_hidden(
+    params: dict,
+    input_ids: jax.Array,
+    attention_mask: Optional[jax.Array],
+    config: AlbertConfig,
+    tp_axis: Optional[str] = None,
+    token_type_ids: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Embeddings -> n_layer applications of the SHARED layer. The scan
+    carries only the activations; the one layer's params are closed
+    over — the compiled program contains the layer body once, and the
+    weights stream from HBM once per application."""
+    b, s = input_ids.shape
+    if attention_mask is None:
+        attention_mask = jnp.ones((b, s), dtype=jnp.int32)
+    key_bias = (
+        (1.0 - attention_mask[:, None, None, :].astype(jnp.float32)) * NEG_INF
+    )
+    x = embed_tokens(params, input_ids, config, tp_axis, token_type_ids)
+
+    def body(h, _):
+        return _layer(params["layer"], h, key_bias, config, tp_axis), None
+
+    step = jax.checkpoint(body) if config.remat else body
+    x, _ = jax.lax.scan(step, x, None, length=config.n_layer)
+    return x
+
+
+def logits_fn(
+    params: dict,
+    hidden: jax.Array,
+    tp_axis: Optional[str],
+    eps: float = 1e-12,
+) -> jax.Array:
+    """MLM head: dense H->E + gelu + LN, then the decoder TIED to the
+    word embedding (transposed lookup) + vocab bias. Logits come out
+    vocab-SHARDED under TP (feed vocab_parallel_cross_entropy)."""
+    e = jnp.einsum("bsh,he->bse", hidden, params["mlm"]["dense"]["kernel"],
+                   preferred_element_type=jnp.float32)
+    e = gelu_new(e + params["mlm"]["dense"]["bias"].astype(jnp.float32))
+    e = layer_norm(params["mlm"]["ln"], e.astype(hidden.dtype), eps)
+    if tp_axis:
+        # f-operator: identity forward, all-reduce backward — each rank's
+        # cotangent of ``e`` is only the partial sum over its local vocab
+        # shard (same load-bearing collective as bloom.logits_fn)
+        from pipegoose_tpu.distributed.functional import copy_to_tensor_group
+
+        e = copy_to_tensor_group(e, tp_axis)
+    logits = jnp.einsum("bse,ve->bsv", e, params["embed"]["word"]["weight"],
+                        preferred_element_type=jnp.float32)
+    # the vocab bias shards with the tied embedding's vocab rows (the
+    # mapping marks it Vocab), so under shard_map it arrives as the
+    # matching local slice already
+    return logits + params["mlm"]["bias"].astype(jnp.float32)
+
+
+def forward(
+    params: dict,
+    input_ids: jax.Array,
+    attention_mask: Optional[jax.Array],
+    config: AlbertConfig,
+    tp_axis: Optional[str] = None,
+    token_type_ids: Optional[jax.Array] = None,
+) -> jax.Array:
+    """(B, S) ids -> (B, S, V[/tp]) MLM logits."""
+    hidden = forward_hidden(
+        params, input_ids, attention_mask, config, tp_axis, token_type_ids
+    )
+    return logits_fn(params, hidden, tp_axis, eps=config.layer_norm_eps)
+
+
+def loss_fn(
+    params: dict,
+    input_ids: jax.Array,
+    attention_mask: Optional[jax.Array],
+    labels: jax.Array,  # (B, S) target ids; positions with label_mask 0 ignored
+    config: AlbertConfig,
+    tp_axis: Optional[str] = None,
+    label_mask: Optional[jax.Array] = None,  # (B, S) 1 = scored position
+) -> jax.Array:
+    """Masked-LM cross entropy (NO shift — encoder objective): mean CE
+    over the scored positions. ``label_mask`` is the analog of HF's
+    ``labels != -100``; default scores every valid (attention-masked)
+    position."""
+    logits = forward(params, input_ids, attention_mask, config, tp_axis)
+    per_tok = vocab_parallel_cross_entropy(
+        logits, labels, tp_axis, valid_size=config.valid_vocab_size
+    )
+    if label_mask is None:
+        label_mask = (
+            attention_mask
+            if attention_mask is not None
+            else jnp.ones_like(labels)
+        )
+    w = label_mask.astype(per_tok.dtype)
+    return (per_tok * w).sum() / jnp.maximum(w.sum(), 1)
+
+
+# -- TP policy -------------------------------------------------------------
+
+def tp_mapping(axis: str = "tensor") -> ParallelMapping:
+    """The reference's albert TP mapping, as policy rules
+    (parallel_mapping.py:33-52): q/k/v and ffn Column, attention dense
+    and ffn_output Row, word embedding (and its tied decoder) Vocab."""
+    return ParallelMapping(
+        [
+            ("layer/attn/q", Column(axis)),
+            ("layer/attn/k", Column(axis)),
+            ("layer/attn/v", Column(axis)),
+            ("layer/attn/dense", Row(axis)),
+            ("layer/ffn/up", Column(axis)),
+            ("layer/ffn/down", Row(axis)),
+            ("embed/word", Vocab(axis)),
+            ("mlm/bias", Vocab(axis)),
+        ]
+    )
+
+
+def tp_specs(params: dict, axis: str = "tensor") -> dict:
+    """PartitionSpec pytree (no stacked layer dim — params are shared)."""
+    from pipegoose_tpu.nn.parallel import spec_tree
+
+    mapping = tp_mapping(axis)
+    return spec_tree(params, lambda path, x: mapping.spec_for(path, x.ndim))
