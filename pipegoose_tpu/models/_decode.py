@@ -52,12 +52,17 @@ def autoregressive_generate(
     rng: Optional[jax.Array] = None,
     eos_token_id: Optional[int] = None,
     logits_mask: Optional[Callable] = None,
+    extras=None,
 ) -> jax.Array:
     """Greedy (temperature=0) or sampled decoding with a KV cache.
 
     - ``eos_token_id``: finished sequences emit eos from then on (HF
       generate's pad-with-eos behavior);
     - ``logits_mask(logits) -> logits``: e.g. padded-vocab masking;
+    - ``extras``: optional pytree of RUNTIME side inputs forwarded to
+      ``forward_cached(..., extras=extras)`` — e.g. the extended
+      attention mask for ragged/left-padded prompts. A runtime argument,
+      NOT baked into the compiled program: new masks don't recompile;
     - compiled programs cached per (model fwd, config, prompt len, exact
       temperature, eos) — params stay runtime arguments.
     """
@@ -69,7 +74,10 @@ def autoregressive_generate(
         rng = jax.random.PRNGKey(0)
     eos = -1 if eos_token_id is None else int(eos_token_id)
 
-    key = (forward_cached, config, s, float(temperature), eos, logits_mask)
+    key = (
+        forward_cached, config, s, float(temperature), eos, logits_mask,
+        extras is not None,
+    )
     if key not in _JIT_CACHE:
 
         def pick(logits, k):
@@ -79,16 +87,21 @@ def autoregressive_generate(
                 return jnp.argmax(logits, axis=-1)
             return jax.random.categorical(k, logits / temperature, axis=-1)
 
+        def fwd(params, ids, cache, pos, extras):
+            if extras is None:
+                return forward_cached(params, ids, cache, pos, config)
+            return forward_cached(params, ids, cache, pos, config, extras=extras)
+
         @jax.jit
-        def prefill(params, ids, cache, k):
-            logits, cache = forward_cached(params, ids, cache, 0, config)
+        def prefill(params, ids, cache, k, extras):
+            logits, cache = fwd(params, ids, cache, 0, extras)
             return pick(logits, k), cache
 
         @jax.jit
-        def decode_all(params, first, cache, keys):
+        def decode_all(params, first, cache, keys, extras):
             def step(carry, k):
                 tok, done, cache, pos = carry
-                logits, cache = forward_cached(params, tok[:, None], cache, pos, config)
+                logits, cache = fwd(params, tok[:, None], cache, pos, extras)
                 nxt = pick(logits, k)
                 nxt = jnp.where(done, eos, nxt)
                 done = done | (nxt == eos)
@@ -105,11 +118,11 @@ def autoregressive_generate(
         _JIT_CACHE[key] = _JIT_CACHE.pop(key)  # LRU refresh on hit
     prefill, decode_all = _JIT_CACHE[key]
 
-    first, cache = prefill(params, input_ids, cache, rng)
+    first, cache = prefill(params, input_ids, cache, rng, extras)
     if max_new_tokens == 1:
         return jnp.concatenate([input_ids, first[:, None]], axis=1)
     keys = jax.random.split(jax.random.fold_in(rng, 1), max_new_tokens - 1)
-    rest = decode_all(params, first, cache, keys)
+    rest = decode_all(params, first, cache, keys, extras)
     out = jnp.concatenate([first[:, None], rest.T], axis=1)
     return jnp.concatenate([input_ids, out], axis=1)
 
@@ -146,6 +159,7 @@ def autoregressive_generate_sharded(
     param_specs,
     tp_axis: str = "tensor",
     eos_token_id: Optional[int] = None,
+    extras=None,
 ) -> jax.Array:
     """TENSOR-PARALLEL greedy decoding: the whole generation (prefill +
     scanned decode) runs as one shard_map program over ``mesh`` with
@@ -155,9 +169,11 @@ def autoregressive_generate_sharded(
 
     ``forward_cached(params, ids, cache, start, config, tp_axis)`` must
     return LOCAL vocab-shard logits (the model's TP decode path);
-    ``init_cache(config, b, max_len, tp)`` the local-head cache. Greedy
-    only: sampling under a sharded vocab needs a global categorical —
-    use the single-device path for temperature > 0.
+    ``init_cache(config, b, max_len, tp)`` the local-head cache;
+    ``extras`` an optional replicated side-input pytree forwarded to
+    ``forward_cached`` (ragged-prompt masks). Greedy only: sampling
+    under a sharded vocab needs a global categorical — use the
+    single-device path for temperature > 0.
     """
     from jax.sharding import PartitionSpec as P
 
@@ -173,16 +189,21 @@ def autoregressive_generate_sharded(
     eos = -1 if eos_token_id is None else int(eos_token_id)
     valid = getattr(config, "valid_vocab_size", None)
 
-    def body(params, ids):
+    def fwd(params, ids, cache, pos, extras):
+        if extras is None:
+            return forward_cached(params, ids, cache, pos, config, tp_axis)
+        return forward_cached(
+            params, ids, cache, pos, config, tp_axis, extras=extras
+        )
+
+    def body(params, ids, extras):
         cache = init_cache(config, b, s + max_new_tokens, tp)
-        logits, cache = forward_cached(params, ids, cache, 0, config, tp_axis)
+        logits, cache = fwd(params, ids, cache, 0, extras)
         first = global_greedy_pick(logits, tp_axis, valid)
 
         def step(carry, _):
             tok, done, cache, pos = carry
-            logits, cache = forward_cached(
-                params, tok[:, None], cache, pos, config, tp_axis
-            )
+            logits, cache = fwd(params, tok[:, None], cache, pos, extras)
             nxt = global_greedy_pick(logits, tp_axis, valid)
             nxt = jnp.where(done, eos, nxt)
             done = done | (nxt == eos)
@@ -192,11 +213,14 @@ def autoregressive_generate_sharded(
         _, rest = lax.scan(step, init, None, length=max_new_tokens - 1)
         return jnp.concatenate([first[:, None], rest.T], axis=1)
 
+    extras_specs = jax.tree_util.tree_map(lambda _: P(), extras)
     fn = jax.jit(
         shard_map(
-            body, mesh=mesh, in_specs=(param_specs, P()), out_specs=P(),
+            body, mesh=mesh,
+            in_specs=(param_specs, P(), extras_specs),
+            out_specs=P(),
             check_vma=False,
         )
     )
-    out = fn(params, input_ids)
+    out = fn(params, input_ids, extras)
     return jnp.concatenate([input_ids, out], axis=1)

@@ -8,8 +8,13 @@ two jitted programs with static shapes, and the decode loop is a
 ``lax.scan`` over time steps — the whole generation is compiled, no
 per-token Python.
 
-Prompts are assumed unpadded (equal lengths per batch row) in v1; the
-alibi/causal bias uses plain global positions accordingly.
+Ragged batches follow HF generate's LEFT-padding convention: pass
+``attention_mask`` and each row's prompt ends at the last column. The
+mask is a RUNTIME side input (``_decode`` extras) — the compiled
+programs are shared across masks; ALiBi uses the mask-aware positions
+(build_alibi semantics) and pad slots stay masked as keys for the whole
+generation. Without a mask, prompts are assumed unpadded and plain
+global positions apply.
 """
 from __future__ import annotations
 
@@ -45,17 +50,19 @@ def init_cache(config: BloomConfig, batch: int, max_len: int, tp: int = 1) -> di
     }
 
 
-def _attn_cached(blk, x, k_cache, v_cache, start, config, tp_axis=None):
+def _attn_cached(blk, x, k_cache, v_cache, start, config, tp_axis=None,
+                 bias=None, qmask=None):
     """Attend S new tokens against cache[:start] + themselves; returns
     (out, new_k_cache, new_v_cache). ``start`` is the number of tokens
     already cached (traced scalar). Under TP the qkv projection is
     column-parallel, the cache and slopes carry the LOCAL head subset,
-    and the out projection's row-parallel psum recombines heads."""
+    and the out projection's row-parallel psum recombines heads.
+    ``bias``/``qmask`` come from :func:`_decode_bias` (hoisted — shared
+    by all layers of one forward)."""
     b, s, _ = x.shape
     hd = config.head_dim
     tp = jax.lax.axis_size(tp_axis) if tp_axis else 1
     nh = config.n_head // tp
-    max_len = k_cache.shape[1]
 
     fused = column_parallel_linear(blk["qkv"], x, tp_axis)
     fused = fused.reshape(b, s, nh, 3, hd)
@@ -64,32 +71,65 @@ def _attn_cached(blk, x, k_cache, v_cache, start, config, tp_axis=None):
     k_cache = lax.dynamic_update_slice(k_cache, k.astype(k_cache.dtype), (0, start, 0, 0))
     v_cache = lax.dynamic_update_slice(v_cache, v.astype(v_cache.dtype), (0, start, 0, 0))
 
-    key_pos = jnp.arange(max_len)
-    q_pos = start + jnp.arange(s)
-    slopes = jnp.asarray(alibi_slopes(config.n_head))
-    if tp_axis:
-        slopes = lax.dynamic_slice_in_dim(
-            slopes, jax.lax.axis_index(tp_axis) * nh, nh, 0
-        )
-    bias = slopes[None, :, None, None] * key_pos[None, None, None, :].astype(jnp.float32)
-    keep = key_pos[None, :] <= q_pos[:, None]  # (S, max_len): causal + not-yet-written
-    bias = bias + jnp.where(keep[None, None], 0.0, NEG_INF)
-
     scores = jnp.einsum(
         "bqhd,bkhd->bhqk", q, k_cache, preferred_element_type=jnp.float32
     ) * (hd**-0.5)
     probs = jax.nn.softmax(scores + bias, axis=-1).astype(x.dtype)
     ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, v_cache, preferred_element_type=jnp.float32)
+    if qmask is not None:
+        # pad-query context is ZERO in every attention path (bloom._attention)
+        ctx = ctx * qmask[:, :, None, None].astype(ctx.dtype)
     ctx = ctx.astype(x.dtype).reshape(b, s, nh * hd)
     return row_parallel_linear(blk["out"], ctx, tp_axis), k_cache, v_cache
 
 
-def forward_cached(params, ids, cache, start, config, tp_axis=None):
+def _decode_bias(config, b, s, start, max_len, extras, tp_axis):
+    """Attention bias for one cached-forward call, shared by all layers:
+    causal-by-slot keep + ALiBi (+ per-row key validity for ragged
+    LEFT-padded prompts). Returns (bias (B|1, nh_local, S, max_len),
+    qmask (B, S) or None).
+
+    Ragged prompts (``extras={"mask": (B, max_len)}`` — the prompt's
+    attention mask extended with ones over the generated tail, HF
+    left-padding convention): ALiBi positions become the mask-aware
+    global ``(cumsum(mask)-1)*mask`` (exactly ``build_alibi``), pad
+    slots are masked as keys for every future step, and pad-query rows
+    of the prefill get zero context."""
+    tp = jax.lax.axis_size(tp_axis) if tp_axis else 1
+    nh = config.n_head // tp
+    slopes = jnp.asarray(alibi_slopes(config.n_head))
+    if tp_axis:
+        slopes = lax.dynamic_slice_in_dim(
+            slopes, jax.lax.axis_index(tp_axis) * nh, nh, 0
+        )
+    key_pos = jnp.arange(max_len)
+    q_pos = start + jnp.arange(s)
+    keep = key_pos[None, :] <= q_pos[:, None]  # (S, max_len): causal + not-yet-written
+    causal = jnp.where(keep[None, None], 0.0, NEG_INF)
+    if extras is None:
+        bias = slopes[None, :, None, None] * key_pos[None, None, None, :].astype(jnp.float32)
+        return bias + causal, None
+    m = extras["mask"].astype(jnp.float32)  # (B, max_len)
+    apos = (jnp.cumsum(m, axis=-1) - 1.0) * m
+    bias = slopes[None, :, None, None] * apos[:, None, None, :]
+    bias = bias + jnp.where(m[:, None, None, :] > 0, 0.0, NEG_INF)
+    qmask = lax.dynamic_slice_in_dim(m, start, s, axis=1)  # (B, S)
+    return bias + causal, qmask
+
+
+def forward_cached(params, ids, cache, start, config, tp_axis=None,
+                   extras=None):
     """Forward S tokens with cache read/write. Returns (logits last
     position, new cache). Under TP the returned logits are the LOCAL
-    vocab shard (pair with ``_decode.global_greedy_pick``)."""
+    vocab shard (pair with ``_decode.global_greedy_pick``).
+    ``extras={"mask": (B, max_len)}`` enables ragged/left-padded
+    prompts (see _decode_bias)."""
     x = vocab_parallel_embedding(params["embed"], ids, tp_axis).astype(config.dtype)
     x = layer_norm(params["embed_ln"], x, config.layer_norm_epsilon)
+    b, s = ids.shape
+    bias, qmask = _decode_bias(
+        config, b, s, start, cache["k"].shape[2], extras, tp_axis
+    )
 
     def scan_fn(carry, blk_and_cache):
         h = carry
@@ -97,7 +137,7 @@ def forward_cached(params, ids, cache, start, config, tp_axis=None):
         ln1 = layer_norm(blk["ln_1"], h, config.layer_norm_epsilon)
         attn, kc, vc = _attn_cached(
             {"qkv": blk["attn"]["qkv"], "out": blk["attn"]["out"]},
-            ln1, kc, vc, start, config, tp_axis,
+            ln1, kc, vc, start, config, tp_axis, bias=bias, qmask=qmask,
         )
         h = h + attn
         ln2 = layer_norm(blk["ln_2"], h, config.layer_norm_epsilon)
@@ -117,24 +157,43 @@ def _bloom_init_cache(config, batch, max_len, tp=1):
 
 
 
+def _ragged_extras(attention_mask, max_new_tokens):
+    """Extend a LEFT-padded prompt mask with ones over the generated
+    tail: the runtime side input for ragged decode (HF generate's
+    left-padding convention — the prompt must END at the last column;
+    generated tokens are always valid)."""
+    b = attention_mask.shape[0]
+    ones = jnp.ones((b, max_new_tokens), attention_mask.dtype)
+    return {"mask": jnp.concatenate([attention_mask, ones], axis=1)}
+
+
 def generate(
     params: dict,
-    input_ids: jax.Array,  # (B, S) unpadded prompt
+    input_ids: jax.Array,  # (B, S) prompt; ragged rows LEFT-padded
     config: BloomConfig,
     max_new_tokens: int,
     temperature: float = 0.0,
     rng: Optional[jax.Array] = None,
     eos_token_id: Optional[int] = None,
+    attention_mask: Optional[jax.Array] = None,  # (B, S): ragged prompts
 ) -> jax.Array:
     """Greedy (temperature=0) or sampled decoding. Returns (B, S+new).
     ``eos_token_id``: finished sequences emit eos from then on (HF
-    generate's pad-with-eos behavior)."""
+    generate's pad-with-eos behavior). ``attention_mask`` enables
+    RAGGED prompts (unequal lengths, LEFT-padded like HF generate):
+    ALiBi uses mask-aware positions, pad slots stay masked as keys for
+    the whole generation, and the mask is a runtime input — new masks
+    don't recompile."""
     from pipegoose_tpu.models._decode import autoregressive_generate, vocab_mask_for
 
+    extras = (
+        _ragged_extras(attention_mask, max_new_tokens)
+        if attention_mask is not None else None
+    )
     return autoregressive_generate(
         forward_cached, _bloom_init_cache, params, input_ids, config,
         max_new_tokens, temperature, rng, eos_token_id,
-        logits_mask=vocab_mask_for(config),
+        logits_mask=vocab_mask_for(config), extras=extras,
     )
 
 
@@ -147,14 +206,22 @@ def generate_tp(
     param_specs,
     tp_axis: str = "tensor",
     eos_token_id: Optional[int] = None,
+    attention_mask: Optional[jax.Array] = None,  # (B, S): ragged prompts
 ) -> jax.Array:
     """Tensor-parallel greedy decoding: vocab/head-sharded weights, a
     per-shard KV cache, and a global argmax over the sharded vocab —
     the whole generation compiled as one shard_map program
-    (models/_decode.py:autoregressive_generate_sharded)."""
+    (models/_decode.py:autoregressive_generate_sharded).
+    ``attention_mask`` enables ragged LEFT-padded prompts, same
+    semantics as :func:`generate`."""
     from pipegoose_tpu.models._decode import autoregressive_generate_sharded
 
+    extras = (
+        _ragged_extras(attention_mask, max_new_tokens)
+        if attention_mask is not None else None
+    )
     return autoregressive_generate_sharded(
         forward_cached, _bloom_init_cache, params, input_ids, config,
         max_new_tokens, mesh, param_specs, tp_axis, eos_token_id,
+        extras=extras,
     )

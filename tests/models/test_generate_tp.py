@@ -72,3 +72,27 @@ def test_tp_generate_padded_vocab(devices):
         assert (np.asarray(out) < 62).all()
     finally:
         ctx.destroy()
+
+
+def test_tp_generate_ragged_matches_single_device(setup, devices):
+    """Ragged LEFT-padded prompts under TP == the single-device ragged
+    path, token for token."""
+    cfg, params, _ = setup
+    rng = np.random.RandomState(13)
+    ids = rng.randint(1, 64, (2, 6))
+    mask = np.ones((2, 6), np.int32)
+    ids[1, :3] = 0; mask[1, :3] = 0
+    ids_j, mask_j = jnp.asarray(ids), jnp.asarray(mask)
+    ref = np.asarray(
+        gen.generate(params, ids_j, cfg, max_new_tokens=7, attention_mask=mask_j)
+    )
+
+    ctx = ParallelContext(tensor_parallel_size=4, data_parallel_size=2)
+    try:
+        out = gen.generate_tp(
+            params, ids_j, cfg, 7, ctx.mesh, bloom.tp_specs(params),
+            attention_mask=mask_j,
+        )
+        np.testing.assert_array_equal(np.asarray(out), ref)
+    finally:
+        ctx.destroy()
