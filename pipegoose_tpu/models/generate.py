@@ -161,7 +161,26 @@ def _ragged_extras(attention_mask, max_new_tokens):
     """Extend a LEFT-padded prompt mask with ones over the generated
     tail: the runtime side input for ragged decode (HF generate's
     left-padding convention — the prompt must END at the last column;
-    generated tokens are always valid)."""
+    generated tokens are always valid).
+
+    A RIGHT-padded mask would silently mis-position the generated tail
+    (the appended ones land after the pad gap), so fail loudly instead
+    — one tiny host sync per generate call (advisor r4). The check is
+    best-effort: under a tracer or a non-fully-addressable (multihost)
+    mask the host fetch is impossible, so it is skipped rather than
+    crashing a path that worked before the guard existed (the ``.all()``
+    reduction keeps the fetch legal for fully-replicated shardings)."""
+    try:
+        ends_valid = bool(jnp.asarray(attention_mask)[:, -1].all())
+    except Exception:  # noqa: BLE001 — tracer / non-addressable sharding
+        ends_valid = True
+    if not ends_valid:
+        raise ValueError(
+            "ragged generate expects a LEFT-padded attention_mask (HF "
+            "generate convention): the last column must be all ones, but "
+            "some rows end in padding. Re-tokenize with "
+            "padding_side='left'."
+        )
     b = attention_mask.shape[0]
     ones = jnp.ones((b, max_new_tokens), attention_mask.dtype)
     return {"mask": jnp.concatenate([attention_mask, ones], axis=1)}

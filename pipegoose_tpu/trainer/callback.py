@@ -9,6 +9,23 @@ from __future__ import annotations
 from typing import Any, Optional
 
 
+def _host_scalar(x: Any) -> float:
+    """Scalar (possibly multi-host sharded) -> host float.
+
+    ``float()``/``bool()`` raise on non-fully-addressable arrays, which
+    a multihost trainer produces (tests/distributed/test_multihost.py);
+    fall back to a replicated all-gather in that case (advisor r4).
+    """
+    try:
+        return float(x)
+    except RuntimeError:
+        from jax.experimental import multihost_utils
+
+        import numpy as np
+
+        return float(np.asarray(multihost_utils.process_allgather(x)).reshape(-1)[0])
+
+
 class Callback:
     order: int = 0
 
@@ -72,28 +89,36 @@ class CheckpointCallback(Callback):
         # 1. the last recorded loss — catches divergence that happened on
         #    an earlier step (e.g. slipped past a FailureDetector with
         #    check_every > 1) at zero extra device work;
-        if trainer.state.last_loss is not None and not math.isfinite(
-            float(trainer.state.last_loss)
-        ):
-            trainer.logger.warning(
-                f"step {step}: refusing to checkpoint non-finite state "
-                f"(loss {float(trainer.state.last_loss)})"
-            )
-            return
-        # 2. the params themselves — the loss canary is computed from
-        #    PRE-update params, so a step whose optimizer update itself
-        #    overflowed (finite loss, NaN update) would slip past it.
-        #    One fused reduction per checkpoint; negligible next to the
-        #    write itself.
+        if trainer.state.last_loss is not None:
+            last_loss = _host_scalar(trainer.state.last_loss)
+            if not math.isfinite(last_loss):
+                trainer.logger.warning(
+                    f"step {step}: refusing to checkpoint non-finite state "
+                    f"(loss {last_loss})"
+                )
+                return
+        # 2. the params AND optimizer state — the loss canary is computed
+        #    from PRE-update params, so a step whose optimizer update
+        #    itself overflowed (finite loss, NaN update) would slip past
+        #    it; and opt_state (e.g. overflowed Adam moments under still-
+        #    finite params) is restored too, so a poisoned moment would
+        #    re-poison training on resume (advisor r4). One fused
+        #    reduction per checkpoint; negligible next to the write.
         import functools
 
+        float_leaves = [
+            l
+            for l in jax.tree_util.tree_leaves((trainer.params, trainer.opt_state))
+            if hasattr(l, "dtype") and jnp.issubdtype(l.dtype, jnp.floating)
+        ]
         finite = functools.reduce(
             jnp.logical_and,
-            [jnp.isfinite(l).all() for l in jax.tree_util.tree_leaves(trainer.params)],
+            [jnp.isfinite(l).all() for l in float_leaves],
+            jnp.asarray(True),
         )
-        if not bool(finite):
+        if not _host_scalar(finite):
             trainer.logger.warning(
-                f"step {step}: refusing to checkpoint non-finite params"
+                f"step {step}: refusing to checkpoint non-finite params/opt_state"
             )
             return
         path = save_train_state(self.directory, step, trainer.params, trainer.opt_state)
