@@ -60,7 +60,7 @@ class LossLoggerCallback(Callback):
             dt = time.perf_counter() - self._t0
             tps = self._tokens / dt if dt > 0 else float("nan")
             trainer.logger.info(
-                f"step {step} loss {float(loss):.4f} tokens/s {tps:,.0f}"
+                f"step {step} loss {_host_scalar(loss):.4f} tokens/s {tps:,.0f}"
             )
             self._t0 = time.perf_counter()
             self._tokens = 0

@@ -83,7 +83,9 @@ class FailureDetector(Callback):
     def on_step_end(self, trainer: Any, step: int, loss) -> None:
         if step % self.check_every:
             return
-        reason = self._is_divergent(float(loss))
+        from pipegoose_tpu.trainer.callback import _host_scalar
+
+        reason = self._is_divergent(_host_scalar(loss))
         if reason is not None:
             self.handle_failure(trainer, step, reason)
 

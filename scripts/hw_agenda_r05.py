@@ -1,0 +1,533 @@
+"""Round-5 single-attach hardware agenda.
+
+The axon tunnel is single-client and intermittently held (rounds 3-4:
+round-end bench fell back to CPU four times). This script therefore
+packs EVERY round-5 hardware capture into ONE attached process, run
+opportunistically (scripts/hw_watch.sh retries until the tunnel opens):
+
+  1. BENCH   -> docs/acceptance/BENCH_TPU_r05.json
+     bloom-560m train throughput/MFU, champion flash config first, the
+     no-remat variants retried (the r3 compile-helper HTTP 500 may have
+     healed), cumulative write after every variant.
+  2. TRAIN   -> docs/acceptance/TRAIN_TPU_r05.json
+     full-vocab convergence: bloom-560m over the REAL 250,880-token
+     vocab with word-level Zipfian ids (reference acceptance protocol,
+     /root/reference/tests/convergence/run_hybrid_parallel.py:83-177;
+     no HF tokenizer is reachable offline, so the corpus is word-
+     tokenized locally and ranks are permuted across the full id
+     range — same embedding-table + vocab-CE distribution shape).
+  3. DECODE  -> docs/acceptance/DECODE_TPU_r05.json
+     KV-cache decode throughput for bloom-560m AND a GQA family
+     (mixtral-450m) — the r3 record covered bloom only.
+
+Parent/child split mirrors bench.py: the parent never touches the
+backend; the child prints ``AGENDA_READY`` right after attach.
+Parent rc: 0 = child ran the agenda (individual stage errors are
+recorded in the JSONs), 3 = backend never attached (retryable).
+
+Timing recipe per docs/perf_tpu_v5e.md: step loops live inside jit
+(lax.scan), value fetches force completion, dispatch RTT subtracted.
+
+    PYTHONPATH=.:/root/.axon_site python scripts/hw_agenda_r05.py
+"""
+from __future__ import annotations
+
+import functools
+import json
+import os
+import re
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+ACC = REPO / "docs" / "acceptance"
+
+ATTACH_DEADLINE_S = int(os.environ.get("AGENDA_ATTACH_DEADLINE_S", "300"))
+RUN_DEADLINE_S = int(os.environ.get("AGENDA_RUN_DEADLINE_S", "3600"))
+# AGENDA_SMOKE=1: run the full flow with tiny shapes on CPU into /tmp —
+# validates the script end-to-end without holding the tunnel
+SMOKE = bool(os.environ.get("AGENDA_SMOKE"))
+
+PEAK_FLOPS = {
+    "v5 lite": 197e12, "v5e": 197e12, "v5p": 459e12,
+    "v6 lite": 918e12, "v6e": 918e12, "v4": 275e12,
+}
+
+
+def _peak_flops(device_kind: str) -> float:
+    kind = device_kind.lower()
+    for k, v in PEAK_FLOPS.items():
+        if k in kind:
+            return v
+    return 1e12
+
+
+def _rtt() -> float:
+    import jax
+    import jax.numpy as jnp
+
+    tiny = jax.jit(lambda x: x + 1.0)
+    z = jnp.zeros(())
+    float(tiny(z))
+    t0 = time.perf_counter()
+    for _ in range(3):
+        float(tiny(z))
+    return (time.perf_counter() - t0) / 3
+
+
+# ---------------------------------------------------------------- stage 1
+
+
+def stage_bench(device_kind: str) -> dict:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from pipegoose_tpu.models import bloom
+
+    steps = 10
+    variants = {
+        # champion first: the most important number lands even if a
+        # later variant wedges the tunnel
+        "flash": (dict(remat=True, use_flash=True), 8, 1024),
+        # the r3 sweep's 0.40-MFU candidates, blocked then by the
+        # remote-compile-helper HTTP 500 — retry (VERDICT r4 next #2)
+        "noremat+flash+ce8": (
+            dict(remat=False, use_flash=True, ce_chunks=8), 8, 1024),
+        "noremat+flash": (dict(remat=False, use_flash=True), 4, 1024),
+        "flash+ce8": (dict(remat=True, use_flash=True, ce_chunks=8), 8, 1024),
+        "flash_s2048": (dict(remat=True, use_flash=True), 4, 2048),
+        "flash_b16": (dict(remat=True, use_flash=True), 16, 1024),
+        "xla": (dict(remat=True), 8, 1024),
+    }
+    make_cfg = functools.partial(bloom.BloomConfig.bloom_560m, dtype=jnp.bfloat16)
+    if SMOKE:
+        steps = 2
+        variants = {
+            "flash": (dict(remat=True, use_flash=True), 2, 128),
+            "xla": (dict(remat=True), 2, 128),
+        }
+
+        def make_cfg(**kw):
+            kw.pop("ce_chunks", None)
+            return bloom.BloomConfig(
+                vocab_size=512, hidden_size=64, n_layer=2, n_head=4, **kw
+            )
+
+    def measure(cfg, batch, seq):
+        params = bloom.init_params(cfg, jax.random.PRNGKey(0))
+        opt = optax.adam(1e-4)
+        opt_state = opt.init(params)
+        ids = jnp.asarray(
+            np.random.RandomState(0).randint(0, cfg.vocab_size, (batch, seq))
+        )
+
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        def run(params, opt_state, ids):
+            def body(carry, _):
+                p, o = carry
+                loss, grads = jax.value_and_grad(bloom.loss_fn)(
+                    p, ids, None, ids, cfg
+                )
+                updates, o = opt.update(grads, o, p)
+                return (optax.apply_updates(p, updates), o), loss
+            (params, opt_state), losses = jax.lax.scan(
+                body, (params, opt_state), None, length=steps
+            )
+            return params, opt_state, losses[-1]
+
+        params, opt_state, loss = run(params, opt_state, ids)
+        loss = float(loss)  # compile+warm; fetch forces completion
+        rtt = _rtt()
+        t0 = time.perf_counter()
+        params, opt_state, loss = run(params, opt_state, ids)
+        loss = float(loss)
+        dt = max(time.perf_counter() - t0 - rtt, 1e-9)
+        tokens_per_sec = batch * seq * steps / dt
+        n_params = sum(
+            int(np.prod(p.shape)) for p in jax.tree_util.tree_leaves(params)
+        )
+        flops_per_token = 6 * n_params + 12 * cfg.n_layer * cfg.hidden_size * seq
+        mfu = tokens_per_sec * flops_per_token / _peak_flops(device_kind)
+        return {
+            "tokens_per_sec": round(tokens_per_sec, 1),
+            "mfu": round(mfu, 4), "loss": loss,
+        }
+
+    results: dict = {}
+    out = ACC / "BENCH_TPU_r05.json"
+    for name, (kw, batch, seq) in variants.items():
+        b = batch
+        while True:
+            try:
+                cfg = make_cfg(**kw)
+                results[name] = measure(cfg, b, seq)
+                results[name].update(batch=b, seq=seq)
+                break
+            except Exception as e:  # noqa: BLE001
+                if "RESOURCE_EXHAUSTED" in str(e) and b > 1:
+                    b //= 2
+                    continue
+                results[name] = {"error": f"{type(e).__name__}: {e}"[:400]}
+                break
+        ok = {k: v for k, v in results.items() if "error" not in v}
+        if ok:
+            best = max(ok, key=lambda k: ok[k]["tokens_per_sec"])
+            record = {
+                "metric": "bloom-560m train tokens/sec/chip",
+                "value": ok[best]["tokens_per_sec"],
+                "unit": "tokens/sec/chip",
+                "vs_baseline": round(ok[best]["mfu"] / 0.40, 4),
+                "mfu": ok[best]["mfu"],
+                "device": device_kind,
+                "best_variant": best,
+                "variants": results,
+                "loss": ok[best]["loss"],
+                "captured": "round 5 in-round (scripts/hw_agenda_r05.py)",
+            }
+            out.write_text(json.dumps(record, indent=1))
+        print("BENCH", name, json.dumps(results[name])[:200], flush=True)
+    return results
+
+
+# ---------------------------------------------------------------- stage 2
+
+
+def build_word_stream(full_vocab: int = 250_880):
+    """Word-level Zipfian ids over the FULL vocab range.
+
+    The repo's text corpus is tokenized into words/punctuation; word
+    frequency ranks (naturally Zipf-distributed for text) are mapped
+    through a fixed permutation of ``range(full_vocab)`` so the ids the
+    model sees span the whole 250,880-row embedding table and every
+    vocab-parallel CE shard — the distribution shape of the reference's
+    real-tokenizer protocol, reproducible with zero egress.
+    """
+    import numpy as np
+
+    parts = []
+    for pat in ("pipegoose_tpu/**/*.py", "tests/**/*.py", "docs/**/*.md",
+                "*.md", "examples/*.py", "native/*.cpp"):
+        for f in sorted(REPO.glob(pat)):
+            parts.append(f.read_text(errors="replace"))
+    text = "\n\n".join(parts)
+    words = re.findall(r"[A-Za-z_]+|[0-9]+|[^\sA-Za-z_0-9]", text)
+    from collections import Counter
+
+    by_freq = [w for w, _ in Counter(words).most_common()]
+    perm = np.random.RandomState(7).permutation(full_vocab)
+    word_to_id = {w: int(perm[r]) for r, w in enumerate(by_freq)}
+    stream = np.asarray([word_to_id[w] for w in words], dtype=np.int32)
+    return stream, len(by_freq)
+
+
+def stage_fullvocab_train(device_kind: str, steps: int = 300) -> dict:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from pipegoose_tpu.models import bloom
+
+    b, s, inner = 8, 1024, 10
+    if SMOKE:
+        b, s, inner, steps = 2, 64, 2, 4
+    stream, n_words = build_word_stream()
+    split = int(len(stream) * 0.9)
+    train_data, val_data = stream[:split], stream[split:]
+
+    cfg = (
+        bloom.BloomConfig.bloom_560m(
+            dtype=jnp.bfloat16, remat=True, use_flash=True
+        )
+        if not SMOKE
+        # smoke keeps the FULL 250,880 vocab (the point of the record)
+        # on a tiny trunk
+        else bloom.BloomConfig(
+            vocab_size=250_880, hidden_size=64, n_layer=2, n_head=4
+        )
+    )
+    params = bloom.init_params(cfg, jax.random.PRNGKey(0))
+    opt = optax.chain(
+        optax.clip_by_global_norm(1.0),
+        optax.adamw(optax.linear_schedule(0.0, 2e-4, 20), weight_decay=0.01),
+    )
+    opt_state = opt.init(params)
+
+    def batches(data, rng, n):
+        starts = rng.randint(0, len(data) - s - 1, size=(n, b))
+        return np.stack(
+            [[data[st:st + s] for st in row] for row in starts]
+        ).astype(np.int32)
+
+    rng = np.random.RandomState(0)
+    val_ids = jnp.asarray(batches(val_data, np.random.RandomState(1), 4))
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def run_chunk(params, opt_state, ids_chunk):
+        def body(carry, ids):
+            p, o = carry
+            loss, grads = jax.value_and_grad(bloom.loss_fn)(
+                p, ids, None, ids, cfg
+            )
+            updates, o = opt.update(grads, o, p)
+            return (optax.apply_updates(p, updates), o), loss
+        (params, opt_state), losses = jax.lax.scan(
+            body, (params, opt_state), ids_chunk
+        )
+        return params, opt_state, losses
+
+    @jax.jit
+    def val_loss(params, val_ids):
+        # lax.map: ONE (B,S,V) fp32 logits buffer at a time (vmap would
+        # materialize all four at once — tens of GB at V=250,880)
+        return jax.lax.map(
+            lambda ids: bloom.loss_fn(params, ids, None, ids, cfg), val_ids
+        ).mean()
+
+    n_chunks = steps // inner
+    curve = []
+    v0 = float(val_loss(params, val_ids))
+    t0 = time.perf_counter()
+    for chunk in range(n_chunks):
+        ids = jnp.asarray(batches(train_data, rng, inner))
+        params, opt_state, losses = run_chunk(params, opt_state, ids)
+        losses = np.asarray(losses, np.float64)
+        curve.append({
+            "step": (chunk + 1) * inner,
+            "train_loss": round(float(losses[-1]), 4),
+        })
+        print("TRAIN", curve[-1], flush=True)
+    dt = time.perf_counter() - t0
+    v1 = float(val_loss(params, val_ids))
+
+    record = {
+        "record": "real-hardware-full-vocab-convergence",
+        "family": "bloom",
+        "device": device_kind,
+        "model": "bloom-560m bf16+flash+remat, FULL 250,880-token vocab",
+        "tokenization": (
+            f"word-level over the repo corpus: {n_words} distinct words, "
+            "frequency ranks (Zipfian) permuted across the full "
+            "0..250,879 id range (reference protocol uses the real HF "
+            "bloom tokenizer, run_hybrid_parallel.py:83-177; no HF hub "
+            "egress here, so token STATISTICS are reproduced instead)"
+        ),
+        "distinct_ids": int(n_words),
+        "max_id_seen": int(stream.max()),
+        "batch": b, "seq": s, "steps": n_chunks * inner,
+        "val_loss_init": round(v0, 4),
+        "val_loss_final": round(v1, 4),
+        "train_curve": curve,
+        "tokens_per_sec": round(n_chunks * inner * b * s / dt, 1),
+        "note": (
+            "init loss must start near ln(250880)=12.43 (uniform over the "
+            "FULL vocab — proves the whole embedding/CE participates) and "
+            "fall toward word-level corpus entropy"
+        ),
+    }
+    (ACC / "TRAIN_TPU_r05.json").write_text(json.dumps(record, indent=1))
+    return record
+
+
+# ---------------------------------------------------------------- stage 3
+
+
+def stage_decode(device_kind: str) -> dict:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from pipegoose_tpu.models import bloom, generate as gen, mixtral
+
+    results = {}
+
+    def time_decode(run, batch, new):
+        out = run()  # compile + warm
+        rtt = _rtt()
+        t0 = time.perf_counter()
+        run()
+        dt = max(time.perf_counter() - t0 - 2 * rtt, 1e-9)
+        return {
+            "decode_tokens_per_sec": round(batch * new / dt, 1),
+            "per_sequence_tokens_per_sec": round(new / dt, 1),
+            "wall_s": round(dt, 3),
+        }
+
+    # bloom-560m (MHA + ALiBi)
+    try:
+        cfg = (
+            bloom.BloomConfig.bloom_560m(dtype=jnp.bfloat16)
+            if not SMOKE
+            else bloom.BloomConfig(
+                vocab_size=512, hidden_size=64, n_layer=2, n_head=4
+            )
+        )
+        params = bloom.init_params(cfg, jax.random.PRNGKey(0))
+        batch, prompt, new = (8, 128, 256) if not SMOKE else (2, 8, 8)
+        ids = jnp.asarray(
+            np.random.RandomState(0).randint(0, cfg.vocab_size, (batch, prompt))
+        )
+
+        def run_bloom():
+            out = gen.generate(params, ids, cfg, max_new_tokens=new)
+            np.asarray(out)
+            return out
+
+        results["bloom-560m"] = dict(
+            time_decode(run_bloom, batch, new),
+            batch=batch, prompt_len=prompt, new_tokens=new,
+            attention="MHA+ALiBi",
+        )
+        del params
+    except Exception as e:  # noqa: BLE001
+        results["bloom-560m"] = {"error": f"{type(e).__name__}: {e}"[:400]}
+    print("DECODE bloom", json.dumps(results["bloom-560m"])[:200], flush=True)
+
+    # mixtral-450m: the GQA + sliding-window + MoE cache path
+    # (VERDICT r4 next #8 — no GQA-family decode record existed)
+    try:
+        cfg = (
+            mixtral.MixtralConfig(
+                vocab_size=32000, hidden_size=1024, intermediate_size=1792,
+                n_layer=8, n_head=16, n_kv_head=4, num_experts=8, top_k=2,
+                capacity_factor=1.25, dtype=jnp.bfloat16,
+            )
+            if not SMOKE
+            else mixtral.MixtralConfig(
+                vocab_size=512, hidden_size=64, intermediate_size=96,
+                n_layer=2, n_head=4, n_kv_head=2, num_experts=2, top_k=1,
+            )
+        )
+        params = mixtral.init_params(cfg, jax.random.PRNGKey(0))
+        batch, prompt, new = (8, 128, 256) if not SMOKE else (2, 8, 8)
+        ids = jnp.asarray(
+            np.random.RandomState(0).randint(0, cfg.vocab_size, (batch, prompt))
+        )
+
+        def run_mixtral():
+            out = mixtral.generate(params, ids, cfg, max_new_tokens=new)
+            np.asarray(out)
+            return out
+
+        results["mixtral-450m-gqa"] = dict(
+            time_decode(run_mixtral, batch, new),
+            batch=batch, prompt_len=prompt, new_tokens=new,
+            attention="GQA 16q/4kv, 8 experts top-2",
+        )
+    except Exception as e:  # noqa: BLE001
+        results["mixtral-450m-gqa"] = {"error": f"{type(e).__name__}: {e}"[:400]}
+    print("DECODE mixtral", json.dumps(results["mixtral-450m-gqa"])[:200],
+          flush=True)
+
+    record = {
+        "record": "kv-cache-decode-throughput",
+        "device": device_kind,
+        "families": results,
+        "note": "greedy decode, whole generation = 1 prefill + 1 scanned "
+                "decode dispatch; tokens counted = batch * new_tokens",
+    }
+    (ACC / "DECODE_TPU_r05.json").write_text(json.dumps(record, indent=1))
+    return record
+
+
+# ----------------------------------------------------------------- driver
+
+
+def child() -> None:
+    global ACC
+    import jax
+
+    if SMOKE:
+        jax.config.update("jax_platforms", "cpu")
+        ACC = Path("/tmp/agenda_smoke")
+    dev = jax.devices()[0]
+    print("AGENDA_READY", dev.platform, flush=True)
+    if dev.platform.lower() == "cpu" and not SMOKE:
+        print("AGENDA_ABORT cpu-only", flush=True)
+        sys.exit(4)
+    device_kind = getattr(dev, "device_kind", dev.platform)
+    ACC.mkdir(parents=True, exist_ok=True)
+
+    summary = {}
+    for name, fn in (
+        ("bench", stage_bench),
+        ("fullvocab_train", stage_fullvocab_train),
+        ("decode", stage_decode),
+    ):
+        t0 = time.perf_counter()
+        try:
+            fn(device_kind)
+            summary[name] = f"ok ({time.perf_counter() - t0:.0f}s)"
+        except Exception as e:  # noqa: BLE001
+            summary[name] = f"FAILED {type(e).__name__}: {e}"[:300]
+        print("STAGE", name, summary[name], flush=True)
+    print("AGENDA_DONE", json.dumps(summary), flush=True)
+    if not any(v.startswith("ok") for v in summary.values()):
+        sys.exit(5)  # nothing captured — let the watcher retry
+
+
+def parent() -> int:
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__)],
+        env={**os.environ, "AGENDA_CHILD": "1"},
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+    )
+    ready = threading.Event()
+    done = threading.Event()
+
+    def reader():
+        for line in proc.stdout:
+            print(line.rstrip("\n"), flush=True)
+            if line.startswith("AGENDA_READY"):
+                ready.set()
+        done.set()
+
+    err_tail: list[str] = []
+
+    def err_reader():
+        for line in proc.stderr:
+            err_tail.append(line)
+            if len(err_tail) > 100:
+                del err_tail[:-100]
+
+    threading.Thread(target=reader, daemon=True).start()
+    threading.Thread(target=err_reader, daemon=True).start()
+
+    def wait_for(ev, timeout_s):
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if ev.wait(min(2.0, max(0.0, deadline - time.monotonic()))):
+                return True
+            if proc.poll() is not None:
+                return ev.wait(2.0)
+        return False
+
+    attached = wait_for(ready, ATTACH_DEADLINE_S)
+    if attached:
+        wait_for(done, RUN_DEADLINE_S)
+    if proc.poll() is None:
+        proc.terminate()  # SIGTERM only — a SIGKILLed client wedges the tunnel
+        try:
+            proc.wait(timeout=15)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+    rc = proc.wait()
+    if err_tail:
+        sys.stderr.write("".join(err_tail)[-3000:])
+    if not attached:
+        print("AGENDA: backend never attached", flush=True)
+        return 3
+    return 0 if rc == 0 else rc
+
+
+if __name__ == "__main__":
+    if os.environ.get("AGENDA_CHILD"):
+        child()
+    else:
+        sys.exit(parent())

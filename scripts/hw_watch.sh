@@ -1,0 +1,23 @@
+#!/bin/bash
+# Tunnel watcher: retry the round-5 hardware agenda until the single-
+# client axon tunnel opens (rc=3 = never attached, retryable), then run
+# it once and stop. SIGTERM-only termination throughout — a SIGKILLed
+# attached client wedges the tunnel for the whole session.
+#
+#   bash scripts/hw_watch.sh [attempts] [sleep_s]
+cd "$(dirname "$0")/.."
+ATTEMPTS=${1:-30}
+SLEEP_S=${2:-420}
+for i in $(seq 1 "$ATTEMPTS"); do
+  echo "hw_watch: attempt $i/$ATTEMPTS $(date -u +%H:%M:%S)"
+  timeout --signal=TERM 4200 python scripts/hw_agenda_r05.py
+  rc=$?
+  if [ "$rc" -eq 0 ]; then
+    echo "hw_watch: AGENDA COMPLETE"
+    exit 0
+  fi
+  echo "hw_watch: rc=$rc; sleeping ${SLEEP_S}s"
+  sleep "$SLEEP_S"
+done
+echo "hw_watch: exhausted $ATTEMPTS attempts without completing"
+exit 1
