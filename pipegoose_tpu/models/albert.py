@@ -191,12 +191,20 @@ def embed_tokens(
     config: AlbertConfig,
     tp_axis: Optional[str],
     token_type_ids: Optional[jax.Array] = None,
+    pos_offset: Optional[jax.Array] = None,
 ) -> jax.Array:
     """word (vocab-sharded) + position + token-type embeddings -> LN ->
-    the E->H projection. Returns (B, S, H)."""
+    the E->H projection. Returns (B, S, H). ``pos_offset`` (traced
+    scalar) shifts the absolute-position window — sequence sharding
+    passes ``rank * s_local`` so each chunk reads its GLOBAL positions."""
     b, s = input_ids.shape
     x = vocab_parallel_embedding(params["embed"]["word"], input_ids, tp_axis)
-    x = x + params["embed"]["pos"][None, :s]
+    pos = (
+        params["embed"]["pos"][:s]
+        if pos_offset is None
+        else jax.lax.dynamic_slice_in_dim(params["embed"]["pos"], pos_offset, s)
+    )
+    x = x + pos[None]
     tt = (
         token_type_ids
         if token_type_ids is not None
@@ -334,3 +342,272 @@ def tp_specs(params: dict, axis: str = "tensor") -> dict:
 
     mapping = tp_mapping(axis)
     return spec_tree(params, lambda path, x: mapping.spec_for(path, x.ndim))
+
+
+# -- pipeline parallel ------------------------------------------------------
+
+def uniform_stage_counts(n_layer: int, n_stages: int) -> tuple:
+    """Per-stage application counts for the SHARED layer. All albert
+    layer applications cost the same (identical params), so the
+    interval-DP partitioner's optimum IS the even split — remainder to
+    the earliest stages (they also run the cheap embed)."""
+    base, rem = divmod(n_layer, n_stages)
+    return tuple(base + (1 if i < rem else 0) for i in range(n_stages))
+
+
+def loss_fn_pp(
+    params: dict,
+    input_ids: jax.Array,
+    attention_mask: Optional[jax.Array],
+    labels: jax.Array,
+    config: AlbertConfig,
+    n_microbatches: int,
+    tp_axis: Optional[str] = None,
+    pipe_axis: str = "pipe",
+    stage_layer_counts=None,
+    label_mask: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Pipeline-parallel MLM loss for the SHARED-layer encoder.
+
+    Cross-layer parameter sharing inverts the usual PP layout: there is
+    no stacked layer stack to shard over the pipe axis — every stage
+    holds the SAME layer params (replicated) and applies them
+    ``counts[stage]`` times, so the pipeline ships only activations and
+    the per-stage "partition" is just a repetition count. Runs on the
+    same compiled GPipe runtime as the causal families
+    (nn/pipeline_parallel/pipeline.py:gpipe); uneven ``stage_layer_counts``
+    use the same lax.cond skip as masked_stage_scan.
+
+    Gradient sync: layer/head/embed params are pipe-replicated but each
+    stage produces only its own applications' grads — complete them with
+    ``grad_sync_axes=(("pipe", "sum"),)`` exactly as for bloom PP.
+    """
+    from pipegoose_tpu.nn.pipeline_parallel import microbatch as mb
+    from pipegoose_tpu.nn.pipeline_parallel.pipeline import (
+        gpipe,
+        last_stage_value,
+    )
+
+    b, s = input_ids.shape
+    if attention_mask is None:
+        attention_mask = jnp.ones((b, s), dtype=jnp.int32)
+    if label_mask is None:
+        label_mask = attention_mask
+
+    from pipegoose_tpu.nn.pipeline_parallel.partitioner import stage_n_valid
+
+    n_stages = jax.lax.axis_size(pipe_axis)
+    counts = (
+        tuple(int(c) for c in stage_layer_counts)
+        if stage_layer_counts is not None
+        else uniform_stage_counts(config.n_layer, n_stages)
+    )
+    # shared validation + traced per-stage count (len/sum check included)
+    n_valid = stage_n_valid(counts, config.n_layer, pipe_axis)
+    max_count = max(counts)
+
+    mbs = mb.split(
+        {"ids": input_ids, "mask": attention_mask, "labels": labels,
+         "lmask": label_mask},
+        n_microbatches,
+    )
+    h0 = jax.vmap(
+        lambda ids: embed_tokens(params, ids, config, tp_axis)
+    )(mbs["ids"])
+    key_bias = jax.vmap(
+        lambda m: (1.0 - m[:, None, None, :].astype(jnp.float32)) * NEG_INF
+    )(mbs["mask"])
+
+    def stage_fn(layer, h, side):
+        def body(hh, t):
+            # cond genuinely SKIPS pad applications at run time (uneven
+            # stages) — same mechanism as masked_stage_scan
+            out = jax.lax.cond(
+                t < n_valid,
+                lambda a: _layer(layer, a, side, config, tp_axis),
+                lambda a: a,
+                hh,
+            )
+            return out, None
+
+        h, _ = jax.lax.scan(body, h, jnp.arange(max_count))
+        return h
+
+    outs = gpipe(
+        stage_fn,
+        params["layer"],
+        h0,
+        side_inputs=key_bias,
+        axis_name=pipe_axis,
+        remat=config.remat,
+    )  # (M, b/M, S, H), valid on the last stage
+
+    def head_one(h, labels_mb, lmask_mb):
+        logits = logits_fn(params, h, tp_axis, eps=config.layer_norm_eps)
+        per_tok = vocab_parallel_cross_entropy(
+            logits, labels_mb, tp_axis, valid_size=config.valid_vocab_size
+        )
+        w = lmask_mb.astype(per_tok.dtype)
+        return (per_tok * w).sum(), w.sum()
+
+    tot, cnt = jax.vmap(head_one)(outs, mbs["labels"], mbs["lmask"])
+    loss_local = tot.sum() / jnp.maximum(cnt.sum(), 1)
+    return last_stage_value(loss_local, pipe_axis)
+
+
+def pp_specs(params: dict, tp_axis: str = "tensor", pipe_axis: str = "pipe") -> dict:
+    """PartitionSpecs for albert under TP x PP: identical to
+    :func:`tp_specs` — the shared layer has no stacked dim to shard
+    over ``pipe``, so every param is pipe-REPLICATED and the pipeline
+    distributes only repetition counts (see :func:`loss_fn_pp`)."""
+    del pipe_axis  # nothing shards over it — documented above
+    return tp_specs(params, tp_axis)
+
+
+# -- sequence parallel ------------------------------------------------------
+
+def _attention_sp(
+    blk: dict,
+    x: jax.Array,  # (B, S_local, H)
+    config: AlbertConfig,
+    tp_axis: Optional[str],
+    sp_axis: str,
+    pad_mask_local: jax.Array,  # (B, S_local)
+) -> jax.Array:
+    """Bidirectional attention with the sequence sharded over
+    ``sp_axis``: K/V (and the padding mask) rotate around the ring; the
+    block bias is padding-only (make_bidirectional_bias_fn — encoders
+    carry position additively in the embeddings, so no causal mask and
+    no position term in the bias). Heads shard over ``tp_axis`` exactly
+    as in the dense path."""
+    from pipegoose_tpu.nn.sequence_parallel.ring_attention import (
+        make_bidirectional_bias_fn,
+        ring_attention,
+    )
+
+    b, s_local, _ = x.shape
+    hd = config.head_dim
+    tp = jax.lax.axis_size(tp_axis) if tp_axis else 1
+    nh = config.n_head // tp
+
+    def heads(p):
+        return column_parallel_linear(p, x, tp_axis).reshape(b, s_local, nh, hd)
+
+    q, k, v = heads(blk["q"]), heads(blk["k"]), heads(blk["v"])
+    ctx = ring_attention(
+        q, k, v, sp_axis, make_bidirectional_bias_fn(), kv_side=pad_mask_local
+    )
+    ctx = ctx.astype(x.dtype).reshape(b, s_local, nh * hd)
+    proj = row_parallel_linear(blk["dense"], ctx, tp_axis)
+    return layer_norm(blk["ln"], x + proj, config.layer_norm_eps)
+
+
+def loss_fn_sp(
+    params: dict,
+    input_ids: jax.Array,  # (B, S_local) — sequence sharded over sp_axis
+    attention_mask: Optional[jax.Array],
+    labels: jax.Array,  # (B, S_local) local label chunk
+    config: AlbertConfig,
+    tp_axis: Optional[str] = None,
+    sp_axis: str = "seq",
+    label_mask: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Sequence-parallel MLM loss: activations live sequence-sharded
+    end to end; attention is the bidirectional ring. Unlike the causal
+    families no target shift crosses chunk boundaries (the MLM label
+    sits AT its position), so the head is purely local + one psum of
+    the (sum, count) pair. Position embeddings read the GLOBAL window
+    via ``pos_offset`` (global S must fit max_position_embeddings).
+
+    Grads of seq-replicated params are partial per rank — sum them over
+    ``sp_axis`` (grad_sync_axes=(("seq", "sum"),))."""
+    from pipegoose_tpu.distributed.functional import reduce_from_tensor_group
+
+    b, s_local = input_ids.shape
+    if attention_mask is None:
+        attention_mask = jnp.ones((b, s_local), dtype=jnp.int32)
+    if label_mask is None:
+        label_mask = attention_mask
+
+    sp = jax.lax.axis_size(sp_axis)
+    if sp * s_local > config.max_position_embeddings:
+        # the dense path fails loudly on this (broadcast mismatch); the
+        # dynamic position slice would CLAMP silently — wrong absolute
+        # positions with no error — so refuse at trace time instead
+        raise ValueError(
+            f"global sequence {sp}x{s_local}={sp * s_local} exceeds "
+            f"max_position_embeddings={config.max_position_embeddings}"
+        )
+    rank = jax.lax.axis_index(sp_axis)
+    x = embed_tokens(
+        params, input_ids, config, tp_axis, pos_offset=rank * s_local
+    )
+
+    def body(h, _):
+        a = _attention_sp(
+            params["layer"]["attn"], h, config, tp_axis, sp_axis,
+            attention_mask,
+        )
+        ffn = params["layer"]["ffn"]
+        hcol = column_parallel_linear(ffn["up"], a, tp_axis)
+        down = row_parallel_linear(ffn["down"], gelu_new(hcol), tp_axis)
+        return layer_norm(ffn["ln"], a + down, config.layer_norm_eps), None
+
+    step = jax.checkpoint(body) if config.remat else body
+    x, _ = jax.lax.scan(step, x, None, length=config.n_layer)
+
+    logits = logits_fn(params, x, tp_axis, eps=config.layer_norm_eps)
+    per_tok = vocab_parallel_cross_entropy(
+        logits, labels, tp_axis, valid_size=config.valid_vocab_size
+    )
+    w = label_mask.astype(per_tok.dtype)
+    count = jax.lax.psum(w.sum(), sp_axis)
+    # identity-backward combine: each rank's grads stay local and are
+    # summed over sp by the train step
+    return reduce_from_tensor_group(
+        (per_tok * w).sum() / jnp.maximum(count, 1), sp_axis
+    )
+
+
+# -- MLM-fill inference -----------------------------------------------------
+
+def fill_mask(
+    params: dict,
+    input_ids: jax.Array,  # (B, S) with mask_token_id at slots to fill
+    mask_token_id: int,
+    config: AlbertConfig,
+    attention_mask: Optional[jax.Array] = None,
+    token_type_ids: Optional[jax.Array] = None,
+    tp_axis: Optional[str] = None,
+) -> jax.Array:
+    """The encoder's inference path (HF fill-mask pipeline analog):
+    one bidirectional forward, argmax the MLM logits at every
+    ``mask_token_id`` slot, leave everything else untouched. Jittable;
+    under TP the argmax runs over the vocab-SHARDED logits (local
+    argmax + max, then a global winner pick over the gathered pairs —
+    the same trick as TP greedy decode, models/_decode.py)."""
+    logits = forward(
+        params, input_ids, attention_mask, config, tp_axis, token_type_ids
+    )
+    valid = (
+        config.valid_vocab_size
+        if config.valid_vocab_size is not None
+        else config.vocab_size
+    )
+    v_local = logits.shape[-1]
+    offset = (
+        jax.lax.axis_index(tp_axis) * v_local if tp_axis else jnp.asarray(0)
+    )
+    # mask padded vocab slots (TP divisibility padding) out of the argmax
+    cols = offset + jnp.arange(v_local)
+    logits = jnp.where(cols[None, None, :] < valid, logits, NEG_INF)
+    if tp_axis:
+        local_best = jnp.argmax(logits, -1) + offset  # (B, S) global ids
+        local_max = jnp.max(logits, -1)
+        maxes = jax.lax.all_gather(local_max, tp_axis)  # (tp, B, S)
+        bests = jax.lax.all_gather(local_best, tp_axis)
+        winner = jnp.argmax(maxes, axis=0)  # (B, S)
+        pred = jnp.take_along_axis(bests, winner[None], axis=0)[0]
+    else:
+        pred = jnp.argmax(logits, -1)
+    return jnp.where(input_ids == mask_token_id, pred, input_ids)

@@ -84,6 +84,11 @@ class BloomConfig:
     # rematerializes per chunk (nn/tensor_parallel/layers.py:
     # chunked_ce_sums). None = plain full-logits path.
     ce_chunks: Optional[int] = None
+    # fused Pallas CE (ops/fused_ce.py): the logits buffer never exists
+    # in HBM at all, forward or backward, with no chunk recompute —
+    # strictly dominates ce_chunks when the kernel is available; takes
+    # precedence over it
+    fused_ce: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -400,6 +405,26 @@ def loss_fn(
     vocab-parallel over ``tp_axis``. With ``config.ce_chunks`` the loss
     is computed chunk-by-chunk over the sequence (the full logits buffer
     never exists — see chunked_ce_sums)."""
+    if config.fused_ce:
+        from pipegoose_tpu.ops.fused_ce import fused_ce_sums
+
+        hidden = forward_hidden(params, input_ids, attention_mask, config, tp_axis)
+        b, s, hdim = hidden.shape
+        w = (
+            attention_mask[:, 1:]
+            if attention_mask is not None
+            else jnp.ones_like(labels[:, 1:])
+        ).astype(jnp.float32)
+        # final-LN output -> kernel; the tied embedding is the LM head
+        # (logits_fn without the materialized einsum)
+        tot, cnt = fused_ce_sums(
+            hidden[:, :-1].reshape(b * (s - 1), hdim),
+            params["embed"]["weight"],
+            labels[:, 1:].reshape(-1),
+            w.reshape(-1),
+            tp_axis, config.valid_vocab_size,
+        )
+        return tot / jnp.maximum(cnt, 1)
     if config.ce_chunks:
         from pipegoose_tpu.nn.tensor_parallel.layers import chunked_ce_sums
 

@@ -1,0 +1,362 @@
+"""Fused vocab-parallel cross-entropy (Pallas, TPU) — forward AND backward.
+
+The single largest HBM consumer of the bloom-560m train step is the
+(B, S, V) fp32 logits buffer (~8 GB at b8 x s1024 x v250880 —
+docs/perf_tpu_v5e.md); the chunked-CE fallback (chunked_ce_sums) bounds
+it but pays ~7% throughput for the chunk-boundary logit recompute. This
+kernel computes the loss STRAIGHT from (hidden, embedding) with an
+online log-sum-exp over vocab tiles — the full logits tensor never
+exists in HBM, forward or backward:
+
+- forward: grid (token_blocks, vocab_blocks), vocab sequential; per
+  token-block scratch carries the online (max, sumexp, target-logit)
+  triple; emits per-token local ``lse`` and ``target_logit``.
+- backward: dlogits = softmax - onehot is rematerialized tile-by-tile
+  from the saved GLOBAL lse (Megatron's analytic CE backward, reference
+  loss.py:71-89, without ever holding more than one (BT, BV) tile):
+  dhidden: grid (token_blocks, vocab_blocks), vocab sequential,
+  accumulates dlogits @ W_tile; dweight: grid (vocab_blocks,
+  token_blocks), tokens sequential, accumulates dlogits^T @ h_tile.
+
+Tensor-parallel semantics match ``vocab_parallel_cross_entropy``
+(nn/tensor_parallel/layers.py): the kernel works on the LOCAL vocab
+shard; the wrapper combines shards with a max+log-sum-exp reduction and
+a psum of the (exactly-one-shard-hit) target logit, and the hand-written
+VJP psums the hidden cotangent over the axis — the same load-bearing
+all-reduce as logits_fn's f-operator (models/bloom.py:366-373), here
+fused into the custom backward. Padded vocab slots (pad_vocab) are
+masked by GLOBAL column index against ``valid_size``.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e9
+
+
+def _resolve_interpret(interpret):
+    # same convention as ops/flash_attention.py:728-732 — None = auto
+    # (compiled on TPU, interpreter elsewhere e.g. the CPU test mesh)
+    if interpret is None:
+        return jax.default_backend() != "tpu"
+    return interpret
+
+
+def _pick_block(n: int, target: int) -> int:
+    b = target
+    while b >= 8:
+        if n % b == 0:
+            return b
+        b //= 2
+    return n
+
+
+def _fwd_pallas(h, w, targets, offset, valid, block_t, block_v, interpret):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    t_tot, hd = h.shape
+    v_loc = w.shape[0]
+    nt, nv = t_tot // block_t, v_loc // block_v
+
+    def kernel(off_ref, h_ref, w_ref, t_ref, lse_ref, tl_ref,
+               m_sc, l_sc, t_sc):
+        vi = pl.program_id(1)
+
+        @pl.when(vi == 0)
+        def _init():
+            m_sc[:] = jnp.full_like(m_sc, NEG_INF)
+            l_sc[:] = jnp.zeros_like(l_sc)
+            t_sc[:] = jnp.zeros_like(t_sc)
+
+        hb = h_ref[...].astype(jnp.float32)  # (BT, H)
+        wb = w_ref[...].astype(jnp.float32)  # (BV, H)
+        logits = jax.lax.dot_general(
+            hb, wb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (BT, BV)
+        col = off_ref[0] + vi * block_v + jax.lax.broadcasted_iota(
+            jnp.int32, (block_t, block_v), 1
+        )
+        if valid is not None:
+            logits = jnp.where(col < valid, logits, NEG_INF)
+        tb = t_ref[0]  # (BT,) int32
+        hit = tb[:, None] == col
+        t_sc[:, 0] += jnp.where(hit, logits, 0.0).sum(axis=1)
+
+        m_prev = m_sc[:, 0]
+        m_new = jnp.maximum(m_prev, logits.max(axis=1))
+        p = jnp.exp(logits - m_new[:, None])
+        l_sc[:, 0] = l_sc[:, 0] * jnp.exp(m_prev - m_new) + p.sum(axis=1)
+        m_sc[:, 0] = m_new
+
+        @pl.when(vi == nv - 1)
+        def _finish():
+            lse_ref[0] = m_sc[:, 0] + jnp.log(jnp.maximum(l_sc[:, 0], 1e-30))
+            tl_ref[0] = t_sc[:, 0]
+
+    lse, tl = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=0,
+            grid=(nt, nv),
+            in_specs=[
+                pl.BlockSpec((1,), lambda i, j: (0,),
+                             memory_space=pltpu.SMEM),
+                pl.BlockSpec((block_t, hd), lambda i, j: (i, 0)),
+                pl.BlockSpec((block_v, hd), lambda i, j: (j, 0)),
+                pl.BlockSpec((1, block_t), lambda i, j: (0, i)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, block_t), lambda i, j: (0, i)),
+                pl.BlockSpec((1, block_t), lambda i, j: (0, i)),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((block_t, 1), jnp.float32),
+                pltpu.VMEM((block_t, 1), jnp.float32),
+                pltpu.VMEM((block_t, 1), jnp.float32),
+            ],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((1, t_tot), jnp.float32),
+            jax.ShapeDtypeStruct((1, t_tot), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(offset, h, w, targets[None, :])
+    return lse[0], tl[0]
+
+
+def _dlogits_tile(hb, wb, tb, lse_b, g_b, off, vi, block_t, block_v, valid):
+    """One (BT, BV) dlogits tile: g * (softmax - onehot), rebuilt from
+    the saved global lse. Shared by the dh and dw kernels."""
+    logits = jax.lax.dot_general(
+        hb, wb, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    col = off + vi * block_v + jax.lax.broadcasted_iota(
+        jnp.int32, (block_t, block_v), 1
+    )
+    if valid is not None:
+        logits = jnp.where(col < valid, logits, NEG_INF)
+    p = jnp.exp(logits - lse_b[:, None])  # padded cols: exp(-inf) = 0
+    hit = tb[:, None] == col
+    return g_b[:, None] * (p - jnp.where(hit, 1.0, 0.0))
+
+
+def _dh_pallas(h, w, targets, lse, g, offset, valid, block_t, block_v,
+               interpret):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    t_tot, hd = h.shape
+    v_loc = w.shape[0]
+    nt, nv = t_tot // block_t, v_loc // block_v
+
+    def kernel(off_ref, h_ref, w_ref, t_ref, lse_ref, g_ref, dh_ref, dh_sc):
+        vi = pl.program_id(1)
+
+        @pl.when(vi == 0)
+        def _init():
+            dh_sc[:] = jnp.zeros_like(dh_sc)
+
+        hb = h_ref[...].astype(jnp.float32)
+        wb = w_ref[...].astype(jnp.float32)
+        dl = _dlogits_tile(
+            hb, wb, t_ref[0], lse_ref[0], g_ref[0],
+            off_ref[0], vi, block_t, block_v, valid,
+        )
+        dh_sc[:] += jax.lax.dot_general(
+            dl, wb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+        @pl.when(vi == nv - 1)
+        def _finish():
+            dh_ref[...] = dh_sc[:].astype(dh_ref.dtype)
+
+    return pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=0,
+            grid=(nt, nv),
+            in_specs=[
+                pl.BlockSpec((1,), lambda i, j: (0,),
+                             memory_space=pltpu.SMEM),
+                pl.BlockSpec((block_t, hd), lambda i, j: (i, 0)),
+                pl.BlockSpec((block_v, hd), lambda i, j: (j, 0)),
+                pl.BlockSpec((1, block_t), lambda i, j: (0, i)),
+                pl.BlockSpec((1, block_t), lambda i, j: (0, i)),
+                pl.BlockSpec((1, block_t), lambda i, j: (0, i)),
+            ],
+            out_specs=pl.BlockSpec((block_t, hd), lambda i, j: (i, 0)),
+            scratch_shapes=[pltpu.VMEM((block_t, hd), jnp.float32)],
+        ),
+        out_shape=jax.ShapeDtypeStruct(h.shape, h.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(offset, h, w, targets[None, :], lse[None, :], g[None, :])
+
+
+def _dw_pallas(h, w, targets, lse, g, offset, valid, block_t, block_v,
+               interpret):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    t_tot, hd = h.shape
+    v_loc = w.shape[0]
+    nt, nv = t_tot // block_t, v_loc // block_v
+
+    def kernel(off_ref, h_ref, w_ref, t_ref, lse_ref, g_ref, dw_ref, dw_sc):
+        ti = pl.program_id(1)
+
+        @pl.when(ti == 0)
+        def _init():
+            dw_sc[:] = jnp.zeros_like(dw_sc)
+
+        hb = h_ref[...].astype(jnp.float32)
+        wb = w_ref[...].astype(jnp.float32)
+        dl = _dlogits_tile(
+            hb, wb, t_ref[0], lse_ref[0], g_ref[0],
+            off_ref[0], pl.program_id(0), block_t, block_v, valid,
+        )
+        dw_sc[:] += jax.lax.dot_general(
+            dl, hb, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (BV, H)
+
+        @pl.when(ti == nt - 1)
+        def _finish():
+            dw_ref[...] = dw_sc[:].astype(dw_ref.dtype)
+
+    return pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=0,
+            grid=(nv, nt),
+            in_specs=[
+                pl.BlockSpec((1,), lambda j, i: (0,),
+                             memory_space=pltpu.SMEM),
+                pl.BlockSpec((block_t, hd), lambda j, i: (i, 0)),
+                pl.BlockSpec((block_v, hd), lambda j, i: (j, 0)),
+                pl.BlockSpec((1, block_t), lambda j, i: (0, i)),
+                pl.BlockSpec((1, block_t), lambda j, i: (0, i)),
+                pl.BlockSpec((1, block_t), lambda j, i: (0, i)),
+            ],
+            out_specs=pl.BlockSpec((block_v, hd), lambda j, i: (j, 0)),
+            scratch_shapes=[pltpu.VMEM((block_v, hd), jnp.float32)],
+        ),
+        out_shape=jax.ShapeDtypeStruct(w.shape, w.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(offset, h, w, targets[None, :], lse[None, :], g[None, :])
+
+
+@functools.partial(
+    jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8)
+)
+def _fused_ce(h, w, targets, token_w, axis_name, valid_size, block_t,
+              block_v, interpret):
+    out, _ = _fused_ce_fwd(
+        h, w, targets, token_w, axis_name, valid_size, block_t, block_v,
+        interpret,
+    )
+    return out
+
+
+def _shard_offset(axis_name, v_local):
+    off = jax.lax.axis_index(axis_name) * v_local if axis_name else 0
+    return jnp.asarray([off], jnp.int32)
+
+
+def _combine(lse_l, tl_l, axis_name):
+    """Local-shard (lse, target_logit) -> global: max + log-sum-exp over
+    shards for lse; the target column lives on exactly one shard (hits
+    elsewhere sum to 0), so its psum is the true pick."""
+    if not axis_name:
+        return lse_l, tl_l
+    m = jax.lax.pmax(lse_l, axis_name)
+    lse = m + jnp.log(jax.lax.psum(jnp.exp(lse_l - m), axis_name))
+    return lse, jax.lax.psum(tl_l, axis_name)
+
+
+def _fused_ce_fwd(h, w, targets, token_w, axis_name, valid_size, block_t,
+                  block_v, interpret):
+    offset = _shard_offset(axis_name, w.shape[0])
+    lse_l, tl_l = _fwd_pallas(
+        h, w, targets, offset, valid_size, block_t, block_v, interpret
+    )
+    lse, tl = _combine(lse_l, tl_l, axis_name)
+    loss_sum = ((lse - tl) * token_w).sum()
+    return (loss_sum, token_w.sum()), (h, w, targets, token_w, lse)
+
+
+def _fused_ce_bwd(axis_name, valid_size, block_t, block_v, interpret,
+                  res, cts):
+    h, w, targets, token_w, lse = res
+    ct_loss, _ = cts  # weight_sum is a non-diff count
+    g = (ct_loss * token_w).astype(jnp.float32)
+    offset = _shard_offset(axis_name, w.shape[0])
+    dh = _dh_pallas(
+        h, w, targets, lse, g, offset, valid_size, block_t, block_v,
+        interpret,
+    )
+    if axis_name:
+        # each shard's dh holds only its vocab rows' contribution; the
+        # true hidden cotangent is the sum — the f-operator all-reduce
+        # (models/bloom.py logits_fn), fused into this backward
+        dh = jax.lax.psum(dh, axis_name)
+    dw = _dw_pallas(
+        h, w, targets, lse, g, offset, valid_size, block_t, block_v,
+        interpret,
+    )
+    return dh, dw, None, None
+
+
+_fused_ce.defvjp(_fused_ce_fwd, _fused_ce_bwd)
+
+
+def fused_ce_sums(
+    hidden: jax.Array,   # (T, H) tokens already aligned with targets
+    weight: jax.Array,   # (V_local, H) (tied) embedding shard
+    targets: jax.Array,  # (T,) GLOBAL target ids
+    token_w: jax.Array,  # (T,) float weights (0 = ignored position)
+    axis_name: Optional[str] = None,
+    valid_size: Optional[int] = None,
+    block_t: int = 256,
+    block_v: int = 512,
+    interpret: Optional[bool] = None,
+):
+    """(weighted loss sum, weight sum) of the vocab-parallel CE, fused.
+
+    Same contract as chunked_ce_sums' return (callers divide), same TP
+    and padded-vocab semantics as vocab_parallel_cross_entropy — but no
+    logits buffer and no chunk recompute. Pads T up to the token block
+    (weight-0 pad tokens)."""
+    t = hidden.shape[0]
+    # token blocks stay powers of two (pad T up); vocab blocks must
+    # divide V_local (pad_vocab guarantees power-of-two-friendly shards)
+    pow2 = 8
+    while pow2 < min(t, block_t):
+        pow2 *= 2
+    block_t = min(pow2, block_t)
+    block_v = _pick_block(weight.shape[0], block_v)
+    if t % block_t:
+        pad = block_t - t % block_t
+        hidden = jnp.pad(hidden, ((0, pad), (0, 0)))
+        targets = jnp.pad(targets, (0, pad))
+        token_w = jnp.pad(token_w, (0, pad))
+    return _fused_ce(
+        hidden, weight, targets, token_w.astype(jnp.float32), axis_name,
+        valid_size, block_t, block_v, _resolve_interpret(interpret),
+    )

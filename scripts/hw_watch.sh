@@ -16,6 +16,13 @@ for i in $(seq 1 "$ATTEMPTS"); do
     echo "hw_watch: AGENDA COMPLETE"
     exit 0
   fi
+  # retry only the retryable outcomes: 3 = backend never attached,
+  # 124/143 = watchdog timeout (tunnel stalled mid-attach). Anything
+  # else is a deterministic agenda failure — stop, don't burn the round.
+  case "$rc" in
+    3|124|143) ;;
+    *) echo "hw_watch: non-retryable rc=$rc; stopping"; exit "$rc" ;;
+  esac
   echo "hw_watch: rc=$rc; sleeping ${SLEEP_S}s"
   sleep "$SLEEP_S"
 done
