@@ -123,6 +123,7 @@ def test_8x7b_sharding_covers_every_large_leaf(cfg_8x7b):
             )
 
 
+@pytest.mark.slow  # ~3.5 min AOT compile on one core
 def test_8x7b_xla_memory_analysis_v5p64(cfg_8x7b):
     """The analytic budget above trusts hand-derived activation
     arithmetic; THIS test asks XLA itself (VERDICT r4 weak #6): the real
