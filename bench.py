@@ -216,6 +216,23 @@ def run_bench(force_cpu: bool) -> None:
                 ),
                 8, 1024,
             ),
+            # fused Pallas CE (ops/fused_ce.py): the 8 GB fp32 logits
+            # buffer never exists, so no-remat has the HBM to run at
+            # full batch — the primary MFU>=0.40 candidates (round 5)
+            "noremat+flash+fusedce": (
+                bloom.BloomConfig.bloom_560m(
+                    dtype=jnp.bfloat16, remat=False, use_flash=True,
+                    fused_ce=True,
+                ),
+                8, 1024,
+            ),
+            "flash+fusedce": (
+                bloom.BloomConfig.bloom_560m(
+                    dtype=jnp.bfloat16, remat=True, use_flash=True,
+                    fused_ce=True,
+                ),
+                8, 1024,
+            ),
             "xla": (
                 bloom.BloomConfig.bloom_560m(dtype=jnp.bfloat16, remat=True),
                 8, 1024,
@@ -236,9 +253,10 @@ def run_bench(force_cpu: bool) -> None:
                 ),
                 4, 2048,
             ),
-            # LAST: b8 no-remat reproducibly kills the remote compile
-            # helper today (docs/perf_tpu_v5e.md) — keep probing in case
-            # the toolchain heals, but never at the other variants' cost
+            # LAST: b8 no-remat with full logits reproducibly killed the
+            # remote compile helper in r3 (docs/perf_tpu_v5e.md) — keep
+            # probing in case the toolchain heals, but never at the
+            # other variants' cost
             "noremat+flash+ce8": (
                 bloom.BloomConfig.bloom_560m(
                     dtype=jnp.bfloat16, remat=False, use_flash=True, ce_chunks=8

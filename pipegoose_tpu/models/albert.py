@@ -65,6 +65,9 @@ class AlbertConfig:
     initializer_range: float = 0.02
     dtype: Any = jnp.float32
     remat: bool = False
+    # fused Pallas attention (ops/flash_attention.py, causal=False):
+    # bidirectional flash — no (S, S) score materialization
+    use_flash: bool = False
     # true vocab size when the embedding was padded for TP divisibility
     valid_vocab_size: Optional[int] = None
 
@@ -159,12 +162,24 @@ def _attention(
         return column_parallel_linear(p, x, tp_axis).reshape(b, s, nh, hd)
 
     q, k, v = heads(blk["q"]), heads(blk["k"]), heads(blk["v"])
-    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
-                        preferred_element_type=jnp.float32)
-    scores = scores * (1.0 / math.sqrt(hd)) + key_bias
-    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(x.dtype)
-    ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, v,
-                     preferred_element_type=jnp.float32)
+    if config.use_flash:
+        # the flash kernel's kv_neg input IS the key-padding bias
+        # ((B, S) 0 / NEG_INF — key_bias squeezed); causal=False makes
+        # it bidirectional, no ALiBi slopes
+        from pipegoose_tpu.ops.flash_attention import flash_attention
+
+        ctx = flash_attention(
+            q, k, v, causal=False, kv_neg=key_bias[:, 0, 0, :]
+        )
+    else:
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                            preferred_element_type=jnp.float32)
+        scores = scores * (1.0 / math.sqrt(hd)) + key_bias
+        probs = jax.nn.softmax(
+            scores.astype(jnp.float32), axis=-1
+        ).astype(x.dtype)
+        ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, v,
+                         preferred_element_type=jnp.float32)
     ctx = ctx.astype(x.dtype).reshape(b, s, nh * hd)
     proj = row_parallel_linear(blk["dense"], ctx, tp_axis)
     return layer_norm(blk["ln"], x + proj, config.layer_norm_eps)

@@ -406,25 +406,15 @@ def loss_fn(
     is computed chunk-by-chunk over the sequence (the full logits buffer
     never exists — see chunked_ce_sums)."""
     if config.fused_ce:
-        from pipegoose_tpu.ops.fused_ce import fused_ce_sums
+        from pipegoose_tpu.ops.fused_ce import fused_ce_shifted_loss
 
-        hidden = forward_hidden(params, input_ids, attention_mask, config, tp_axis)
-        b, s, hdim = hidden.shape
-        w = (
-            attention_mask[:, 1:]
-            if attention_mask is not None
-            else jnp.ones_like(labels[:, 1:])
-        ).astype(jnp.float32)
         # final-LN output -> kernel; the tied embedding is the LM head
         # (logits_fn without the materialized einsum)
-        tot, cnt = fused_ce_sums(
-            hidden[:, :-1].reshape(b * (s - 1), hdim),
-            params["embed"]["weight"],
-            labels[:, 1:].reshape(-1),
-            w.reshape(-1),
+        hidden = forward_hidden(params, input_ids, attention_mask, config, tp_axis)
+        return fused_ce_shifted_loss(
+            hidden, params["embed"]["weight"], labels, attention_mask,
             tp_axis, config.valid_vocab_size,
         )
-        return tot / jnp.maximum(cnt, 1)
     if config.ce_chunks:
         from pipegoose_tpu.nn.tensor_parallel.layers import chunked_ce_sums
 

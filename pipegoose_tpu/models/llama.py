@@ -56,6 +56,8 @@ class LlamaConfig:
     # fused Pallas flash attention after RoPE; GQA served natively by
     # the kernel's grouped K/V index maps (no head repetition)
     use_flash: bool = False
+    # fused Pallas CE (ops/fused_ce.py): no logits buffer in HBM
+    fused_ce: bool = False
     valid_vocab_size: Optional[int] = None
 
     @property
@@ -175,6 +177,25 @@ def forward(params, input_ids, attention_mask, config, tp_axis=None):
 
 
 def loss_fn(params, input_ids, attention_mask, labels, config, tp_axis=None):
+    if config.fused_ce:
+        # fused Pallas CE: loss straight from (hidden, head weight) in
+        # its NATIVE layout — tied = (V/tp, H) embedding, untied =
+        # (H, V/tp) column head — no logits buffer, no transpose copy
+        # (ops/fused_ce.py; the f-operator psum lives in its VJP)
+        from pipegoose_tpu.ops.fused_ce import fused_ce_shifted_loss
+
+        hidden = forward_hidden(
+            params, input_ids, attention_mask, config, tp_axis
+        )
+        weight, layout = (
+            (params["embed"]["weight"], "vh")
+            if config.tie_word_embeddings
+            else (params["lm_head"]["kernel"], "hv")
+        )
+        return fused_ce_shifted_loss(
+            hidden, weight, labels, attention_mask, tp_axis,
+            config.valid_vocab_size, weight_layout=layout,
+        )
     logits = forward(params, input_ids, attention_mask, config, tp_axis)
     per_tok = vocab_parallel_cross_entropy(
         logits[:, :-1], labels[:, 1:], tp_axis, valid_size=config.valid_vocab_size

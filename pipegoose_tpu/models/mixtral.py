@@ -63,6 +63,8 @@ class MixtralConfig:
     # bias input; GQA served natively (grouped K/V index maps, no head
     # repetition)
     use_flash: bool = False
+    # fused Pallas CE (ops/fused_ce.py): no logits buffer in HBM
+    fused_ce: bool = False
     # set when the embedding/head was padded for TP divisibility: the
     # true vocab size; padded logit slots are masked out of CE + decode
     valid_vocab_size: Optional[int] = None
@@ -387,6 +389,23 @@ def forward(params, input_ids, attention_mask, config,
 
 def loss_fn(params, input_ids, attention_mask, labels, config,
             tp_axis=None, ep_axis=None, rng=None, train=True):
+    if config.fused_ce:
+        # fused Pallas CE on the (H, V/tp) column head in its native
+        # layout (ops/fused_ce.py, weight_layout="hv") — no logits
+        # buffer; the f-operator psum lives in the kernel's VJP
+        from pipegoose_tpu.ops.fused_ce import fused_ce_shifted_loss
+
+        hidden, aux, z = forward_hidden(
+            params, input_ids, attention_mask, config, tp_axis, ep_axis,
+            rng, train,
+        )
+        task = fused_ce_shifted_loss(
+            hidden, params["lm_head"]["kernel"], labels, attention_mask,
+            tp_axis, config.valid_vocab_size, weight_layout="hv",
+        )
+        return ExpertLoss(config.aux_loss_weight, config.z_loss_weight)(
+            task, aux.mean(), z.mean()
+        )
     logits, aux, z = forward(
         params, input_ids, attention_mask, config, tp_axis, ep_axis, rng, train
     )

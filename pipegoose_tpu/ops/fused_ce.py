@@ -55,12 +55,13 @@ def _pick_block(n: int, target: int) -> int:
     return n
 
 
-def _fwd_pallas(h, w, targets, offset, valid, block_t, block_v, interpret):
+def _fwd_pallas(h, w, targets, offset, valid, block_t, block_v, interpret,
+                vh):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     t_tot, hd = h.shape
-    v_loc = w.shape[0]
+    v_loc = w.shape[0] if vh else w.shape[1]
     nt, nv = t_tot // block_t, v_loc // block_v
 
     def kernel(off_ref, h_ref, w_ref, t_ref, lse_ref, tl_ref,
@@ -74,9 +75,9 @@ def _fwd_pallas(h, w, targets, offset, valid, block_t, block_v, interpret):
             t_sc[:] = jnp.zeros_like(t_sc)
 
         hb = h_ref[...].astype(jnp.float32)  # (BT, H)
-        wb = w_ref[...].astype(jnp.float32)  # (BV, H)
+        wb = w_ref[...].astype(jnp.float32)  # (BV, H) | (H, BV)
         logits = jax.lax.dot_general(
-            hb, wb, (((1,), (1,)), ((), ())),
+            hb, wb, (((1,), (1,) if vh else (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )  # (BT, BV)
         col = off_ref[0] + vi * block_v + jax.lax.broadcasted_iota(
@@ -108,7 +109,9 @@ def _fwd_pallas(h, w, targets, offset, valid, block_t, block_v, interpret):
                 pl.BlockSpec((1,), lambda i, j: (0,),
                              memory_space=pltpu.SMEM),
                 pl.BlockSpec((block_t, hd), lambda i, j: (i, 0)),
-                pl.BlockSpec((block_v, hd), lambda i, j: (j, 0)),
+                pl.BlockSpec((block_v, hd), lambda i, j: (j, 0))
+                if vh else
+                pl.BlockSpec((hd, block_v), lambda i, j: (0, j)),
                 pl.BlockSpec((1, block_t), lambda i, j: (0, i)),
             ],
             out_specs=[
@@ -133,11 +136,13 @@ def _fwd_pallas(h, w, targets, offset, valid, block_t, block_v, interpret):
     return lse[0], tl[0]
 
 
-def _dlogits_tile(hb, wb, tb, lse_b, g_b, off, vi, block_t, block_v, valid):
+def _dlogits_tile(hb, wb, tb, lse_b, g_b, off, vi, block_t, block_v, valid,
+                  vh=True):
     """One (BT, BV) dlogits tile: g * (softmax - onehot), rebuilt from
-    the saved global lse. Shared by the dh and dw kernels."""
+    the saved global lse. Shared by the dh and dw kernels. ``vh``: the
+    weight tile is (BV, H) (tied embedding) vs (H, BV) (untied head)."""
     logits = jax.lax.dot_general(
-        hb, wb, (((1,), (1,)), ((), ())),
+        hb, wb, (((1,), (1,) if vh else (0,)), ((), ())),
         preferred_element_type=jnp.float32,
     )
     col = off + vi * block_v + jax.lax.broadcasted_iota(
@@ -151,12 +156,12 @@ def _dlogits_tile(hb, wb, tb, lse_b, g_b, off, vi, block_t, block_v, valid):
 
 
 def _dh_pallas(h, w, targets, lse, g, offset, valid, block_t, block_v,
-               interpret):
+               interpret, vh):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     t_tot, hd = h.shape
-    v_loc = w.shape[0]
+    v_loc = w.shape[0] if vh else w.shape[1]
     nt, nv = t_tot // block_t, v_loc // block_v
 
     def kernel(off_ref, h_ref, w_ref, t_ref, lse_ref, g_ref, dh_ref, dh_sc):
@@ -170,10 +175,10 @@ def _dh_pallas(h, w, targets, lse, g, offset, valid, block_t, block_v,
         wb = w_ref[...].astype(jnp.float32)
         dl = _dlogits_tile(
             hb, wb, t_ref[0], lse_ref[0], g_ref[0],
-            off_ref[0], vi, block_t, block_v, valid,
+            off_ref[0], vi, block_t, block_v, valid, vh,
         )
         dh_sc[:] += jax.lax.dot_general(
-            dl, wb, (((1,), (0,)), ((), ())),
+            dl, wb, (((1,), (0,) if vh else (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
 
@@ -190,7 +195,9 @@ def _dh_pallas(h, w, targets, lse, g, offset, valid, block_t, block_v,
                 pl.BlockSpec((1,), lambda i, j: (0,),
                              memory_space=pltpu.SMEM),
                 pl.BlockSpec((block_t, hd), lambda i, j: (i, 0)),
-                pl.BlockSpec((block_v, hd), lambda i, j: (j, 0)),
+                pl.BlockSpec((block_v, hd), lambda i, j: (j, 0))
+                if vh else
+                pl.BlockSpec((hd, block_v), lambda i, j: (0, j)),
                 pl.BlockSpec((1, block_t), lambda i, j: (0, i)),
                 pl.BlockSpec((1, block_t), lambda i, j: (0, i)),
                 pl.BlockSpec((1, block_t), lambda i, j: (0, i)),
@@ -207,12 +214,12 @@ def _dh_pallas(h, w, targets, lse, g, offset, valid, block_t, block_v,
 
 
 def _dw_pallas(h, w, targets, lse, g, offset, valid, block_t, block_v,
-               interpret):
+               interpret, vh):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     t_tot, hd = h.shape
-    v_loc = w.shape[0]
+    v_loc = w.shape[0] if vh else w.shape[1]
     nt, nv = t_tot // block_t, v_loc // block_v
 
     def kernel(off_ref, h_ref, w_ref, t_ref, lse_ref, g_ref, dw_ref, dw_sc):
@@ -226,12 +233,18 @@ def _dw_pallas(h, w, targets, lse, g, offset, valid, block_t, block_v,
         wb = w_ref[...].astype(jnp.float32)
         dl = _dlogits_tile(
             hb, wb, t_ref[0], lse_ref[0], g_ref[0],
-            off_ref[0], pl.program_id(0), block_t, block_v, valid,
+            off_ref[0], pl.program_id(0), block_t, block_v, valid, vh,
         )
-        dw_sc[:] += jax.lax.dot_general(
-            dl, hb, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )  # (BV, H)
+        if vh:
+            dw_sc[:] += jax.lax.dot_general(
+                dl, hb, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )  # (BV, H)
+        else:
+            dw_sc[:] += jax.lax.dot_general(
+                hb, dl, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )  # (H, BV)
 
         @pl.when(ti == nt - 1)
         def _finish():
@@ -246,13 +259,19 @@ def _dw_pallas(h, w, targets, lse, g, offset, valid, block_t, block_v,
                 pl.BlockSpec((1,), lambda j, i: (0,),
                              memory_space=pltpu.SMEM),
                 pl.BlockSpec((block_t, hd), lambda j, i: (i, 0)),
-                pl.BlockSpec((block_v, hd), lambda j, i: (j, 0)),
+                pl.BlockSpec((block_v, hd), lambda j, i: (j, 0))
+                if vh else
+                pl.BlockSpec((hd, block_v), lambda j, i: (0, j)),
                 pl.BlockSpec((1, block_t), lambda j, i: (0, i)),
                 pl.BlockSpec((1, block_t), lambda j, i: (0, i)),
                 pl.BlockSpec((1, block_t), lambda j, i: (0, i)),
             ],
-            out_specs=pl.BlockSpec((block_v, hd), lambda j, i: (j, 0)),
-            scratch_shapes=[pltpu.VMEM((block_v, hd), jnp.float32)],
+            out_specs=pl.BlockSpec((block_v, hd), lambda j, i: (j, 0))
+            if vh else
+            pl.BlockSpec((hd, block_v), lambda j, i: (0, j)),
+            scratch_shapes=[pltpu.VMEM(
+                (block_v, hd) if vh else (hd, block_v), jnp.float32
+            )],
         ),
         out_shape=jax.ShapeDtypeStruct(w.shape, w.dtype),
         compiler_params=pltpu.CompilerParams(
@@ -263,13 +282,13 @@ def _dw_pallas(h, w, targets, lse, g, offset, valid, block_t, block_v,
 
 
 @functools.partial(
-    jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8)
+    jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9)
 )
 def _fused_ce(h, w, targets, token_w, axis_name, valid_size, block_t,
-              block_v, interpret):
+              block_v, interpret, vh):
     out, _ = _fused_ce_fwd(
         h, w, targets, token_w, axis_name, valid_size, block_t, block_v,
-        interpret,
+        interpret, vh,
     )
     return out
 
@@ -291,25 +310,25 @@ def _combine(lse_l, tl_l, axis_name):
 
 
 def _fused_ce_fwd(h, w, targets, token_w, axis_name, valid_size, block_t,
-                  block_v, interpret):
-    offset = _shard_offset(axis_name, w.shape[0])
+                  block_v, interpret, vh):
+    offset = _shard_offset(axis_name, w.shape[0] if vh else w.shape[1])
     lse_l, tl_l = _fwd_pallas(
-        h, w, targets, offset, valid_size, block_t, block_v, interpret
+        h, w, targets, offset, valid_size, block_t, block_v, interpret, vh
     )
     lse, tl = _combine(lse_l, tl_l, axis_name)
     loss_sum = ((lse - tl) * token_w).sum()
     return (loss_sum, token_w.sum()), (h, w, targets, token_w, lse)
 
 
-def _fused_ce_bwd(axis_name, valid_size, block_t, block_v, interpret,
+def _fused_ce_bwd(axis_name, valid_size, block_t, block_v, interpret, vh,
                   res, cts):
     h, w, targets, token_w, lse = res
     ct_loss, _ = cts  # weight_sum is a non-diff count
     g = (ct_loss * token_w).astype(jnp.float32)
-    offset = _shard_offset(axis_name, w.shape[0])
+    offset = _shard_offset(axis_name, w.shape[0] if vh else w.shape[1])
     dh = _dh_pallas(
         h, w, targets, lse, g, offset, valid_size, block_t, block_v,
-        interpret,
+        interpret, vh,
     )
     if axis_name:
         # each shard's dh holds only its vocab rows' contribution; the
@@ -318,7 +337,7 @@ def _fused_ce_bwd(axis_name, valid_size, block_t, block_v, interpret,
         dh = jax.lax.psum(dh, axis_name)
     dw = _dw_pallas(
         h, w, targets, lse, g, offset, valid_size, block_t, block_v,
-        interpret,
+        interpret, vh,
     )
     return dh, dw, None, None
 
@@ -336,13 +355,22 @@ def fused_ce_sums(
     block_t: int = 256,
     block_v: int = 512,
     interpret: Optional[bool] = None,
+    weight_layout: str = "vh",
 ):
     """(weighted loss sum, weight sum) of the vocab-parallel CE, fused.
 
     Same contract as chunked_ce_sums' return (callers divide), same TP
     and padded-vocab semantics as vocab_parallel_cross_entropy — but no
     logits buffer and no chunk recompute. Pads T up to the token block
-    (weight-0 pad tokens)."""
+    (weight-0 pad tokens).
+
+    ``weight_layout``: "vh" = (V_local, H) (bloom's tied embedding),
+    "hv" = (H, V_local) (llama/mixtral's untied column-parallel head) —
+    both read the weight in its native layout, no transpose copy."""
+    if weight_layout not in ("vh", "hv"):
+        raise ValueError(f"weight_layout must be 'vh' or 'hv', got "
+                         f"{weight_layout!r}")
+    vh = weight_layout == "vh"
     t = hidden.shape[0]
     # token blocks stay powers of two (pad T up); vocab blocks must
     # divide V_local (pad_vocab guarantees power-of-two-friendly shards)
@@ -350,7 +378,7 @@ def fused_ce_sums(
     while pow2 < min(t, block_t):
         pow2 *= 2
     block_t = min(pow2, block_t)
-    block_v = _pick_block(weight.shape[0], block_v)
+    block_v = _pick_block(weight.shape[0] if vh else weight.shape[1], block_v)
     if t % block_t:
         pad = block_t - t % block_t
         hidden = jnp.pad(hidden, ((0, pad), (0, 0)))
@@ -358,5 +386,32 @@ def fused_ce_sums(
         token_w = jnp.pad(token_w, (0, pad))
     return _fused_ce(
         hidden, weight, targets, token_w.astype(jnp.float32), axis_name,
-        valid_size, block_t, block_v, _resolve_interpret(interpret),
+        valid_size, block_t, block_v, _resolve_interpret(interpret), vh,
     )
+
+
+def fused_ce_shifted_loss(
+    hidden: jax.Array,  # (B, S, H) final-LN output
+    weight: jax.Array,
+    labels: jax.Array,  # (B, S)
+    attention_mask,     # (B, S) or None
+    axis_name: Optional[str] = None,
+    valid_size: Optional[int] = None,
+    weight_layout: str = "vh",
+) -> jax.Array:
+    """Causal-LM mean loss (shift-by-one, mask-weighted) via the fused
+    kernel — the single dispatch shared by the bloom/llama/mixtral
+    ``config.fused_ce`` paths so the shift/mask/normalize convention
+    lives in exactly one place."""
+    b, s, hd = hidden.shape
+    w = (
+        attention_mask[:, 1:]
+        if attention_mask is not None
+        else jnp.ones_like(labels[:, 1:])
+    ).astype(jnp.float32)
+    tot, cnt = fused_ce_sums(
+        hidden[:, :-1].reshape(b * (s - 1), hd), weight,
+        labels[:, 1:].reshape(-1), w.reshape(-1),
+        axis_name, valid_size, weight_layout=weight_layout,
+    )
+    return tot / jnp.maximum(cnt, 1)

@@ -191,3 +191,120 @@ def test_bloom_loss_fused_matches_default(devices):
         )
     finally:
         ctx.destroy()
+
+
+def test_fused_hv_layout_matches_vh(data):
+    """weight_layout='hv' (untied (H, V) column head) must agree with
+    'vh' on the transposed weight — value and both grads."""
+    h, w, targets, token_w = data
+
+    def loss_vh(h, w):
+        tot, cnt = fused_ce_sums(h, w, targets, token_w, interpret=True)
+        return tot / cnt
+
+    def loss_hv(h, w_t):
+        tot, cnt = fused_ce_sums(
+            h, w_t, targets, token_w, interpret=True, weight_layout="hv"
+        )
+        return tot / cnt
+
+    rl, (rdh, rdw) = jax.value_and_grad(loss_vh, argnums=(0, 1))(h, w)
+    fl, (fdh, fdwt) = jax.value_and_grad(loss_hv, argnums=(0, 1))(h, w.T)
+    assert abs(float(fl) - float(rl)) < 1e-4
+    np.testing.assert_allclose(np.asarray(fdh), np.asarray(rdh),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(fdwt.T), np.asarray(rdw),
+                               rtol=1e-4, atol=1e-5)
+    with pytest.raises(ValueError, match="weight_layout"):
+        fused_ce_sums(h, w, targets, token_w, weight_layout="hw")
+
+
+def test_llama_and_mixtral_fused_ce_match_default(devices):
+    """config.fused_ce on the untied-head families reproduces the
+    default loss (llama untied + tied; mixtral incl. aux/z)."""
+    import dataclasses
+
+    from pipegoose_tpu.models import llama, mixtral
+
+    rng = np.random.RandomState(9)
+
+    for tied in (False, True):
+        cfg = llama.LlamaConfig(
+            vocab_size=128, hidden_size=64, intermediate_size=96,
+            n_layer=2, n_head=4, n_kv_head=2, tie_word_embeddings=tied,
+        )
+        params = llama.init_params(cfg, jax.random.PRNGKey(0))
+        ids = jnp.asarray(rng.randint(0, 128, (2, 24)))
+        rl, rg = jax.value_and_grad(
+            lambda p: llama.loss_fn(p, ids, None, ids, cfg)
+        )(params)
+        cfg_f = dataclasses.replace(cfg, fused_ce=True)
+        fl, fg = jax.value_and_grad(
+            lambda p: llama.loss_fn(p, ids, None, ids, cfg_f)
+        )(params)
+        assert abs(float(fl) - float(rl)) < 1e-4, ("llama", tied)
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-3, atol=2e-5
+            ),
+            fg, rg,
+        )
+
+    mcfg = mixtral.MixtralConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=96, n_layer=2,
+        n_head=4, n_kv_head=2, num_experts=2, top_k=1, router_jitter=0.0,
+    )
+    mparams = mixtral.init_params(mcfg, jax.random.PRNGKey(1))
+    mids = jnp.asarray(rng.randint(0, 128, (2, 24)))
+    rl = float(mixtral.loss_fn(mparams, mids, None, mids, mcfg, train=False))
+    mcfg_f = dataclasses.replace(mcfg, fused_ce=True)
+    fl = float(mixtral.loss_fn(mparams, mids, None, mids, mcfg_f, train=False))
+    assert abs(fl - rl) < 1e-4, ("mixtral", fl, rl)
+
+
+def test_fused_hv_vocab_parallel_matches_dense(data, devices):
+    """hv layout under tp=4: the column-sharded (H, V/tp) head's shard
+    offset and lse/tl combine must reproduce the dense loss and grads
+    (the untied llama/mixtral TP configuration)."""
+    h, w, targets, token_w = data
+    w_hv = jnp.asarray(np.asarray(w).T)  # (H, V)
+    valid = 100
+
+    def ref_loss(h, w_hv):
+        logits = jnp.einsum("th,hv->tv", h, w_hv,
+                            preferred_element_type=jnp.float32)
+        per_tok = vocab_parallel_cross_entropy(
+            logits, targets, None, valid_size=valid
+        )
+        return (per_tok * token_w).sum() / token_w.sum()
+
+    rl, (rdh, rdw) = jax.value_and_grad(ref_loss, argnums=(0, 1))(h, w_hv)
+
+    from pipegoose_tpu.distributed import ParallelContext
+
+    ctx = ParallelContext(tensor_parallel_size=4, data_parallel_size=2)
+    try:
+        def tp_loss(h, w_hv):
+            tot, cnt = fused_ce_sums(
+                h, w_hv, targets, token_w, axis_name="tensor",
+                valid_size=valid, interpret=True, weight_layout="hv",
+            )
+            return tot / cnt
+
+        fn = jax.jit(
+            shard_map(
+                lambda h, w: jax.value_and_grad(tp_loss, argnums=(0, 1))(h, w),
+                mesh=ctx.mesh,
+                in_specs=(P(), P(None, "tensor")),
+                out_specs=(P(), (P(), P(None, "tensor"))),
+                check_vma=False,
+            )
+        )
+        fl, (fdh, fdw) = fn(h, w_hv)
+        assert abs(float(fl) - float(rl)) < 1e-4
+        np.testing.assert_allclose(np.asarray(fdh), np.asarray(rdh),
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(fdw), np.asarray(rdw),
+                                   rtol=1e-4, atol=1e-5)
+    finally:
+        ctx.destroy()
