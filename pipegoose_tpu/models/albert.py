@@ -361,6 +361,45 @@ def tp_specs(params: dict, axis: str = "tensor") -> dict:
 
 # -- pipeline parallel ------------------------------------------------------
 
+def _resolve_stage_counts(config, pipe_axis, stage_layer_counts):
+    """(traced this-stage count, static max count) — shared validation
+    via stage_n_valid (len/sum check included)."""
+    from pipegoose_tpu.nn.pipeline_parallel.partitioner import stage_n_valid
+
+    n_stages = jax.lax.axis_size(pipe_axis)
+    counts = (
+        tuple(int(c) for c in stage_layer_counts)
+        if stage_layer_counts is not None
+        else uniform_stage_counts(config.n_layer, n_stages)
+    )
+    return stage_n_valid(counts, config.n_layer, pipe_axis), max(counts)
+
+
+def _repeat_stage_fn(n_valid, max_count: int, config, tp_axis):
+    """Stage body for the SHARED-layer pipeline: apply the (replicated)
+    layer params ``n_valid`` times out of ``max_count`` slots — the
+    lax.cond genuinely SKIPS pad applications at run time (uneven
+    stages), the same mechanism as masked_stage_scan. Shared by the
+    GPipe and 1F1B runtimes."""
+
+    def stage_fn(layer, h, side):
+        key_bias = side["bias"] if isinstance(side, dict) else side
+
+        def body(hh, t):
+            out = jax.lax.cond(
+                t < n_valid,
+                lambda a: _layer(layer, a, key_bias, config, tp_axis),
+                lambda a: a,
+                hh,
+            )
+            return out, None
+
+        h, _ = jax.lax.scan(body, h, jnp.arange(max_count))
+        return h
+
+    return stage_fn
+
+
 def uniform_stage_counts(n_layer: int, n_stages: int) -> tuple:
     """Per-stage application counts for the SHARED layer. All albert
     layer applications cost the same (identical params), so the
@@ -409,17 +448,9 @@ def loss_fn_pp(
     if label_mask is None:
         label_mask = attention_mask
 
-    from pipegoose_tpu.nn.pipeline_parallel.partitioner import stage_n_valid
-
-    n_stages = jax.lax.axis_size(pipe_axis)
-    counts = (
-        tuple(int(c) for c in stage_layer_counts)
-        if stage_layer_counts is not None
-        else uniform_stage_counts(config.n_layer, n_stages)
+    n_valid, max_count = _resolve_stage_counts(
+        config, pipe_axis, stage_layer_counts
     )
-    # shared validation + traced per-stage count (len/sum check included)
-    n_valid = stage_n_valid(counts, config.n_layer, pipe_axis)
-    max_count = max(counts)
 
     mbs = mb.split(
         {"ids": input_ids, "mask": attention_mask, "labels": labels,
@@ -433,20 +464,7 @@ def loss_fn_pp(
         lambda m: (1.0 - m[:, None, None, :].astype(jnp.float32)) * NEG_INF
     )(mbs["mask"])
 
-    def stage_fn(layer, h, side):
-        def body(hh, t):
-            # cond genuinely SKIPS pad applications at run time (uneven
-            # stages) — same mechanism as masked_stage_scan
-            out = jax.lax.cond(
-                t < n_valid,
-                lambda a: _layer(layer, a, side, config, tp_axis),
-                lambda a: a,
-                hh,
-            )
-            return out, None
-
-        h, _ = jax.lax.scan(body, h, jnp.arange(max_count))
-        return h
+    stage_fn = _repeat_stage_fn(n_valid, max_count, config, tp_axis)
 
     outs = gpipe(
         stage_fn,
@@ -470,6 +488,102 @@ def loss_fn_pp(
     return last_stage_value(loss_local, pipe_axis)
 
 
+def loss_fn_1f1b(
+    params: dict,
+    input_ids: jax.Array,
+    attention_mask: Optional[jax.Array],
+    labels: jax.Array,
+    config: AlbertConfig,
+    n_microbatches: int,
+    tp_axis: Optional[str] = None,
+    pipe_axis: str = "pipe",
+    stage_layer_counts=None,
+    label_mask: Optional[jax.Array] = None,
+) -> jax.Array:
+    """1F1B (PipeDream-flush) MLM loss for the SHARED-layer encoder:
+    same value and gradients as :func:`loss_fn_pp`, peak activation
+    memory bounded by the STAGE count (nn/pipeline_parallel/pipeline.py
+    one_f_one_b). The stage body is the same repeat-scan as GPipe's;
+    the tied decoder's embedding grads merge from BOTH the embed vjp
+    (stage-0 side) and the head (last-stage side), completed — like
+    every replicated param here — by grad_sync_axes=(("pipe", "sum"),).
+    """
+    from pipegoose_tpu.nn.pipeline_parallel import microbatch as mb
+    from pipegoose_tpu.nn.pipeline_parallel.pipeline import (
+        manual_grads_loss,
+        one_f_one_b,
+    )
+
+    b, s = input_ids.shape
+    if attention_mask is None:
+        attention_mask = jnp.ones((b, s), dtype=jnp.int32)
+    if label_mask is None:
+        label_mask = attention_mask
+
+    n_valid, max_count = _resolve_stage_counts(
+        config, pipe_axis, stage_layer_counts
+    )
+    stage_fn = _repeat_stage_fn(n_valid, max_count, config, tp_axis)
+
+    mbs = mb.split(
+        {"ids": input_ids, "mask": attention_mask, "labels": labels,
+         "lmask": label_mask},
+        n_microbatches,
+    )
+    key_bias = jax.vmap(
+        lambda m: (1.0 - m[:, None, None, :].astype(jnp.float32)) * NEG_INF
+    )(mbs["mask"])
+    side = {"bias": key_bias, "labels": mbs["labels"], "lmask": mbs["lmask"]}
+
+    # per-microbatch head losses pre-normalized by the GLOBAL scored
+    # count so their plain sum equals loss_fn_pp's tot/cnt
+    inv_count = 1.0 / jnp.maximum(label_mask.sum().astype(jnp.float32), 1)
+
+    def head_fn(hp, h, side_mb):
+        logits = logits_fn(hp, h, tp_axis, eps=config.layer_norm_eps)
+        per_tok = vocab_parallel_cross_entropy(
+            logits, side_mb["labels"], tp_axis,
+            valid_size=config.valid_vocab_size,
+        )
+        w = side_mb["lmask"].astype(per_tok.dtype)
+        return ((per_tok * w).sum() * inv_count).astype(jnp.float32)
+
+    def run(params):
+        embed_params = {"embed": params["embed"], "map_in": params["map_in"]}
+        h0, embed_vjp = jax.vjp(
+            lambda ep: jax.vmap(
+                lambda ids: embed_tokens(ep, ids, config, tp_axis)
+            )(mbs["ids"]),
+            embed_params,
+        )
+        head_params = {
+            "mlm": params["mlm"],
+            "embed": {"word": params["embed"]["word"]},
+        }
+        loss_local, dh0, d_layer, d_head = one_f_one_b(
+            stage_fn, params["layer"], head_fn, head_params, h0, side,
+            pipe_axis,
+        )
+        (d_embed,) = embed_vjp(dh0)
+        n_stages = jax.lax.axis_size(pipe_axis)
+        is_last = jax.lax.axis_index(pipe_axis) == n_stages - 1
+        loss = jax.lax.psum(jnp.where(is_last, loss_local, 0.0), pipe_axis)
+        emb = dict(d_embed["embed"])
+        emb["word"] = {
+            "weight": d_embed["embed"]["word"]["weight"]
+            + d_head["embed"]["word"]["weight"]
+        }
+        grads = {
+            "embed": emb,
+            "map_in": d_embed["map_in"],
+            "layer": d_layer,
+            "mlm": d_head["mlm"],
+        }
+        return loss, grads
+
+    return manual_grads_loss(run, params)
+
+
 def pp_specs(params: dict, tp_axis: str = "tensor", pipe_axis: str = "pipe") -> dict:
     """PartitionSpecs for albert under TP x PP: identical to
     :func:`tp_specs` — the shared layer has no stacked dim to shard
@@ -488,18 +602,17 @@ def _attention_sp(
     tp_axis: Optional[str],
     sp_axis: str,
     pad_mask_local: jax.Array,  # (B, S_local)
+    variant: str = "ring",
 ) -> jax.Array:
     """Bidirectional attention with the sequence sharded over
-    ``sp_axis``: K/V (and the padding mask) rotate around the ring; the
-    block bias is padding-only (make_bidirectional_bias_fn — encoders
-    carry position additively in the embeddings, so no causal mask and
-    no position term in the bias). Heads shard over ``tp_axis`` exactly
-    as in the dense path."""
-    from pipegoose_tpu.nn.sequence_parallel.ring_attention import (
-        make_bidirectional_bias_fn,
-        ring_attention,
-    )
-
+    ``sp_axis``. ``variant="ring"``: K/V (and the padding mask) rotate
+    around the ring; the block bias is padding-only
+    (make_bidirectional_bias_fn — encoders carry position additively in
+    the embeddings, so no causal mask and no position term in the
+    bias). ``variant="ulysses"``: all_to_all head/sequence exchange,
+    full-sequence attention on nh/sp local heads — with
+    ``config.use_flash`` the fused kernel (causal=False) runs inside.
+    Heads shard over ``tp_axis`` exactly as in the dense path."""
     b, s_local, _ = x.shape
     hd = config.head_dim
     tp = jax.lax.axis_size(tp_axis) if tp_axis else 1
@@ -509,9 +622,26 @@ def _attention_sp(
         return column_parallel_linear(p, x, tp_axis).reshape(b, s_local, nh, hd)
 
     q, k, v = heads(blk["q"]), heads(blk["k"]), heads(blk["v"])
-    ctx = ring_attention(
-        q, k, v, sp_axis, make_bidirectional_bias_fn(), kv_side=pad_mask_local
-    )
+    if variant == "ulysses":
+        from pipegoose_tpu.nn.sequence_parallel.ulysses import (
+            ulysses_bidirectional_attention,
+        )
+
+        ctx = ulysses_bidirectional_attention(
+            q, k, v, sp_axis, pad_mask_local, use_flash=config.use_flash
+        )
+    elif variant == "ring":
+        from pipegoose_tpu.nn.sequence_parallel.ring_attention import (
+            make_bidirectional_bias_fn,
+            ring_attention,
+        )
+
+        ctx = ring_attention(
+            q, k, v, sp_axis, make_bidirectional_bias_fn(),
+            kv_side=pad_mask_local,
+        )
+    else:
+        raise ValueError(f"unknown SP variant {variant!r} (ring, ulysses)")
     ctx = ctx.astype(x.dtype).reshape(b, s_local, nh * hd)
     proj = row_parallel_linear(blk["dense"], ctx, tp_axis)
     return layer_norm(blk["ln"], x + proj, config.layer_norm_eps)
@@ -526,9 +656,12 @@ def loss_fn_sp(
     tp_axis: Optional[str] = None,
     sp_axis: str = "seq",
     label_mask: Optional[jax.Array] = None,
+    variant: str = "ring",
 ) -> jax.Array:
     """Sequence-parallel MLM loss: activations live sequence-sharded
-    end to end; attention is the bidirectional ring. Unlike the causal
+    end to end; attention is the bidirectional ring (or Ulysses
+    all_to_all with ``variant="ulysses"`` — see _attention_sp; flash
+    inside when config.use_flash). Unlike the causal
     families no target shift crosses chunk boundaries (the MLM label
     sits AT its position), so the head is purely local + one psum of
     the (sum, count) pair. Position embeddings read the GLOBAL window
@@ -561,7 +694,7 @@ def loss_fn_sp(
     def body(h, _):
         a = _attention_sp(
             params["layer"]["attn"], h, config, tp_axis, sp_axis,
-            attention_mask,
+            attention_mask, variant,
         )
         ffn = params["layer"]["ffn"]
         hcol = column_parallel_linear(ffn["up"], a, tp_axis)

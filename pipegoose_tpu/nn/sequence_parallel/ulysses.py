@@ -123,3 +123,57 @@ def ulysses_causal_attention(
         return ring_attention(qh, kh, vh, None, bias_fn, kv_side=side)
 
     return ulysses_attention(q, k, v, axis_name, attn_fn)
+
+
+def ulysses_bidirectional_attention(
+    q: jax.Array,  # (B, S_local, nh, hd)
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str,
+    pad_mask_local: Optional[jax.Array] = None,  # (B, S_local)
+    use_flash: bool = False,
+) -> jax.Array:
+    """Encoder (bidirectional) Ulysses attention: same all_to_all
+    head/sequence exchange, no causal mask, key-padding only. Position
+    information is additive at embedding time for encoders, so no
+    global-position plumbing is needed. With ``use_flash`` the
+    full-sequence attention on the local head subset runs the fused
+    kernel (causal=False) — the encoder's flash-under-SP path (the
+    bidirectional RING still uses dense block math)."""
+    from pipegoose_tpu.distributed.functional import all_gather
+    from pipegoose_tpu.nn.sequence_parallel.ring_attention import (
+        make_bidirectional_bias_fn,
+        ring_attention,
+    )
+
+    sp = jax.lax.axis_size(axis_name)
+    nh = q.shape[2]
+    if nh % sp:
+        raise ValueError(
+            f"ulysses needs local heads {nh} divisible by the sequence "
+            f"axis size {sp}; use the ring variant (no head constraint)"
+        )
+    full_mask = (
+        all_gather(pad_mask_local, axis_name, dim=1)
+        if pad_mask_local is not None else None
+    )
+
+    def attn_fn(qh, kh, vh):
+        if use_flash:
+            from pipegoose_tpu.ops.flash_attention import (
+                flash_attention,
+                mask_to_kv_bias,
+            )
+
+            kv_neg = (
+                mask_to_kv_bias(full_mask)[1]
+                if full_mask is not None else None
+            )
+            return flash_attention(qh, kh, vh, causal=False, kv_neg=kv_neg)
+        # single-step ring == plain bidirectional attention
+        return ring_attention(
+            qh, kh, vh, None, make_bidirectional_bias_fn(),
+            kv_side=full_mask,
+        )
+
+    return ulysses_attention(q, k, v, axis_name, attn_fn)

@@ -283,3 +283,99 @@ def test_flash_attention_matches_dense(setup):
         ),
         fg, rg,
     )
+
+
+def test_ulysses_sp_matches_dense(setup, devices):
+    """variant='ulysses' (bidirectional all_to_all head exchange), with
+    and without the flash kernel inside: loss + grads == dense."""
+    import dataclasses
+
+    cfg, params, ids, mask, lmask = setup
+    ref_loss, ref_grads = _dense_ref(cfg, params, ids, mask, lmask)
+
+    ctx = ParallelContext(sequence_parallel_size=4, data_parallel_size=2)
+    try:
+        for use_flash in (False, True):
+            cfg_v = dataclasses.replace(cfg, use_flash=use_flash)
+
+            def sp_loss(p, ids, mask, lmask):
+                loss = albert.loss_fn_sp(
+                    p, ids, mask, ids, cfg_v, sp_axis="seq",
+                    label_mask=lmask, variant="ulysses",
+                )
+                return jax.lax.pmean(loss, "data")
+
+            fn = jax.jit(
+                shard_map(
+                    lambda p, i, m, l: jax.tree_util.tree_map(
+                        lambda g: jax.lax.psum(g, "seq"),
+                        jax.value_and_grad(sp_loss)(p, i, m, l),
+                    ),
+                    mesh=ctx.mesh,
+                    in_specs=(P(), P(None, "seq"), P(None, "seq"),
+                              P(None, "seq")),
+                    out_specs=(P(), P()),
+                    check_vma=False,
+                )
+            )
+            loss, grads = fn(params, ids, mask, lmask)
+            # the loss is seq-replicated; psum over 4 ranks scales it
+            assert abs(float(loss) / 4 - float(ref_loss)) < 2e-4, use_flash
+            jax.tree_util.tree_map(
+                lambda a, b: np.testing.assert_allclose(
+                    np.asarray(a), np.asarray(b), rtol=2e-3, atol=2e-4
+                ),
+                grads, ref_grads,
+            )
+    finally:
+        ctx.destroy()
+
+
+def test_1f1b_matches_dense(setup, devices):
+    """albert.loss_fn_1f1b (shared-layer 1F1B, tied-decoder grad merge)
+    == dense loss AND grads, even and uneven stage counts."""
+    import dataclasses
+
+    cfg, params, ids, mask, lmask = setup
+    ref_loss, ref_grads = _dense_ref(cfg, params, ids, mask, lmask)
+
+    ctx = ParallelContext(pipeline_parallel_size=4, data_parallel_size=2)
+    try:
+        specs = albert.pp_specs(params)
+
+        def run(counts):
+            def pp_loss(p, ids, mask, lmask):
+                loss = albert.loss_fn_1f1b(
+                    p, ids, mask, ids, cfg, n_microbatches=2,
+                    pipe_axis="pipe", stage_layer_counts=counts,
+                    label_mask=lmask,
+                )
+                return jax.lax.pmean(loss, "data")
+
+            fn = jax.jit(
+                shard_map(
+                    lambda p, i, m, l: jax.tree_util.tree_map(
+                        lambda g: jax.lax.psum(g, "pipe"),
+                        jax.value_and_grad(pp_loss)(p, i, m, l),
+                    ),
+                    mesh=ctx.mesh,
+                    in_specs=(specs, P(), P(), P()),
+                    out_specs=(P(), specs),
+                    check_vma=False,
+                )
+            )
+            return fn(params, ids, mask, lmask)
+
+        for counts in (None, (2, 1, 1, 0)):
+            loss, grads = run(counts)
+            # loss pipe-replicated after last_stage psum; the outer psum
+            # over 4 pipe ranks scales it by 4
+            assert abs(float(loss) / 4 - float(ref_loss)) < 2e-5, counts
+            jax.tree_util.tree_map(
+                lambda a, b: np.testing.assert_allclose(
+                    np.asarray(a), np.asarray(b), rtol=2e-3, atol=2e-5
+                ),
+                grads, ref_grads,
+            )
+    finally:
+        ctx.destroy()

@@ -256,10 +256,20 @@ def test_llama_and_mixtral_fused_ce_match_default(devices):
     )
     mparams = mixtral.init_params(mcfg, jax.random.PRNGKey(1))
     mids = jnp.asarray(rng.randint(0, 128, (2, 24)))
-    rl = float(mixtral.loss_fn(mparams, mids, None, mids, mcfg, train=False))
     mcfg_f = dataclasses.replace(mcfg, fused_ce=True)
-    fl = float(mixtral.loss_fn(mparams, mids, None, mids, mcfg_f, train=False))
-    assert abs(fl - rl) < 1e-4, ("mixtral", fl, rl)
+    rl, rg = jax.value_and_grad(
+        lambda p: mixtral.loss_fn(p, mids, None, mids, mcfg, train=False)
+    )(mparams)
+    fl, fg = jax.value_and_grad(
+        lambda p: mixtral.loss_fn(p, mids, None, mids, mcfg_f, train=False)
+    )(mparams)
+    assert abs(float(fl) - float(rl)) < 1e-4, ("mixtral", fl, rl)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-3, atol=2e-5
+        ),
+        fg, rg,
+    )
 
 
 def test_fused_hv_vocab_parallel_matches_dense(data, devices):
