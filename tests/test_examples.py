@@ -22,6 +22,8 @@ CASES = [
     ("hybrid_parallelism.py", ["--fake-devices", "4", "--tp", "2", "--dp", "2"]),
     ("moe_training.py", ["--fake-devices", "8"]),
     ("long_context.py", ["--fake-devices", "8"]),
+    ("encoder_mlm.py", ["--fake-devices", "8", "--tp", "2", "--dp", "4",
+                        "--seq", "32"]),
 ]
 
 
