@@ -375,20 +375,25 @@ def _resolve_stage_counts(config, pipe_axis, stage_layer_counts):
     return stage_n_valid(counts, config.n_layer, pipe_axis), max(counts)
 
 
-def _repeat_stage_fn(n_valid, max_count: int, config, tp_axis):
+def _repeat_stage_fn(n_valid, max_count: int, config, tp_axis,
+                     layer_apply=None):
     """Stage body for the SHARED-layer pipeline: apply the (replicated)
     layer params ``n_valid`` times out of ``max_count`` slots — the
     lax.cond genuinely SKIPS pad applications at run time (uneven
     stages), the same mechanism as masked_stage_scan. Shared by the
-    GPipe and 1F1B runtimes."""
+    GPipe, 1F1B, and PP x SP runtimes; ``layer_apply(layer, h, side)``
+    overrides the dense layer body (the SP composition passes the
+    sequence-sharded one)."""
+    if layer_apply is None:
+        def layer_apply(layer, a, side):
+            key_bias = side["bias"] if isinstance(side, dict) else side
+            return _layer(layer, a, key_bias, config, tp_axis)
 
     def stage_fn(layer, h, side):
-        key_bias = side["bias"] if isinstance(side, dict) else side
-
         def body(hh, t):
             out = jax.lax.cond(
                 t < n_valid,
-                lambda a: _layer(layer, a, key_bias, config, tp_axis),
+                lambda a: layer_apply(layer, a, side),
                 lambda a: a,
                 hh,
             )
@@ -398,6 +403,17 @@ def _repeat_stage_fn(n_valid, max_count: int, config, tp_axis):
         return h
 
     return stage_fn
+
+
+def _mlm_head_sums(params, h, labels_mb, lmask_mb, config, tp_axis):
+    """(weighted CE sum, weight sum) of one microbatch's MLM head —
+    shared by the GPipe and PP x SP pipeline losses."""
+    logits = logits_fn(params, h, tp_axis, eps=config.layer_norm_eps)
+    per_tok = vocab_parallel_cross_entropy(
+        logits, labels_mb, tp_axis, valid_size=config.valid_vocab_size
+    )
+    w = lmask_mb.astype(per_tok.dtype)
+    return (per_tok * w).sum(), w.sum()
 
 
 def uniform_stage_counts(n_layer: int, n_stages: int) -> tuple:
@@ -475,15 +491,9 @@ def loss_fn_pp(
         remat=config.remat,
     )  # (M, b/M, S, H), valid on the last stage
 
-    def head_one(h, labels_mb, lmask_mb):
-        logits = logits_fn(params, h, tp_axis, eps=config.layer_norm_eps)
-        per_tok = vocab_parallel_cross_entropy(
-            logits, labels_mb, tp_axis, valid_size=config.valid_vocab_size
-        )
-        w = lmask_mb.astype(per_tok.dtype)
-        return (per_tok * w).sum(), w.sum()
-
-    tot, cnt = jax.vmap(head_one)(outs, mbs["labels"], mbs["lmask"])
+    tot, cnt = jax.vmap(
+        lambda h, l, m: _mlm_head_sums(params, h, l, m, config, tp_axis)
+    )(outs, mbs["labels"], mbs["lmask"])
     loss_local = tot.sum() / jnp.maximum(cnt.sum(), 1)
     return last_stage_value(loss_local, pipe_axis)
 
@@ -647,6 +657,18 @@ def _attention_sp(
     return layer_norm(blk["ln"], x + proj, config.layer_norm_eps)
 
 
+def _layer_sp(layer, h, config, tp_axis, sp_axis, pad_mask_local,
+              variant: str = "ring"):
+    """One ALBERT layer on sequence-sharded activations (shared by the
+    plain SP loss and the PP x SP composition)."""
+    a = _attention_sp(
+        layer["attn"], h, config, tp_axis, sp_axis, pad_mask_local, variant
+    )
+    hcol = column_parallel_linear(layer["ffn"]["up"], a, tp_axis)
+    down = row_parallel_linear(layer["ffn"]["down"], gelu_new(hcol), tp_axis)
+    return layer_norm(layer["ffn"]["ln"], a + down, config.layer_norm_eps)
+
+
 def loss_fn_sp(
     params: dict,
     input_ids: jax.Array,  # (B, S_local) — sequence sharded over sp_axis
@@ -692,14 +714,10 @@ def loss_fn_sp(
     )
 
     def body(h, _):
-        a = _attention_sp(
-            params["layer"]["attn"], h, config, tp_axis, sp_axis,
-            attention_mask, variant,
-        )
-        ffn = params["layer"]["ffn"]
-        hcol = column_parallel_linear(ffn["up"], a, tp_axis)
-        down = row_parallel_linear(ffn["down"], gelu_new(hcol), tp_axis)
-        return layer_norm(ffn["ln"], a + down, config.layer_norm_eps), None
+        return _layer_sp(
+            params["layer"], h, config, tp_axis, sp_axis, attention_mask,
+            variant,
+        ), None
 
     step = jax.checkpoint(body) if config.remat else body
     x, _ = jax.lax.scan(step, x, None, length=config.n_layer)
@@ -715,6 +733,87 @@ def loss_fn_sp(
     return reduce_from_tensor_group(
         (per_tok * w).sum() / jnp.maximum(count, 1), sp_axis
     )
+
+
+def loss_fn_pp_sp(
+    params: dict,
+    input_ids: jax.Array,  # (B, S_local) — sequence sharded over sp_axis
+    attention_mask: Optional[jax.Array],
+    labels: jax.Array,
+    config: AlbertConfig,
+    n_microbatches: int,
+    tp_axis: Optional[str] = None,
+    pipe_axis: str = "pipe",
+    sp_axis: str = "seq",
+    stage_layer_counts=None,
+    label_mask: Optional[jax.Array] = None,
+    variant: str = "ring",
+) -> jax.Array:
+    """Pipeline x sequence parallel for the SHARED-layer encoder:
+    sequence-sharded activations flow through the compiled GPipe
+    schedule while each stage repeats the one replicated layer with the
+    bidirectional ring (or Ulysses) inside — long documents AND deep
+    stacks, like bloom.loss_fn_pp_sp but with no target shift and the
+    repetition-count stages of :func:`loss_fn_pp`.
+
+    Gradient sync: ``grad_sync_axes=(("pipe", "sum"), ("seq", "sum"))``.
+    """
+    from pipegoose_tpu.distributed.functional import reduce_from_tensor_group
+    from pipegoose_tpu.nn.pipeline_parallel import microbatch as mb
+    from pipegoose_tpu.nn.pipeline_parallel.pipeline import (
+        gpipe,
+        last_stage_value,
+    )
+
+    b, s_local = input_ids.shape
+    if attention_mask is None:
+        attention_mask = jnp.ones((b, s_local), dtype=jnp.int32)
+    if label_mask is None:
+        label_mask = attention_mask
+
+    sp = jax.lax.axis_size(sp_axis)
+    if sp * s_local > config.max_position_embeddings:
+        raise ValueError(
+            f"global sequence {sp}x{s_local}={sp * s_local} exceeds "
+            f"max_position_embeddings={config.max_position_embeddings}"
+        )
+    n_valid, max_count = _resolve_stage_counts(
+        config, pipe_axis, stage_layer_counts
+    )
+
+    mbs = mb.split(
+        {"ids": input_ids, "mask": attention_mask, "labels": labels,
+         "lmask": label_mask},
+        n_microbatches,
+    )
+    rank = jax.lax.axis_index(sp_axis)
+    h0 = jax.vmap(
+        lambda ids: embed_tokens(
+            params, ids, config, tp_axis, pos_offset=rank * s_local
+        )
+    )(mbs["ids"])
+    side = {"mask": mbs["mask"]}
+
+    stage_fn = _repeat_stage_fn(
+        n_valid, max_count, config, tp_axis,
+        layer_apply=lambda layer, a, side_mb: _layer_sp(
+            layer, a, config, tp_axis, sp_axis, side_mb["mask"], variant
+        ),
+    )
+
+    outs = gpipe(
+        stage_fn, params["layer"], h0, side_inputs=side,
+        axis_name=pipe_axis, remat=config.remat,
+    )
+
+    tot, cnt = jax.vmap(
+        lambda h, l, m: _mlm_head_sums(params, h, l, m, config, tp_axis)
+    )(outs, mbs["labels"], mbs["lmask"])
+    count = jax.lax.psum(cnt.sum(), sp_axis)
+    loss_local = reduce_from_tensor_group(
+        tot.sum() / jnp.maximum(count, 1), sp_axis
+    )
+    return last_stage_value(loss_local, pipe_axis)
 
 
 # -- MLM-fill inference -----------------------------------------------------

@@ -379,3 +379,53 @@ def test_1f1b_matches_dense(setup, devices):
             )
     finally:
         ctx.destroy()
+
+
+def test_pp_sp_composition_matches_dense(setup, devices):
+    """pipe=2 x seq=2 x data=2: sequence-sharded activations through the
+    shared-layer pipeline with the ring inside each stage — loss AND
+    grads == dense (grads completed over BOTH pipe and seq)."""
+    cfg, params, ids, mask, lmask = setup
+    ref_loss, ref_grads = _dense_ref(cfg, params, ids, mask, lmask)
+
+    ctx = ParallelContext(
+        pipeline_parallel_size=2, sequence_parallel_size=2,
+        data_parallel_size=2,
+    )
+    try:
+        specs = albert.pp_specs(params)
+
+        def pp_sp_loss(p, ids, mask, lmask):
+            loss = albert.loss_fn_pp_sp(
+                p, ids, mask, ids, cfg, n_microbatches=2, pipe_axis="pipe",
+                sp_axis="seq", label_mask=lmask,
+            )
+            return jax.lax.pmean(loss, "data")
+
+        def value_and_synced_grads(p, ids, mask, lmask):
+            loss, grads = jax.value_and_grad(pp_sp_loss)(p, ids, mask, lmask)
+            grads = jax.tree_util.tree_map(
+                lambda g: jax.lax.psum(jax.lax.psum(g, "pipe"), "seq"), grads
+            )
+            return loss, grads
+
+        fn = jax.jit(
+            shard_map(
+                value_and_synced_grads,
+                mesh=ctx.mesh,
+                in_specs=(specs, P(None, "seq"), P(None, "seq"),
+                          P(None, "seq")),
+                out_specs=(P(), specs),
+                check_vma=False,
+            )
+        )
+        loss, grads = fn(params, ids, mask, lmask)
+        assert abs(float(loss) - float(ref_loss)) < 2e-5
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-3, atol=2e-5
+            ),
+            grads, ref_grads,
+        )
+    finally:
+        ctx.destroy()
