@@ -897,12 +897,20 @@ def _sp_head_sums(params, x, attention_mask, labels, config, tp_axis, sp_axis):
     from pipegoose_tpu.nn.sequence_parallel.targets import sp_shifted_targets
 
     x = layer_norm(params["ln_f"], x, config.layer_norm_epsilon)
-    logits = logits_fn(params, x, tp_axis)  # (B, S_local, V/tp)
-
     shifted_labels, shifted_w = sp_shifted_targets(
         labels, attention_mask, sp_axis
     )
+    if config.fused_ce:
+        # the local (B, S_local, V) fp32 logits buffer is the tensor
+        # that explodes at exactly the long-context shapes SP serves —
+        # the fused kernel never materializes it
+        from pipegoose_tpu.ops.fused_ce import fused_ce_masked_sums
 
+        return fused_ce_masked_sums(
+            x, params["embed"]["weight"], shifted_labels, shifted_w,
+            tp_axis, config.valid_vocab_size,
+        )
+    logits = logits_fn(params, x, tp_axis)  # (B, S_local, V/tp)
     per_tok = vocab_parallel_cross_entropy(
         logits, shifted_labels, tp_axis, valid_size=config.valid_vocab_size
     )

@@ -176,6 +176,17 @@ def forward(params, input_ids, attention_mask, config, tp_axis=None):
     return logits_fn(params, hidden, config, tp_axis)
 
 
+def _head_weight_layout(params, config):
+    """(weight, fused-CE layout) of the LM head in its native form:
+    tied = the (V/tp, H) vocab-sharded embedding, untied = the
+    (H, V/tp) column-parallel kernel."""
+    return (
+        (params["embed"]["weight"], "vh")
+        if config.tie_word_embeddings
+        else (params["lm_head"]["kernel"], "hv")
+    )
+
+
 def loss_fn(params, input_ids, attention_mask, labels, config, tp_axis=None):
     if config.fused_ce:
         # fused Pallas CE: loss straight from (hidden, head weight) in
@@ -187,11 +198,7 @@ def loss_fn(params, input_ids, attention_mask, labels, config, tp_axis=None):
         hidden = forward_hidden(
             params, input_ids, attention_mask, config, tp_axis
         )
-        weight, layout = (
-            (params["embed"]["weight"], "vh")
-            if config.tie_word_embeddings
-            else (params["lm_head"]["kernel"], "hv")
-        )
+        weight, layout = _head_weight_layout(params, config)
         return fused_ce_shifted_loss(
             hidden, weight, labels, attention_mask, tp_axis,
             config.valid_vocab_size, weight_layout=layout,
@@ -451,18 +458,28 @@ def loss_fn_sp(
     x, _ = jax.lax.scan(step, x, params["blocks"])
 
     x = rms_norm(params["ln_f"], x, config.rms_eps)
-    logits = logits_fn(params, x, config, tp_axis)
-
     shifted_labels, shifted_w = sp_shifted_targets(
         labels, attention_mask, sp_axis
     )
-    per_tok = vocab_parallel_cross_entropy(
-        logits, shifted_labels, tp_axis, valid_size=config.valid_vocab_size
-    )
-    w = shifted_w.astype(per_tok.dtype)
-    count = jax.lax.psum(w.sum(), sp_axis)
+    if config.fused_ce:
+        from pipegoose_tpu.ops.fused_ce import fused_ce_masked_sums
+
+        weight, layout = _head_weight_layout(params, config)
+        tot, cnt = fused_ce_masked_sums(
+            x, weight, shifted_labels, shifted_w, tp_axis,
+            config.valid_vocab_size, weight_layout=layout,
+        )
+    else:
+        logits = logits_fn(params, x, config, tp_axis)
+        per_tok = vocab_parallel_cross_entropy(
+            logits, shifted_labels, tp_axis,
+            valid_size=config.valid_vocab_size,
+        )
+        w = shifted_w.astype(per_tok.dtype)
+        tot, cnt = (per_tok * w).sum(), w.sum()
+    count = jax.lax.psum(cnt, sp_axis)
     return reduce_from_tensor_group(
-        (per_tok * w).sum() / jnp.maximum(count, 1), sp_axis
+        tot / jnp.maximum(count, 1), sp_axis
     )
 
 

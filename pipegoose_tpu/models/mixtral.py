@@ -988,20 +988,29 @@ def loss_fn_sp(
     x, (aux, z) = jax.lax.scan(step, x, (params["blocks"], layer_keys))
 
     x = rms_norm(params["ln_f"], x, config.rms_eps)
-    logits = column_parallel_linear(params["lm_head"], x, tp_axis)
-
     shifted_labels, shifted_w = sp_shifted_targets(
         labels, attention_mask, sp_axis
     )
-    per_tok = vocab_parallel_cross_entropy(
-        logits, shifted_labels, tp_axis, valid_size=config.valid_vocab_size
-    )
-    w = shifted_w.astype(per_tok.dtype)
-    count = jax.lax.psum(w.sum(), sp_axis)
+    if config.fused_ce:
+        from pipegoose_tpu.ops.fused_ce import fused_ce_masked_sums
+
+        tot, cnt = fused_ce_masked_sums(
+            x, params["lm_head"]["kernel"], shifted_labels, shifted_w,
+            tp_axis, config.valid_vocab_size, weight_layout="hv",
+        )
+    else:
+        logits = column_parallel_linear(params["lm_head"], x, tp_axis)
+        per_tok = vocab_parallel_cross_entropy(
+            logits, shifted_labels, tp_axis,
+            valid_size=config.valid_vocab_size,
+        )
+        w = shifted_w.astype(per_tok.dtype)
+        tot, cnt = (per_tok * w).sum(), w.sum()
+    count = jax.lax.psum(cnt, sp_axis)
     # identity-backward combines: values become global means, gradients
     # stay local (summed later by grad_sync_axes)
     task = reduce_from_tensor_group(
-        (per_tok * w).sum() / jnp.maximum(count, 1), sp_axis
+        tot / jnp.maximum(count, 1), sp_axis
     )
     sp = jax.lax.axis_size(sp_axis)
     aux_t = reduce_from_tensor_group(aux.mean() / sp, sp_axis)

@@ -415,3 +415,25 @@ def fused_ce_shifted_loss(
         axis_name, valid_size, weight_layout=weight_layout,
     )
     return tot / jnp.maximum(cnt, 1)
+
+
+def fused_ce_masked_sums(
+    hidden: jax.Array,   # (B, S, H) — targets ALREADY aligned (no shift)
+    weight: jax.Array,
+    labels: jax.Array,   # (B, S)
+    weights: jax.Array,  # (B, S) float mask
+    axis_name: Optional[str] = None,
+    valid_size: Optional[int] = None,
+    weight_layout: str = "vh",
+):
+    """(weighted loss sum, weight sum) over pre-aligned positions — the
+    sequence-parallel head adapter: under SP the shift-by-one already
+    happened globally (nn/sequence_parallel/targets.py), and the local
+    (B, S_local, V) logits buffer this replaces is exactly the tensor
+    that explodes at the long-context shapes SP exists for."""
+    b, s, hd = hidden.shape
+    return fused_ce_sums(
+        hidden.reshape(b * s, hd), weight, labels.reshape(-1),
+        weights.reshape(-1).astype(jnp.float32), axis_name, valid_size,
+        weight_layout=weight_layout,
+    )

@@ -168,6 +168,69 @@ def model_sweep():
     print(json.dumps(results))
 
 
+def fusedce_sweep():
+    """Fused-CE block sizes + A/B vs the materialized-logits CE at the
+    bench head shape (T = 8x1024 tokens, H=1024, V=250880)."""
+    from pipegoose_tpu.ops import fused_ce as fc
+
+    t, h, v = 8 * 1024, 1024, 250_880
+    key = jax.random.PRNGKey(0)
+    kh, kw = jax.random.split(key)
+    hid = jax.random.normal(kh, (t, h), jnp.bfloat16) * 0.3
+    w = jax.random.normal(kw, (v, h), jnp.bfloat16) * 0.02
+    targets = jnp.asarray(np.random.RandomState(0).randint(0, v, (t,)))
+    token_w = jnp.ones((t,), jnp.float32)
+
+    results = {}
+
+    def xla_ce(hid, w):
+        logits = jnp.einsum(
+            "th,vh->tv", hid.astype(jnp.float32), w.astype(jnp.float32)
+        )
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        pred = jnp.take_along_axis(logits, targets[:, None], -1)[:, 0]
+        return ((lse - pred) * token_w).sum() / token_w.sum()
+
+    def timed_grad(loss_fn, label):
+        g = jax.jit(jax.value_and_grad(loss_fn, argnums=(0, 1)))
+        out = g(hid, w)
+        float(out[0])  # compile+warm; fetch forces completion
+        rtt = measure_rtt()
+        t0 = time.perf_counter()
+        out = g(hid, w)
+        float(out[0])
+        ms = max(time.perf_counter() - t0 - rtt, 1e-9) * 1e3
+        results[label] = {"fwd_bwd_ms": round(ms, 2)}
+        print(label, json.dumps(results[label]), flush=True)
+
+    try:
+        timed_grad(xla_ce, "xla_full_logits")
+    except Exception as e:  # noqa: BLE001
+        results["xla_full_logits"] = {"error": f"{type(e).__name__}: {e}"[:200]}
+        print("xla_full_logits", json.dumps(results["xla_full_logits"]),
+              flush=True)
+
+    for bt in (128, 256, 512):
+        for bv in (256, 512, 1024):
+            label = f"fused_bt{bt}_bv{bv}"
+            try:
+                def fl(hid, w, _bt=bt, _bv=bv):
+                    tot, cnt = fc.fused_ce_sums(
+                        hid, w, targets, token_w, block_t=_bt, block_v=_bv,
+                        interpret=False,
+                    )
+                    return tot / cnt
+                timed_grad(fl, label)
+            except Exception as e:  # noqa: BLE001
+                results[label] = {"error": f"{type(e).__name__}: {e}"[:200]}
+                print(label, json.dumps(results[label]), flush=True)
+    print(json.dumps(results))
+
+
 if __name__ == "__main__":
     mode = sys.argv[1] if len(sys.argv) > 1 else "kernel"
-    (kernel_sweep if mode == "kernel" else model_sweep)()
+    modes = {"kernel": kernel_sweep, "model": model_sweep,
+             "fusedce": fusedce_sweep}
+    if mode not in modes:
+        raise SystemExit(f"unknown mode {mode!r}; pick one of {sorted(modes)}")
+    modes[mode]()

@@ -264,6 +264,57 @@ def time_ab(b=8, s=2048, nh=16, hd=64, dtype=jnp.bfloat16, iters=20):
     return res
 
 
+def check_fused_ce(layout="vh", t=1024, h=1024, v=250_880,
+                   dtype=jnp.bfloat16):
+    """Fused vocab CE (ops/fused_ce.py) COMPILED at the real bench
+    vocab: loss + both grads vs the materialized-logits reference.
+    ``layout``: vh = tied (V,H) embedding, hv = untied (H,V) head."""
+    from pipegoose_tpu.ops.fused_ce import fused_ce_sums
+
+    key = jax.random.PRNGKey(2)
+    kh, kw = jax.random.split(key)
+    hid = jax.random.normal(kh, (t, h), dtype) * 0.3
+    w = jax.random.normal(
+        kw, (v, h) if layout == "vh" else (h, v), dtype
+    ) * 0.02
+    targets = jnp.asarray(np.random.RandomState(0).randint(0, v, (t,)))
+    token_w = jnp.asarray(
+        (np.random.RandomState(1).rand(t) < 0.9).astype(np.float32)
+    )
+
+    def fused_loss(hid, w):
+        tot, cnt = fused_ce_sums(
+            hid, w, targets, token_w, interpret=False, weight_layout=layout
+        )
+        return tot / cnt
+
+    def ref_loss(hid, w):
+        hid32 = hid.astype(jnp.float32)
+        w32 = w.astype(jnp.float32)
+        eq = "th,vh->tv" if layout == "vh" else "th,hv->tv"
+        logits = jnp.einsum(eq, hid32, w32)
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        pred = jnp.take_along_axis(logits, targets[:, None], axis=-1)[:, 0]
+        per = lse - pred
+        return (per * token_w).sum() / token_w.sum()
+
+    fl, (fdh, fdw) = jax.jit(
+        jax.value_and_grad(fused_loss, argnums=(0, 1))
+    )(hid, w)
+    rl, (rdh, rdw) = jax.jit(
+        jax.value_and_grad(ref_loss, argnums=(0, 1))
+    )(hid, w)
+    jax.block_until_ready((fl, fdh, fdw, rl, rdh, rdw))
+    errs = {
+        "loss": abs(float(fl) - float(rl)) / max(abs(float(rl)), 1e-6),
+        "dh": rel_err(fdh, rdh),
+        "dw": rel_err(fdw, rdw),
+    }
+    ok = all(e < 2.5e-2 for e in errs.values())
+    return {"variant": f"fused-ce-{layout}", "ok": ok, "max_rel_err": errs,
+            "shape": {"t": t, "h": h, "v": v}}
+
+
 def main():
     out_path = sys.argv[1] if len(sys.argv) > 1 else "docs/acceptance/KERNELS_TPU_r03.json"
     dev = jax.devices()[0]
@@ -304,6 +355,17 @@ def main():
     r["wall_s"] = round(time.perf_counter() - t0, 1)
     record["variants"].append(r)
     print(json.dumps(r), flush=True)
+
+    for layout in ("vh", "hv"):
+        t0 = time.perf_counter()
+        try:
+            r = check_fused_ce(layout)
+        except Exception as e:  # noqa: BLE001
+            r = {"variant": f"fused-ce-{layout}", "ok": False,
+                 "error": f"{type(e).__name__}: {e}"[:400]}
+        r["wall_s"] = round(time.perf_counter() - t0, 1)
+        record["variants"].append(r)
+        print(json.dumps(r), flush=True)
 
     try:
         record["timing"] = time_ab()

@@ -318,3 +318,57 @@ def test_fused_hv_vocab_parallel_matches_dense(data, devices):
                                    rtol=1e-4, atol=1e-5)
     finally:
         ctx.destroy()
+
+
+def test_sp_heads_fused_ce_match_default(devices):
+    """config.fused_ce in the SEQUENCE-PARALLEL heads (bloom tied-vh,
+    llama untied-hv, mixtral hv): SP loss with the fused kernel ==
+    SP loss with materialized logits, ragged mask included. This is the
+    long-context configuration where the (B, S_local, V) buffer is the
+    thing that OOMs."""
+    import dataclasses
+
+    from pipegoose_tpu.distributed import ParallelContext
+    from pipegoose_tpu.models import bloom, llama, mixtral
+
+    rng = np.random.RandomState(11)
+    ids = jnp.asarray(rng.randint(0, 128, (2, 32)))
+    mask = np.ones((2, 32), np.int32)
+    mask[1, 28:] = 0
+    mask = jnp.asarray(mask)
+
+    cases = [
+        ("bloom", bloom, bloom.BloomConfig(
+            vocab_size=128, hidden_size=64, n_layer=2, n_head=4), {}),
+        ("llama", llama, llama.LlamaConfig(
+            vocab_size=128, hidden_size=64, intermediate_size=96,
+            n_layer=2, n_head=4, n_kv_head=2), {}),
+        ("mixtral", mixtral, mixtral.MixtralConfig(
+            vocab_size=128, hidden_size=64, intermediate_size=96,
+            n_layer=2, n_head=4, n_kv_head=2, num_experts=2, top_k=1,
+            router_jitter=0.0), {"train": False}),
+    ]
+    ctx = ParallelContext(sequence_parallel_size=4, data_parallel_size=2)
+    try:
+        for name, mod, cfg, kw in cases:
+            params = mod.init_params(cfg, jax.random.PRNGKey(0))
+            cfg_f = dataclasses.replace(cfg, fused_ce=True)
+
+            def run(c):
+                fn = jax.jit(
+                    shard_map(
+                        lambda p, i, m: mod.loss_fn_sp(
+                            p, i, m, i, c, sp_axis="seq", **kw
+                        ),
+                        mesh=ctx.mesh,
+                        in_specs=(P(), P(None, "seq"), P(None, "seq")),
+                        out_specs=P(),
+                        check_vma=False,
+                    )
+                )
+                return float(fn(params, ids, mask))
+
+            ref, fused = run(cfg), run(cfg_f)
+            assert abs(fused - ref) < 1e-4, (name, fused, ref)
+    finally:
+        ctx.destroy()
