@@ -591,6 +591,15 @@ def loss_fn_pp(
     # vectorized head over all microbatches
     def head_one(h, ids, mask, labels):
         h = layer_norm(params["ln_f"], h, config.layer_norm_epsilon)
+        if config.fused_ce:
+            # the LAST stage's per-microbatch logits buffer is the PP
+            # step's largest tensor — the fused kernel never builds it
+            from pipegoose_tpu.ops.fused_ce import fused_ce_shifted_sums
+
+            return fused_ce_shifted_sums(
+                h, params["embed"]["weight"], labels, mask, tp_axis,
+                config.valid_vocab_size,
+            )
         logits = logits_fn(params, h, tp_axis)
         per_tok = vocab_parallel_cross_entropy(
             logits[:, :-1], labels[:, 1:], tp_axis, valid_size=config.valid_vocab_size
@@ -680,6 +689,14 @@ def loss_fn_1f1b(
 
     def head_fn(hp, h, side):
         h = layer_norm(hp["ln_f"], h, config.layer_norm_epsilon)
+        if config.fused_ce:
+            from pipegoose_tpu.ops.fused_ce import fused_ce_shifted_sums
+
+            tot, _ = fused_ce_shifted_sums(
+                h, hp["embed"]["weight"], side["labels"], side["mask"],
+                tp_axis, config.valid_vocab_size,
+            )
+            return (tot * inv_count).astype(jnp.float32)
         logits = logits_fn({"embed": hp["embed"]}, h, tp_axis)
         per_tok = vocab_parallel_cross_entropy(
             logits[:, :-1], side["labels"][:, 1:], tp_axis,

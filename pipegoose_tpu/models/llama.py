@@ -269,6 +269,14 @@ def loss_fn_pp(
 
     def head_one(h, mask, labels):
         h = rms_norm(params["ln_f"], h, config.rms_eps)
+        if config.fused_ce:
+            from pipegoose_tpu.ops.fused_ce import fused_ce_shifted_sums
+
+            weight, layout = _head_weight_layout(params, config)
+            return fused_ce_shifted_sums(
+                h, weight, labels, mask, tp_axis,
+                config.valid_vocab_size, weight_layout=layout,
+            )
         logits = logits_fn(params, h, config, tp_axis)
         per_tok = vocab_parallel_cross_entropy(
             logits[:, :-1], labels[:, 1:], tp_axis, valid_size=config.valid_vocab_size
@@ -341,6 +349,15 @@ def loss_fn_1f1b(
 
     def head_fn(hp, h, side):
         h = rms_norm(hp["ln_f"], h, config.rms_eps)
+        if config.fused_ce:
+            from pipegoose_tpu.ops.fused_ce import fused_ce_shifted_sums
+
+            weight, layout = _head_weight_layout(hp, config)
+            tot, _ = fused_ce_shifted_sums(
+                h, weight, side["labels"], side["mask"], tp_axis,
+                config.valid_vocab_size, weight_layout=layout,
+            )
+            return (tot * inv_count).astype(jnp.float32)
         logits = logits_fn(hp, h, config, tp_axis)
         per_tok = vocab_parallel_cross_entropy(
             logits[:, :-1], side["labels"][:, 1:], tp_axis,

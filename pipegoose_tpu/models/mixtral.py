@@ -602,6 +602,13 @@ def loss_fn_pp(
 
     def head_one(h, mask, labels):
         h = rms_norm(params["ln_f"], h, config.rms_eps)
+        if config.fused_ce:
+            from pipegoose_tpu.ops.fused_ce import fused_ce_shifted_sums
+
+            return fused_ce_shifted_sums(
+                h, params["lm_head"]["kernel"], labels, mask, tp_axis,
+                config.valid_vocab_size, weight_layout="hv",
+            )
         logits = column_parallel_linear(params["lm_head"], h, tp_axis)
         per_tok = vocab_parallel_cross_entropy(
             logits[:, :-1], labels[:, 1:], tp_axis, valid_size=config.valid_vocab_size
@@ -699,6 +706,14 @@ def loss_fn_1f1b(
 
     def head_fn(hp, h, side):
         h = rms_norm(hp["ln_f"], h, config.rms_eps)
+        if config.fused_ce:
+            from pipegoose_tpu.ops.fused_ce import fused_ce_shifted_sums
+
+            tot, _ = fused_ce_shifted_sums(
+                h, hp["lm_head"]["kernel"], side["labels"], side["mask"],
+                tp_axis, config.valid_vocab_size, weight_layout="hv",
+            )
+            return (tot * inv_count).astype(jnp.float32)
         logits = column_parallel_linear(hp["lm_head"], h, tp_axis)
         per_tok = vocab_parallel_cross_entropy(
             logits[:, :-1], side["labels"][:, 1:], tp_axis,
@@ -1110,8 +1125,15 @@ def loss_fn_pp_sp(
 
     def head_one(h, mask_mb, labels_mb):
         h = rms_norm(params["ln_f"], h, config.rms_eps)
-        logits = column_parallel_linear(params["lm_head"], h, tp_axis)
         sl, sw = sp_shifted_targets(labels_mb, mask_mb, sp_axis)
+        if config.fused_ce:
+            from pipegoose_tpu.ops.fused_ce import fused_ce_masked_sums
+
+            return fused_ce_masked_sums(
+                h, params["lm_head"]["kernel"], sl, sw, tp_axis,
+                config.valid_vocab_size, weight_layout="hv",
+            )
+        logits = column_parallel_linear(params["lm_head"], h, tp_axis)
         per_tok = vocab_parallel_cross_entropy(
             logits, sl, tp_axis, valid_size=config.valid_vocab_size
         )

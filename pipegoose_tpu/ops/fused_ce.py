@@ -390,6 +390,31 @@ def fused_ce_sums(
     )
 
 
+def fused_ce_shifted_sums(
+    hidden: jax.Array,  # (B, S, H) final-LN output
+    weight: jax.Array,
+    labels: jax.Array,  # (B, S)
+    attention_mask,     # (B, S) or None
+    axis_name: Optional[str] = None,
+    valid_size: Optional[int] = None,
+    weight_layout: str = "vh",
+):
+    """Shift-by-one causal-LM (weighted loss sum, weight sum) via the
+    fused kernel — the shared convention for the dense losses AND the
+    pipeline heads (which combine per-microbatch sums themselves)."""
+    b, s, hd = hidden.shape
+    w = (
+        attention_mask[:, 1:]
+        if attention_mask is not None
+        else jnp.ones_like(labels[:, 1:])
+    ).astype(jnp.float32)
+    return fused_ce_sums(
+        hidden[:, :-1].reshape(b * (s - 1), hd), weight,
+        labels[:, 1:].reshape(-1), w.reshape(-1),
+        axis_name, valid_size, weight_layout=weight_layout,
+    )
+
+
 def fused_ce_shifted_loss(
     hidden: jax.Array,  # (B, S, H) final-LN output
     weight: jax.Array,
@@ -403,16 +428,9 @@ def fused_ce_shifted_loss(
     kernel — the single dispatch shared by the bloom/llama/mixtral
     ``config.fused_ce`` paths so the shift/mask/normalize convention
     lives in exactly one place."""
-    b, s, hd = hidden.shape
-    w = (
-        attention_mask[:, 1:]
-        if attention_mask is not None
-        else jnp.ones_like(labels[:, 1:])
-    ).astype(jnp.float32)
-    tot, cnt = fused_ce_sums(
-        hidden[:, :-1].reshape(b * (s - 1), hd), weight,
-        labels[:, 1:].reshape(-1), w.reshape(-1),
-        axis_name, valid_size, weight_layout=weight_layout,
+    tot, cnt = fused_ce_shifted_sums(
+        hidden, weight, labels, attention_mask, axis_name, valid_size,
+        weight_layout,
     )
     return tot / jnp.maximum(cnt, 1)
 

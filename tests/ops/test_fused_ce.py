@@ -372,3 +372,57 @@ def test_sp_heads_fused_ce_match_default(devices):
             assert abs(fused - ref) < 1e-4, (name, fused, ref)
     finally:
         ctx.destroy()
+
+
+def test_pp_heads_fused_ce_match_default(devices):
+    """config.fused_ce in the PIPELINE heads (GPipe + 1F1B): the last
+    stage's per-microbatch logits buffer — the PP step's largest
+    tensor — replaced by the fused kernel with identical loss."""
+    import dataclasses
+
+    from pipegoose_tpu.distributed import ParallelContext
+    from pipegoose_tpu.models import bloom, llama, mixtral
+
+    rng = np.random.RandomState(13)
+    ids = jnp.asarray(rng.randint(0, 128, (4, 16)))
+
+    cases = [
+        ("bloom", bloom, bloom.BloomConfig(
+            vocab_size=128, hidden_size=64, n_layer=4, n_head=4), {}),
+        ("llama", llama, llama.LlamaConfig(
+            vocab_size=128, hidden_size=64, intermediate_size=96,
+            n_layer=4, n_head=4, n_kv_head=2), {}),
+        ("mixtral", mixtral, mixtral.MixtralConfig(
+            vocab_size=128, hidden_size=64, intermediate_size=96,
+            n_layer=4, n_head=4, n_kv_head=2, num_experts=2, top_k=1,
+            router_jitter=0.0), {"train": False}),
+    ]
+    ctx = ParallelContext(pipeline_parallel_size=4, data_parallel_size=2)
+    try:
+        for name, mod, cfg, kw in cases:
+            params = mod.init_params(cfg, jax.random.PRNGKey(0))
+            cfg_f = dataclasses.replace(cfg, fused_ce=True)
+            specs = mod.pp_specs(params)
+
+            for runtime in ("loss_fn_pp", "loss_fn_1f1b"):
+                loss_fn = getattr(mod, runtime)
+
+                def run(c):
+                    fn = jax.jit(
+                        shard_map(
+                            lambda p, i: loss_fn(
+                                p, i, None, i, c, n_microbatches=2,
+                                pipe_axis="pipe", **kw
+                            ),
+                            mesh=ctx.mesh,
+                            in_specs=(specs, P()),
+                            out_specs=P(),
+                            check_vma=False,
+                        )
+                    )
+                    return float(fn(params, ids))
+
+                ref, fused = run(cfg), run(cfg_f)
+                assert abs(fused - ref) < 1e-4, (name, runtime, fused, ref)
+    finally:
+        ctx.destroy()
