@@ -337,7 +337,32 @@ def run_bench(force_cpu: bool) -> None:
             "loss": float(loss),
         }
 
-    def emit(results) -> bool:
+    def serving_block():
+        """Continuous-batching vs naive padded batching at mixed
+        sequence lengths (serving/engine.py A/B). Prompt lengths stay
+        inside ONE page bucket so each arm compiles a single prefill
+        program; the raggedness that padded batching pays for comes
+        from the mixed max_new_tokens."""
+        from pipegoose_tpu.serving import serving_ab_benchmark
+
+        if on_tpu:
+            scfg = bloom.BloomConfig.bloom_560m(dtype=jnp.bfloat16)
+            specs = [(10, 50), (30, 15), (20, 35), (5, 60),
+                     (28, 25), (12, 8), (25, 45), (8, 22)]
+            kw = dict(num_slots=4, num_pages=33, page_size=32,
+                      max_context=128)
+        else:
+            scfg = bloom.BloomConfig(
+                vocab_size=512, hidden_size=128, n_layer=2, n_head=4,
+                dtype=jnp.float32,
+            )
+            specs = [(6, 10), (3, 4), (7, 13), (2, 6)]
+            kw = dict(num_slots=2, num_pages=13, page_size=8,
+                      max_context=32)
+        sparams = bloom.init_params(scfg, jax.random.PRNGKey(1))
+        return serving_ab_benchmark(sparams, scfg, specs, **kw)
+
+    def emit(results, serving=None) -> bool:
         ok = {k: v for k, v in results.items() if "error" not in v}
         if not ok:
             return False
@@ -358,6 +383,8 @@ def run_bench(force_cpu: bool) -> None:
             "variants": results,
             "loss": r["loss"],
         }
+        if serving is not None:
+            payload["serving"] = serving
         if not on_tpu:
             cached = _cached_hardware_result()
             if cached is not None:
@@ -389,10 +416,17 @@ def run_bench(force_cpu: bool) -> None:
         if os.environ.get("BENCH_CHILD"):
             emit(results)
 
+    # serving throughput A/B LAST: the train numbers are the primary
+    # contract, a serving failure must not discard them
+    try:
+        serving = serving_block()
+    except Exception as e:  # noqa: BLE001
+        serving = {"error": f"{type(e).__name__}: {e}"[:300]}
     if os.environ.get("BENCH_CHILD"):
+        emit(results, serving)  # final cumulative line carries serving
         ok_any = bool({k: v for k, v in results.items() if "error" not in v})
     else:
-        ok_any = emit(results)
+        ok_any = emit(results, serving)
     if not ok_any:
         raise RuntimeError(f"all bench variants failed: {results}")
 

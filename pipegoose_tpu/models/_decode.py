@@ -41,6 +41,17 @@ def vocab_mask_for(config):
     return _MASKS[valid]
 
 
+def greedy_token(logits: jax.Array, logits_mask: Optional[Callable] = None
+                 ) -> jax.Array:
+    """Single-device greedy pick: optional padded-vocab mask, then argmax
+    — the temperature-0 branch of :func:`autoregressive_generate`'s pick,
+    shared with the serving engine's slot-batched decode step
+    (serving/engine.py) so the two paths cannot drift."""
+    if logits_mask is not None:
+        logits = logits_mask(logits)
+    return jnp.argmax(logits, axis=-1)
+
+
 def autoregressive_generate(
     forward_cached: Callable,
     init_cache: Callable,
@@ -81,10 +92,10 @@ def autoregressive_generate(
     if key not in _JIT_CACHE:
 
         def pick(logits, k):
+            if temperature <= 0.0:
+                return greedy_token(logits, logits_mask)
             if logits_mask is not None:
                 logits = logits_mask(logits)
-            if temperature <= 0.0:
-                return jnp.argmax(logits, axis=-1)
             return jax.random.categorical(k, logits / temperature, axis=-1)
 
         def fwd(params, ids, cache, pos, extras):
@@ -177,10 +188,7 @@ def autoregressive_generate_sharded(
     """
     from jax.sharding import PartitionSpec as P
 
-    try:
-        from jax import shard_map
-    except ImportError:  # jax < 0.6
-        from jax.experimental.shard_map import shard_map
+    from pipegoose_tpu.distributed.compat import shard_map
 
     if max_new_tokens <= 0:
         return input_ids

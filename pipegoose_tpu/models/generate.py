@@ -23,6 +23,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from pipegoose_tpu.models.bloom import (
@@ -50,6 +51,39 @@ def init_cache(config: BloomConfig, batch: int, max_len: int, tp: int = 1) -> di
     }
 
 
+def _qkv_proj(blk, x, config, tp_axis=None):
+    """Fused qkv projection split into (q, k, v), each (B, S, nh_local,
+    hd). Under TP the projection is column-parallel and the head dim is
+    the LOCAL subset. Shared by the contiguous-cache path below and the
+    serving engine's page-table path (serving/kv_pool.py)."""
+    b, s, _ = x.shape
+    hd = config.head_dim
+    tp = jax.lax.axis_size(tp_axis) if tp_axis else 1
+    nh = config.n_head // tp
+    fused = column_parallel_linear(blk["qkv"], x, tp_axis)
+    fused = fused.reshape(b, s, nh, 3, hd)
+    return fused[..., 0, :], fused[..., 1, :], fused[..., 2, :]
+
+
+def _attn_core(q, keys, values, bias, qmask, out_dtype):
+    """Softmax attention of q (B, S, nh, hd) against a key/value view
+    (B, K, nh, hd) under an additive bias (B|1, nh, S, K). The view can
+    be a contiguous cache OR the per-slot gather through a serving page
+    table — invalid key columns must arrive masked (NEG_INF) in ``bias``
+    so their softmax weight is exactly zero."""
+    hd = q.shape[-1]
+    b, s, nh, _ = q.shape
+    scores = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, keys, preferred_element_type=jnp.float32
+    ) * (hd**-0.5)
+    probs = jax.nn.softmax(scores + bias, axis=-1).astype(out_dtype)
+    ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, values, preferred_element_type=jnp.float32)
+    if qmask is not None:
+        # pad-query context is ZERO in every attention path (bloom._attention)
+        ctx = ctx * qmask[:, :, None, None].astype(ctx.dtype)
+    return ctx.astype(out_dtype).reshape(b, s, nh * hd)
+
+
 def _attn_cached(blk, x, k_cache, v_cache, start, config, tp_axis=None,
                  bias=None, qmask=None):
     """Attend S new tokens against cache[:start] + themselves; returns
@@ -59,27 +93,10 @@ def _attn_cached(blk, x, k_cache, v_cache, start, config, tp_axis=None,
     and the out projection's row-parallel psum recombines heads.
     ``bias``/``qmask`` come from :func:`_decode_bias` (hoisted — shared
     by all layers of one forward)."""
-    b, s, _ = x.shape
-    hd = config.head_dim
-    tp = jax.lax.axis_size(tp_axis) if tp_axis else 1
-    nh = config.n_head // tp
-
-    fused = column_parallel_linear(blk["qkv"], x, tp_axis)
-    fused = fused.reshape(b, s, nh, 3, hd)
-    q, k, v = fused[..., 0, :], fused[..., 1, :], fused[..., 2, :]
-
+    q, k, v = _qkv_proj(blk, x, config, tp_axis)
     k_cache = lax.dynamic_update_slice(k_cache, k.astype(k_cache.dtype), (0, start, 0, 0))
     v_cache = lax.dynamic_update_slice(v_cache, v.astype(v_cache.dtype), (0, start, 0, 0))
-
-    scores = jnp.einsum(
-        "bqhd,bkhd->bhqk", q, k_cache, preferred_element_type=jnp.float32
-    ) * (hd**-0.5)
-    probs = jax.nn.softmax(scores + bias, axis=-1).astype(x.dtype)
-    ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, v_cache, preferred_element_type=jnp.float32)
-    if qmask is not None:
-        # pad-query context is ZERO in every attention path (bloom._attention)
-        ctx = ctx * qmask[:, :, None, None].astype(ctx.dtype)
-    ctx = ctx.astype(x.dtype).reshape(b, s, nh * hd)
+    ctx = _attn_core(q, k_cache, v_cache, bias, qmask, x.dtype)
     return row_parallel_linear(blk["out"], ctx, tp_axis), k_cache, v_cache
 
 
@@ -165,15 +182,26 @@ def _ragged_extras(attention_mask, max_new_tokens):
 
     A RIGHT-padded mask would silently mis-position the generated tail
     (the appended ones land after the pad gap), so fail loudly instead
-    — one tiny host sync per generate call (advisor r4). The check is
-    best-effort: under a tracer or a non-fully-addressable (multihost)
-    mask the host fetch is impossible, so it is skipped rather than
-    crashing a path that worked before the guard existed (the ``.all()``
-    reduction keeps the fetch legal for fully-replicated shardings)."""
-    try:
-        ends_valid = bool(jnp.asarray(attention_mask)[:, -1].all())
-    except Exception:  # noqa: BLE001 — tracer / non-addressable sharding
-        ends_valid = True
+    (advisor r4). The check runs only for HOST-resident masks (numpy
+    arrays, lists — the common entry point, where it is free): fetching
+    a column of a device ``jax.Array`` would force a blocking
+    device-to-host sync on every generate call, and a tracer or a
+    non-fully-addressable multihost mask cannot be fetched at all
+    (ADVICE r5) — those skip the guard. The except clause names the
+    specific failure modes of exotic array-likes reaching ``np.asarray``
+    instead of swallowing real errors with a bare Exception."""
+    if isinstance(attention_mask, jax.Array):
+        ends_valid = True  # device array / tracer: skip, no forced sync
+    else:
+        try:
+            # keep the materialized array: plain lists have no .shape,
+            # so the concatenate below needs this form anyway
+            attention_mask = np.asarray(attention_mask)
+            ends_valid = bool(attention_mask[:, -1].all())
+        except (jax.errors.TracerBoolConversionError,
+                jax.errors.ConcretizationTypeError,
+                RuntimeError):  # non-addressable shard behind an array-like
+            ends_valid = True
     if not ends_valid:
         raise ValueError(
             "ragged generate expects a LEFT-padded attention_mask (HF "

@@ -378,7 +378,24 @@ def fused_ce_sums(
     while pow2 < min(t, block_t):
         pow2 *= 2
     block_t = min(pow2, block_t)
-    block_v = _pick_block(weight.shape[0] if vh else weight.shape[1], block_v)
+    v_loc = weight.shape[0] if vh else weight.shape[1]
+    requested_v = block_v
+    block_v = _pick_block(v_loc, block_v)
+    interpret = _resolve_interpret(interpret)
+    if block_v > requested_v and not interpret:
+        # _pick_block's fallback is the WHOLE vocab dim as one tile; a
+        # (V_local, H) fp32 tile cannot fit VMEM on hardware, so the
+        # compiled run would die with an opaque Mosaic error that
+        # interpret-mode tests never see (ADVICE r5) — fail loudly here,
+        # but only for compiled runs: the interpreter has no VMEM limit
+        # and the whole-vocab tile is valid there.
+        raise ValueError(
+            f"fused CE: no block size >= 8 among halvings of "
+            f"{requested_v} divides V_local={v_loc}, and a single "
+            f"(V_local={v_loc}, H) tile is VMEM-infeasible on hardware. "
+            f"Pad the vocab shard to a power-of-two-friendly size "
+            f"(pad_for_tp / pad_vocab) or pass a block_v dividing it."
+        )
     if t % block_t:
         pad = block_t - t % block_t
         hidden = jnp.pad(hidden, ((0, pad), (0, 0)))
@@ -386,7 +403,7 @@ def fused_ce_sums(
         token_w = jnp.pad(token_w, (0, pad))
     return _fused_ce(
         hidden, weight, targets, token_w.astype(jnp.float32), axis_name,
-        valid_size, block_t, block_v, _resolve_interpret(interpret), vh,
+        valid_size, block_t, block_v, interpret, vh,
     )
 
 

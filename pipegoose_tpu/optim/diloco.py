@@ -27,12 +27,8 @@ import optax
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from pipegoose_tpu.distributed.compat import shard_map
 from pipegoose_tpu.distributed.parallel_context import ParallelContext
-
-try:
-    from jax import shard_map
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map
 
 
 def outer_optimizer(lr: float = 0.7, momentum: float = 0.9) -> optax.GradientTransformation:

@@ -17,6 +17,7 @@ import jax
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from pipegoose_tpu.distributed.compat import shard_map
 from pipegoose_tpu.distributed.parallel_context import ParallelContext
 from pipegoose_tpu.optim.zero import (
     DistributedOptimizer,
@@ -24,11 +25,6 @@ from pipegoose_tpu.optim.zero import (
     shard_shapes,
     state_specs,
 )
-
-try:
-    from jax import shard_map
-except ImportError:  # pragma: no cover - jax < 0.6
-    from jax.experimental.shard_map import shard_map
 
 
 def _spec_mentions(spec: P, axis: str) -> bool:

@@ -1,0 +1,35 @@
+"""Continuous-batching inference serving over a paged KV-cache pool.
+
+The layer above the model stack that the per-call ``generate()`` /
+``generate_tp()`` paths cannot provide: request multiplexing. See
+docs/serving.md for the request lifecycle and page-table layout.
+"""
+from pipegoose_tpu.serving.engine import (
+    RequestOutput,
+    ServingEngine,
+    serving_ab_benchmark,
+)
+from pipegoose_tpu.serving.kv_pool import (
+    NULL_PAGE,
+    PagePool,
+    gather_pages,
+    init_pages,
+    paged_decode_step,
+    write_prompt_pages,
+)
+from pipegoose_tpu.serving.scheduler import Request, Scheduler, Status
+
+__all__ = [
+    "NULL_PAGE",
+    "PagePool",
+    "Request",
+    "RequestOutput",
+    "Scheduler",
+    "ServingEngine",
+    "Status",
+    "gather_pages",
+    "init_pages",
+    "paged_decode_step",
+    "serving_ab_benchmark",
+    "write_prompt_pages",
+]

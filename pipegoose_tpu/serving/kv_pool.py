@@ -1,0 +1,232 @@
+"""Paged KV-cache pool: fixed-size pages + a page-table attention path.
+
+vLLM-style paging rebuilt for the jit/shard_map stack. The per-call
+contiguous cache (models/generate.py:init_cache) allocates
+``batch * max_len`` key/value slots whether or not a row ever fills
+them; a serving engine multiplexing many requests instead draws from ONE
+preallocated pool
+
+    (n_layer, num_pages, page_size, n_head_local, head_dim)
+
+per k and v, where a sequence owns ``ceil(len / page_size)`` pages wired
+up by an integer page table. Three pieces live here:
+
+- :class:`PagePool` — the HOST-side free-list allocator. Allocation is a
+  LIFO stack pop, so placement is deterministic given the request/evict
+  order (testable invariant); page 0 is reserved as the NULL page that
+  absorbs writes from padded slots and pad positions.
+- :func:`paged_decode_step` — one decode step over the ragged active
+  batch: each slot's pending token is scatter-written through its page
+  table, attention reads the gathered page view, and invalid key
+  columns (beyond ``seq_lens``, stale page tails, null-page garbage)
+  are masked to exactly zero softmax weight. Reuses the SAME qkv
+  projection and attention core as the contiguous path
+  (models/generate.py:_qkv_proj/_attn_core) so numerics cannot drift.
+- :func:`write_prompt_pages` — scatter a prefill's contiguous cache
+  into the pool, repacking a LEFT-padded prompt to logical positions
+  0..len-1 (the unpadded layout the decode bias assumes).
+
+Under TP every function sees the LOCAL head subset (call inside
+shard_map with the pool's head dim sharded over the tensor axis), and
+the engine pairs the local logits with ``global_greedy_pick`` exactly
+like models/_decode.py's sharded driver.
+"""
+from __future__ import annotations
+
+from collections import deque
+from typing import Deque, List, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from pipegoose_tpu.models.bloom import NEG_INF, alibi_slopes, bloom_gelu, layer_norm, logits_fn
+from pipegoose_tpu.models.generate import _attn_core, _qkv_proj
+from pipegoose_tpu.nn.tensor_parallel.layers import (
+    column_parallel_linear,
+    row_parallel_linear,
+    vocab_parallel_embedding,
+)
+
+NULL_PAGE = 0
+
+
+class PagePool:
+    """Free-list allocator over ``num_pages`` fixed-size KV pages.
+
+    Page 0 is the NULL page — never handed out; padded slots and the pad
+    positions of a bucketed prefill scatter their garbage there. The
+    free list is a LIFO stack, so the physical placement of any workload
+    is a pure function of the submit/evict order (the determinism
+    invariant tests/serving/test_kv_pool.py pins down). ``history``
+    keeps the most recent (event, pages) pairs for those tests and for
+    debugging fragmentation — bounded so a long-lived engine never
+    accumulates host memory per request."""
+
+    def __init__(self, num_pages: int, page_size: int):
+        if num_pages < 2:
+            raise ValueError("need >= 2 pages (page 0 is the null page)")
+        if page_size < 1:
+            raise ValueError(f"page_size must be positive, got {page_size}")
+        self.num_pages = num_pages
+        self.page_size = page_size
+        self._free: List[int] = list(range(num_pages - 1, 0, -1))
+        self._owned: set = set()
+        self.history: Deque[Tuple[str, Tuple[int, ...]]] = deque(maxlen=1024)
+
+    @property
+    def free_count(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_count(self) -> int:
+        return self.num_pages - 1 - len(self._free)
+
+    @property
+    def capacity(self) -> int:
+        """Allocatable pages (the null page is not allocatable)."""
+        return self.num_pages - 1
+
+    def pages_for(self, n_tokens: int) -> int:
+        return -(-n_tokens // self.page_size)
+
+    def alloc(self, n: int) -> List[int]:
+        if n > len(self._free):
+            raise RuntimeError(
+                f"page pool exhausted: requested {n}, free {len(self._free)} "
+                f"of {self.capacity} (admission control should prevent this)"
+            )
+        pages = [self._free.pop() for _ in range(n)]
+        for p in pages:
+            if p == NULL_PAGE or p in self._owned:
+                raise RuntimeError(f"allocator invariant broken: page {p} "
+                                   f"double-allocated or null")
+            self._owned.add(p)
+        self.history.append(("alloc", tuple(pages)))
+        return pages
+
+    def free(self, pages: List[int]) -> None:
+        for p in pages:
+            if p not in self._owned:
+                raise RuntimeError(f"freeing page {p} that is not allocated")
+            self._owned.discard(p)
+            self._free.append(p)
+        self.history.append(("free", tuple(pages)))
+
+
+def init_pages(config, num_pages: int, page_size: int, tp: int = 1):
+    """The pool's device buffers; under TP each shard holds nh/tp heads
+    (create the GLOBAL array and shard dim 3 over the tensor axis)."""
+    L, nh, hd = config.n_layer, config.n_head, config.head_dim
+    shape = (L, num_pages, page_size, nh // tp, hd)
+    return jnp.zeros(shape, config.dtype), jnp.zeros(shape, config.dtype)
+
+
+def write_prompt_pages(k_pages, v_pages, cache, phys_pages, pad, page_size):
+    """Scatter a prefill's contiguous cache into the pool.
+
+    ``cache`` is forward_cached's (L, 1, S_pad, nh, hd) pair holding a
+    LEFT-padded prompt (``pad`` pad slots, then the prompt); logical
+    prompt position p lands in page ``phys_pages[p // page_size]`` at
+    offset ``p % page_size`` — the repack drops the padding, so decode
+    sees the unpadded 0..len-1 layout. Pad positions route to the NULL
+    page. ``phys_pages`` is the slot's full page-table row (fixed width,
+    unused tail entries 0) so every bucket shares one compiled program.
+    """
+    k_seq, v_seq = cache["k"][:, 0], cache["v"][:, 0]  # (L, S_pad, nh, hd)
+    s_pad = k_seq.shape[1]
+    pos = jnp.arange(s_pad)
+    logical = pos - pad
+    valid = logical >= 0
+    lclip = jnp.where(valid, logical, 0)
+    dest_page = jnp.where(valid, phys_pages[lclip // page_size], NULL_PAGE)
+    dest_off = jnp.where(valid, lclip % page_size, 0)
+    k_pages = k_pages.at[:, dest_page, dest_off].set(k_seq.astype(k_pages.dtype))
+    v_pages = v_pages.at[:, dest_page, dest_off].set(v_seq.astype(v_pages.dtype))
+    return k_pages, v_pages
+
+
+def gather_pages(pages, page_table):
+    """Read the pool through a page table: (B, W) int32 -> the per-slot
+    contiguous view (B, W * page_size, nh, hd). The read path of the
+    paged attention; exposed for the reconstruction tests."""
+    b, w = page_table.shape
+    ps = pages.shape[-3]
+    view = jnp.take(pages, page_table, axis=-4)
+    # (.., B, W, ps, nh, hd) -> (.., B, W * ps, nh, hd)
+    return view.reshape(view.shape[:-4] + (w * ps,) + view.shape[-2:])
+
+
+def _paged_bias(config, seq_lens, n_keys, tp_axis):
+    """Additive attention bias for one paged decode step: ALiBi over the
+    GLOBAL key position + a per-ROW keep mask ``key_pos <= seq_len``
+    (causal-by-slot: masks not-yet-written offsets, stale page tails
+    from a previous owner, and null-page garbage alike). Serving slots
+    hold UNPADDED sequences, so plain global positions apply — the same
+    bias _decode_bias builds for extras=None, generalized to a per-row
+    ``start``. Returns (B, nh_local, 1, n_keys)."""
+    tp = jax.lax.axis_size(tp_axis) if tp_axis else 1
+    nh = config.n_head // tp
+    slopes = jnp.asarray(alibi_slopes(config.n_head))
+    if tp_axis:
+        slopes = lax.dynamic_slice_in_dim(
+            slopes, jax.lax.axis_index(tp_axis) * nh, nh, 0
+        )
+    key_pos = jnp.arange(n_keys)
+    keep = key_pos[None, :] <= seq_lens[:, None]  # (B, n_keys)
+    bias = slopes[None, :, None, None] * key_pos[None, None, None, :].astype(jnp.float32)
+    return bias + jnp.where(keep[:, None, None, :], 0.0, NEG_INF)
+
+
+def paged_decode_step(params, tokens, k_pages, v_pages, page_table, seq_lens,
+                      config, tp_axis=None):
+    """One decode step for every slot of the ragged active batch.
+
+    ``tokens`` (B,) are the pending tokens (each slot's last emitted
+    token), ``seq_lens`` (B,) the number of tokens already cached per
+    slot — the pending token's position. Each slot's k/v is written
+    through its ``page_table`` (B, W) row at page ``seq_len // ps``,
+    offset ``seq_len % ps``; attention reads the gathered page view.
+    Padded slots must point every table entry at the NULL page (their
+    writes and reads are garbage-in/garbage-out, masked by the bias and
+    discarded by the scheduler).
+
+    Returns (logits (B, V_local), k_pages, v_pages). Under ``tp_axis``
+    the logits are the LOCAL vocab shard — pair with
+    ``_decode.global_greedy_pick`` like the sharded generate driver.
+    """
+    b = tokens.shape[0]
+    ps = k_pages.shape[2]
+    n_keys = page_table.shape[1] * ps
+
+    x = vocab_parallel_embedding(params["embed"], tokens[:, None], tp_axis)
+    x = x.astype(config.dtype)
+    x = layer_norm(params["embed_ln"], x, config.layer_norm_epsilon)
+    bias = _paged_bias(config, seq_lens, n_keys, tp_axis)
+
+    page_idx = seq_lens // ps
+    off = seq_lens % ps
+    phys = jnp.take_along_axis(page_table, page_idx[:, None], axis=1)[:, 0]
+
+    def scan_fn(carry, blk_and_pages):
+        h = carry
+        blk, kp, vp = blk_and_pages
+        ln1 = layer_norm(blk["ln_1"], h, config.layer_norm_epsilon)
+        q, k, v = _qkv_proj({"qkv": blk["attn"]["qkv"]}, ln1, config, tp_axis)
+        kp = kp.at[phys, off].set(k[:, 0].astype(kp.dtype))
+        vp = vp.at[phys, off].set(v[:, 0].astype(vp.dtype))
+        keys = gather_pages(kp, page_table)
+        vals = gather_pages(vp, page_table)
+        ctx = _attn_core(q, keys, vals, bias, None, h.dtype)
+        h = h + row_parallel_linear(blk["attn"]["out"], ctx, tp_axis)
+        ln2 = layer_norm(blk["ln_2"], h, config.layer_norm_epsilon)
+        up = column_parallel_linear(blk["mlp"]["up"], ln2, tp_axis)
+        h = h + row_parallel_linear(blk["mlp"]["down"], bloom_gelu(up), tp_axis)
+        return h, (kp, vp)
+
+    x, (k_pages, v_pages) = lax.scan(
+        scan_fn, x, (params["blocks"], k_pages, v_pages)
+    )
+    x = layer_norm(params["ln_f"], x, config.layer_norm_epsilon)
+    logits = logits_fn(params, x, tp_axis)[:, 0]  # (B, V_local)
+    return logits, k_pages, v_pages

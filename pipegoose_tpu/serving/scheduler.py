@@ -1,0 +1,186 @@
+"""Continuous-batching scheduler: request lifecycle + slot/page admission.
+
+The request lifecycle is QUEUED -> PREFILL -> DECODE -> DONE. A fixed
+number of decode SLOTS bounds the jitted step's batch dim (static
+shapes); the scheduler's job is to keep those slots full:
+
+- **admission** pops the FIFO queue into free slots whenever the page
+  pool can cover the candidate's WORST-CASE footprint
+  (``ceil((prompt + max_new) / page_size)``) on top of every active
+  request's outstanding reservation. Pages are then allocated LAZILY —
+  prompt pages at admission, decode pages one at a time as the write
+  position crosses a page boundary — so short-finishing requests never
+  hold their worst case, while the reservation arithmetic guarantees a
+  lazy ``alloc`` can never fail mid-flight. Head-of-line blocking is
+  deliberate: FIFO admission keeps the schedule deterministic.
+- **eviction** frees a finished request's pages and reservation the
+  step its last token is emitted, so the next ``admit`` can re-use both
+  the slot and the pages mid-stream (continuous batching).
+
+``continuous=False`` turns the same machinery into the naive padded
+baseline: a batch is admitted only into an EMPTY slot set and drains
+fully before the next one — slots idle behind the batch's longest
+member exactly the way padded ``generate`` rows do, which is the A/B
+the serving bench measures.
+"""
+from __future__ import annotations
+
+import enum
+from collections import deque
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+import numpy as np
+
+from pipegoose_tpu.serving.kv_pool import PagePool
+
+
+class Status(enum.Enum):
+    QUEUED = "queued"
+    PREFILL = "prefill"
+    DECODE = "decode"
+    DONE = "done"
+
+
+@dataclass
+class Request:
+    """One generation request. Engine/scheduler fill the lifecycle
+    fields; callers provide the first three."""
+
+    prompt: np.ndarray                 # (S,) token ids
+    max_new_tokens: int
+    eos_token_id: Optional[int] = None
+
+    uid: Optional[int] = None
+    status: Status = Status.QUEUED
+    generated: List[int] = field(default_factory=list)
+    slot: Optional[int] = None
+    pages: List[int] = field(default_factory=list)
+    outstanding: int = 0               # worst-case pages not yet allocated
+    finish_reason: Optional[str] = None
+    t_submit: Optional[float] = None
+    t_admit: Optional[float] = None
+    t_first_token: Optional[float] = None
+    t_done: Optional[float] = None
+
+    @property
+    def prompt_len(self) -> int:
+        return int(np.asarray(self.prompt).shape[0])
+
+    @property
+    def cached_len(self) -> int:
+        """Tokens currently in the KV pages: the whole prompt plus every
+        generated token except the pending one (the decode step writes
+        the pending token before attending)."""
+        return self.prompt_len + max(len(self.generated) - 1, 0)
+
+    @property
+    def tokens(self) -> np.ndarray:
+        return np.concatenate(
+            [np.asarray(self.prompt, np.int64),
+             np.asarray(self.generated, np.int64)]
+        )
+
+
+class Scheduler:
+    def __init__(self, num_slots: int, pool: PagePool, max_context: int,
+                 continuous: bool = True):
+        if num_slots < 1:
+            raise ValueError("need at least one decode slot")
+        self.num_slots = num_slots
+        self.pool = pool
+        self.max_context = max_context
+        self.continuous = continuous
+        self.slots: List[Optional[Request]] = [None] * num_slots
+        self.queue: deque = deque()
+        self._outstanding_total = 0
+        self._next_uid = 0
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def submit(self, req: Request, now: float) -> None:
+        worst = self.pool.pages_for(req.prompt_len + req.max_new_tokens)
+        if req.prompt_len < 1:
+            raise ValueError("empty prompt")
+        if req.max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        if req.prompt_len + req.max_new_tokens > self.max_context:
+            raise ValueError(
+                f"request needs {req.prompt_len + req.max_new_tokens} "
+                f"context but the engine was sized for {self.max_context}"
+            )
+        if worst > self.pool.capacity:
+            raise ValueError(
+                f"request worst case is {worst} pages but the pool only "
+                f"has {self.pool.capacity}"
+            )
+        req.uid = self._next_uid
+        self._next_uid += 1
+        req.t_submit = now
+        req.status = Status.QUEUED
+        self.queue.append(req)
+
+    def admit(self, now: float) -> List[Request]:
+        """Move queued requests into free slots while the pool can cover
+        their worst case beyond all outstanding reservations. Returns the
+        newly admitted requests (they still need a prefill)."""
+        admitted: List[Request] = []
+        if not self.continuous and any(s is not None for s in self.slots):
+            return admitted  # naive padded batching: drain before refill
+        while self.queue:
+            free_slots = [i for i, s in enumerate(self.slots) if s is None]
+            if not free_slots:
+                break
+            req = self.queue[0]
+            worst = self.pool.pages_for(req.prompt_len + req.max_new_tokens)
+            if self.pool.free_count - self._outstanding_total < worst:
+                break  # FIFO head-of-line: deterministic admission order
+            self.queue.popleft()
+            req.slot = free_slots[0]
+            self.slots[req.slot] = req
+            req.status = Status.PREFILL
+            req.t_admit = now
+            n_prompt = self.pool.pages_for(req.prompt_len)
+            req.pages = self.pool.alloc(n_prompt)
+            req.outstanding = worst - n_prompt
+            self._outstanding_total += req.outstanding
+            admitted.append(req)
+        return admitted
+
+    def ensure_page(self, req: Request) -> None:
+        """Lazy growth: allocate the next page when the pending token's
+        write position crosses into unallocated territory. Cannot fail —
+        admission reserved the worst case."""
+        pos = req.cached_len  # position the next step writes
+        if pos >= len(req.pages) * self.pool.page_size:
+            req.pages += self.pool.alloc(1)
+            req.outstanding -= 1
+            self._outstanding_total -= 1
+
+    def record_token(self, req: Request, token: int, now: float) -> None:
+        if req.t_first_token is None:
+            req.t_first_token = now
+        req.status = Status.DECODE
+        req.generated.append(int(token))
+        if req.eos_token_id is not None and int(token) == req.eos_token_id:
+            self._finish(req, "eos", now)
+        elif len(req.generated) >= req.max_new_tokens:
+            self._finish(req, "length", now)
+
+    def _finish(self, req: Request, reason: str, now: float) -> None:
+        req.status = Status.DONE
+        req.finish_reason = reason
+        req.t_done = now
+        self.pool.free(req.pages)
+        req.pages = []
+        self._outstanding_total -= req.outstanding
+        req.outstanding = 0
+        self.slots[req.slot] = None
+
+    # -- queries -----------------------------------------------------------
+
+    def active(self) -> List[Request]:
+        return [r for r in self.slots if r is not None]
+
+    def all_done(self) -> bool:
+        return not self.queue and all(s is None for s in self.slots)

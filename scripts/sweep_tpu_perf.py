@@ -227,10 +227,38 @@ def fusedce_sweep():
     print(json.dumps(results))
 
 
+def serving_sweep():
+    """Continuous-batching vs naive padded serving (serving/engine.py)
+    across slot counts on the real chip: the decode-step savings grow
+    with the slot count as long as the mixed-length workload keeps
+    slots refillable. Prompt lengths stay inside one page bucket so
+    each engine compiles a single prefill program (dispatch RTT, not
+    compile count, should dominate)."""
+    from pipegoose_tpu.models import bloom
+    from pipegoose_tpu.serving import serving_ab_benchmark
+
+    cfg = bloom.BloomConfig.bloom_560m(dtype=jnp.bfloat16)
+    params = bloom.init_params(cfg, jax.random.PRNGKey(1))
+    specs = [(10, 50), (30, 15), (20, 35), (5, 60), (28, 25), (12, 8),
+             (25, 45), (8, 22), (17, 40), (22, 12), (9, 55), (14, 30)]
+    results = {}
+    for slots in (2, 4, 8):
+        label = f"slots{slots}"
+        try:
+            results[label] = serving_ab_benchmark(
+                params, cfg, specs, num_slots=slots,
+                num_pages=1 + 3 * slots, page_size=32, max_context=128,
+            )
+        except Exception as e:  # noqa: BLE001
+            results[label] = {"error": f"{type(e).__name__}: {e}"[:300]}
+        print(label, json.dumps(results[label]), flush=True)
+    print(json.dumps(results))
+
+
 if __name__ == "__main__":
     mode = sys.argv[1] if len(sys.argv) > 1 else "kernel"
     modes = {"kernel": kernel_sweep, "model": model_sweep,
-             "fusedce": fusedce_sweep}
+             "fusedce": fusedce_sweep, "serving": serving_sweep}
     if mode not in modes:
         raise SystemExit(f"unknown mode {mode!r}; pick one of {sorted(modes)}")
     modes[mode]()

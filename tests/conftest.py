@@ -55,6 +55,8 @@ FAST_FILES = {
     "tests/optim/test_zero.py",                 # ZeRO-1
     "tests/nn/pipeline_parallel/test_pipeline.py",  # compiled GPipe
     "tests/models/test_generate.py",            # KV-cache decode
+    "tests/serving/test_kv_pool.py",            # paged-KV allocator/gather
+    "tests/serving/test_serving_scheduler.py",  # continuous-batching lifecycle
 }
 FAST_TESTS = {
     # TP layers + losses
@@ -105,6 +107,9 @@ FAST_TESTS = {
     "tests/trainer/test_recovery.py::test_detector_raises_on_nan",
     "tests/distributed/test_multihost.py::test_two_process_init_multihost",
     "tests/models/test_generate_tp.py::test_tp_generate_matches_single_device",
+    # serving: continuous batching == per-request generate, 1-device + tp
+    "tests/serving/test_engine.py::test_mixed_lengths_token_identical_to_generate",
+    "tests/serving/test_engine.py::test_tp_sharded_serving_matches_generate[2]",
     # memory dry passes (analytic only; the AOT compile is `slow`)
     "tests/test_8x7b_memory.py::test_8x7b_param_count",
     "tests/test_8x7b_memory.py::test_8x7b_fits_v5p64_4d_sharding",
@@ -112,17 +117,101 @@ FAST_TESTS = {
 }
 
 
+# --- slow tier ------------------------------------------------------------
+#
+# The jax<0.6 compat shims (distributed/compat.py) unlocked ~100 sharded
+# equivalence tests that previously failed at import-mismatch speed; the
+# full `-m 'not slow'` run then blew the tier-1 wall budget (ROADMAP:
+# 870s). Curated from the measured durations: heavyweight MULTI-STEP
+# training-equivalence runs, memory-bound checks, and redundant
+# parametrizations move to `slow` — every entry keeps a cheaper
+# loss/logits/single-step sibling (often in the fast tier) covering the
+# same subsystem in tier-1. Nothing here may also appear in the fast
+# tables above.
+SLOW_TESTS = {
+    "tests/nn/sequence_parallel/test_ring_attention.py::test_ring_flash_gqa_matches_repeated",
+    "tests/nn/sequence_parallel/test_ring_attention.py::test_ring_dense_gqa_matches_repeated",
+    "tests/nn/sequence_parallel/test_ring_attention.py::test_ring_flash_matches_ring",
+    "tests/nn/sequence_parallel/test_ring_attention.py::test_ring_flash_memory_bound",
+    "tests/nn/sequence_parallel/test_ring_attention.py::test_bloom_sp_flash_matches_plain",
+    "tests/ops/test_fused_ce.py::test_pp_heads_fused_ce_match_default",
+    "tests/ops/test_fused_ce.py::test_llama_and_mixtral_fused_ce_match_default",
+    "tests/ops/test_fused_ce.py::test_bloom_loss_fused_matches_default",
+    "tests/nn/pipeline_parallel/test_1f1b.py::test_training_matches_gpipe",
+    "tests/nn/pipeline_parallel/test_1f1b.py::test_activation_memory_bound",
+    "tests/nn/pipeline_parallel/test_1f1b.py::test_matches_gpipe_loss_and_grads[1-4-4]",
+    "tests/nn/pipeline_parallel/test_1f1b.py::test_matches_gpipe_loss_and_grads[2-2-4]",
+    "tests/nn/pipeline_parallel/test_uneven_stages.py::test_uneven_mixtral_pp_matches_dense",
+    "tests/nn/pipeline_parallel/test_uneven_stages.py::test_uneven_grads_match_dense",
+    "tests/nn/tensor_parallel/test_layers.py::test_chunked_ce_matches_plain",
+    "tests/models/test_llama.py::test_1f1b_matches_dense_tied_and_untied",
+    "tests/models/test_mixtral.py::test_sliding_window_flash_matches_dense",
+    "tests/models/test_mixtral.py::test_sliding_window_generate_consistent",
+    "tests/models/test_mixtral.py::test_tp_grads_consistent_across_tensor_ranks",
+    "tests/models/test_mixtral_sp.py::test_pp_sp_training_matches_dense",
+    "tests/models/test_mixtral_sp.py::test_sp_tp_training_matches_single_device",
+    "tests/models/test_mixtral_sp.py::test_sp_grads_match_single_device",
+    "tests/models/test_mixtral_sp.py::test_ulysses_sp_grads_match_dense",
+    "tests/models/test_mixtral_sp.py::test_ulysses_sp_matches_dense",
+    "tests/models/test_albert.py::test_dp_training_matches_single_device",
+    "tests/models/test_albert_pp_sp.py::test_1f1b_matches_dense",
+    "tests/models/test_albert_pp_sp.py::test_pp_sp_composition_matches_dense",
+    "tests/models/test_albert_pp_sp.py::test_ulysses_sp_matches_dense",
+    "tests/models/test_bloom.py::test_tp_grads_match_single_device",
+    "tests/models/test_bloom_sp.py::test_pp_sp_training_matches_single_device",
+    "tests/models/test_bloom_sp.py::test_sp_training_matches_single_device",
+    "tests/models/test_bloom_moe.py::test_moe_training_matches_single_device",
+    "tests/test_4d_parallel.py::test_4d_training_matches_single_device",
+    "tests/test_4d_parallel.py::test_1f1b_matches_gpipe_with_aux",
+    "tests/test_3d_parallel.py::test_3d_training_matches_single_device",
+    "tests/test_hybrid.py::test_hybrid_tp2_dp2_zero1_matches_single_device",
+    "tests/test_hybrid.py::test_hybrid_with_grad_accumulation_matches_large_batch",
+    "tests/optim/test_diloco_4d.py::test_inner_steps_match_standalone_workers",
+    "tests/trainer/test_trainer.py::test_checkpoint_and_resume",
+    "tests/trainer/test_recovery.py::test_auto_recovery_restores_and_continues",
+    "tests/trainer/test_recovery.py::test_rollback_on_save_boundary_does_not_mislabel",
+    "tests/ops/test_flash_attention.py::test_bloom_flash_padded_matches_plain",
+    "tests/ops/test_flash_attention.py::test_rope_family_flash_matches_plain[mixtral]",
+    "tests/ops/test_flash_attention.py::test_rope_family_flash_matches_plain[llama]",
+    "tests/ops/test_flash_attention.py::test_gqa_grouped_kv_matches_repeated",
+    "tests/ops/test_fused_ce.py::test_sp_heads_fused_ce_match_default",
+    "tests/models/test_bloom_sp.py::test_ulysses_tp_training_matches_single_device",
+    "tests/models/test_bloom_sp.py::test_sp_left_padded_flash_grads_match_dense",
+    "tests/models/test_bloom_sp.py::test_sp_grads_match_single_device",
+    "tests/models/test_bloom_sp.py::test_ulysses_grads_match_ring",
+    "tests/models/test_albert.py::test_tp_forward_and_grads_match",
+    "tests/models/test_albert_pp_sp.py::test_sp_loss_and_grads_match_dense",
+    "tests/models/test_albert_pp_sp.py::test_flash_attention_matches_dense",
+    "tests/models/test_mixtral_sp.py::test_pp_sp_loss_matches_dense",
+    "tests/models/test_mixtral_sp.py::test_ulysses_sp_training_equivalence_llama",
+    "tests/models/test_mixtral_sp.py::test_sp_padded_matches_dense",
+    "tests/models/test_llama.py::test_upcycle_to_moe_matches_dense",
+    "tests/nn/pipeline_parallel/test_uneven_stages.py::test_uneven_1f1b_matches_dense",
+    "tests/optim/test_diloco.py::test_diloco_trains_and_syncs",
+    "tests/optim/test_diloco_4d.py::test_mixtral_diloco_tp_ep",
+    "tests/optim/test_diloco_4d.py::test_sync_step_matches_manual_outer_update",
+    "tests/test_4d_parallel.py::test_pp_m4_aux_matches_microbatched_dense_reference",
+}
+
+
 def pytest_collection_modifyitems(config, items):
     matched = set()
     for item in items:
         nid = item.nodeid
+        if nid in SLOW_TESTS:
+            item.add_marker(pytest.mark.slow)
         if nid in FAST_TESTS or nid.split("::")[0] in FAST_FILES:
             item.add_marker(pytest.mark.fast)
             matched.add(nid if nid in FAST_TESTS else nid.split("::")[0])
     # drift guard: a rename or a parametrize-id change would silently
     # shrink the tier — fail the collection instead. Only enforced when
-    # the collection spans every referenced file (a path-restricted run
-    # legitimately sees a subset).
+    # a fast-tier run was actually selected (``-m fast``): a stale entry
+    # must not break every full-suite run at collection time (ADVICE
+    # r5), and only when the collection spans every referenced file (a
+    # path-restricted run legitimately sees a subset).
+    # exact match, not substring: `-m 'not fast'` must not re-arm it
+    if (getattr(config.option, "markexpr", "") or "").strip() != "fast":
+        return
     collected_files = {item.nodeid.split("::")[0] for item in items}
     referenced_files = FAST_FILES | {n.split("::")[0] for n in FAST_TESTS}
     if referenced_files <= collected_files:

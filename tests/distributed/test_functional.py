@@ -9,10 +9,7 @@ from jax.sharding import PartitionSpec as P
 
 from pipegoose_tpu.distributed import ParallelContext, functional as F
 
-try:
-    from jax import shard_map
-except ImportError:  # jax < 0.6
-    from jax.experimental.shard_map import shard_map
+from pipegoose_tpu.distributed.compat import shard_map
 
 
 @pytest.fixture()

@@ -15,10 +15,7 @@ from jax.sharding import PartitionSpec as P
 from pipegoose_tpu.distributed import ParallelContext
 from pipegoose_tpu.models import albert
 
-try:
-    from jax import shard_map
-except ImportError:
-    from jax.experimental.shard_map import shard_map
+from pipegoose_tpu.distributed.compat import shard_map
 
 
 @pytest.fixture(scope="module")

@@ -13,10 +13,7 @@ from pipegoose_tpu.distributed import ParallelContext
 from pipegoose_tpu.models import bloom
 from pipegoose_tpu.models.hf import bloom_params_from_hf, bloom_params_to_hf_state_dict
 
-try:
-    from jax import shard_map
-except ImportError:
-    from jax.experimental.shard_map import shard_map
+from pipegoose_tpu.distributed.compat import shard_map
 
 
 @pytest.fixture(scope="module")

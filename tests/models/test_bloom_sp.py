@@ -10,10 +10,7 @@ from pipegoose_tpu.distributed import ParallelContext
 from pipegoose_tpu.models import bloom
 from pipegoose_tpu.parallel.hybrid import sync_replicated_grads
 
-try:
-    from jax import shard_map
-except ImportError:
-    from jax.experimental.shard_map import shard_map
+from pipegoose_tpu.distributed.compat import shard_map
 
 SP = 2
 B, S = 2, 16

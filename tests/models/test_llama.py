@@ -12,10 +12,7 @@ from pipegoose_tpu.distributed import ParallelContext
 from pipegoose_tpu.models import llama
 from pipegoose_tpu.models.hf import llama_params_from_hf
 
-try:
-    from jax import shard_map
-except ImportError:
-    from jax.experimental.shard_map import shard_map
+from pipegoose_tpu.distributed.compat import shard_map
 
 
 @pytest.fixture(scope="module")

@@ -10,10 +10,7 @@ from pipegoose_tpu.distributed import ParallelContext
 from pipegoose_tpu.models import mixtral
 from pipegoose_tpu.models.hf import mixtral_params_from_hf
 
-try:
-    from jax import shard_map
-except ImportError:
-    from jax.experimental.shard_map import shard_map
+from pipegoose_tpu.distributed.compat import shard_map
 
 
 @pytest.fixture(scope="module")

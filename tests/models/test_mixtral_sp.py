@@ -14,10 +14,7 @@ from pipegoose_tpu.distributed import ParallelContext
 from pipegoose_tpu.models import llama, mixtral
 from pipegoose_tpu.parallel.hybrid import sync_replicated_grads
 
-try:
-    from jax import shard_map
-except ImportError:
-    from jax.experimental.shard_map import shard_map
+from pipegoose_tpu.distributed.compat import shard_map
 
 B, S = 2, 16
 

@@ -14,10 +14,7 @@ from pipegoose_tpu.nn.expert_parallel import (
     moe_layer,
 )
 
-try:
-    from jax import shard_map
-except ImportError:
-    from jax.experimental.shard_map import shard_map
+from pipegoose_tpu.distributed.compat import shard_map
 
 H, E, T, FFN = 8, 4, 16, 32
 

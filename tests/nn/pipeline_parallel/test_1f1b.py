@@ -14,10 +14,7 @@ from pipegoose_tpu.distributed import ParallelContext
 from pipegoose_tpu.models import bloom
 from pipegoose_tpu.nn.pipeline_parallel.scheduler import one_f_one_b_tables
 
-try:
-    from jax import shard_map
-except ImportError:
-    from jax.experimental.shard_map import shard_map
+from pipegoose_tpu.distributed.compat import shard_map
 
 
 @pytest.mark.parametrize("M,Pp", [(4, 2), (8, 2), (8, 4), (4, 4), (1, 2), (6, 3)])

@@ -11,10 +11,7 @@ from jax.sharding import PartitionSpec as P
 from pipegoose_tpu.distributed import ParallelContext
 from pipegoose_tpu.nn.pipeline_parallel import gpipe, last_stage_value, merge, split
 
-try:
-    from jax import shard_map
-except ImportError:
-    from jax.experimental.shard_map import shard_map
+from pipegoose_tpu.distributed.compat import shard_map
 
 PP = 4
 L = 8  # total layers, 2 per stage

@@ -20,10 +20,7 @@ from pipegoose_tpu.nn.pipeline_parallel.partitioner import (
     repartition_blocks,
 )
 
-try:
-    from jax import shard_map
-except ImportError:
-    from jax.experimental.shard_map import shard_map
+from pipegoose_tpu.distributed.compat import shard_map
 
 L, PIPE = 6, 2
 RANGES = [range(0, 4), range(4, 6)]  # deliberately imbalanced 4/2

@@ -13,10 +13,7 @@ from pipegoose_tpu.nn.sequence_parallel import (
     ulysses_attention,
 )
 
-try:
-    from jax import shard_map
-except ImportError:
-    from jax.experimental.shard_map import shard_map
+from pipegoose_tpu.distributed.compat import shard_map
 
 SP = 4
 B, S, NH, HD = 2, 32, 4, 8

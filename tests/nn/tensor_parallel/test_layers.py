@@ -17,10 +17,7 @@ from pipegoose_tpu.nn.tensor_parallel import (
     vocab_parallel_embedding,
 )
 
-try:
-    from jax import shard_map
-except ImportError:
-    from jax.experimental.shard_map import shard_map
+from pipegoose_tpu.distributed.compat import shard_map
 
 TP = 4
 

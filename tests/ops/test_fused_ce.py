@@ -15,10 +15,7 @@ from pipegoose_tpu.nn.tensor_parallel.layers import (
 )
 from pipegoose_tpu.ops.fused_ce import fused_ce_sums
 
-try:
-    from jax import shard_map
-except ImportError:
-    from jax.experimental.shard_map import shard_map
+from pipegoose_tpu.distributed.compat import shard_map
 
 T, H, V = 24, 32, 128
 
@@ -217,6 +214,26 @@ def test_fused_hv_layout_matches_vh(data):
                                rtol=1e-4, atol=1e-5)
     with pytest.raises(ValueError, match="weight_layout"):
         fused_ce_sums(h, w, targets, token_w, weight_layout="hw")
+
+
+def test_infeasible_block_v_raises_compiled_passes_interpret(data):
+    """V_local with no feasible tile (no halving of block_v >= 8 divides
+    it) must fail loudly for compiled runs instead of dying in Mosaic —
+    but the interpreter has no VMEM limit, so the whole-vocab fallback
+    still runs there (and still matches the reference)."""
+    h, _, _, token_w = data
+    rng = np.random.RandomState(1)
+    # odd AND larger than the default block_v=512: no halving divides
+    # it, so the fallback would be a whole-vocab (1001, H) tile
+    v_odd = 1001
+    w = jnp.asarray(rng.randn(v_odd, H), jnp.float32) * 0.3
+    targets = jnp.asarray(rng.randint(0, v_odd, (T,)))
+    with pytest.raises(ValueError, match="VMEM-infeasible"):
+        fused_ce_sums(h, w, targets, token_w, interpret=False)
+    ref_tot, ref_cnt = _ref_sums(h, w, targets, token_w)
+    tot, cnt = fused_ce_sums(h, w, targets, token_w, interpret=True)
+    assert abs(float(tot) - float(ref_tot)) < 1e-3
+    assert float(cnt) == float(ref_cnt)
 
 
 def test_llama_and_mixtral_fused_ce_match_default(devices):

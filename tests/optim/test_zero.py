@@ -17,10 +17,7 @@ from pipegoose_tpu.optim.zero import (
     zero_param_spec,
 )
 
-try:
-    from jax import shard_map
-except ImportError:
-    from jax.experimental.shard_map import shard_map
+from pipegoose_tpu.distributed.compat import shard_map
 
 DP = 4
 

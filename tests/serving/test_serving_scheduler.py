@@ -1,0 +1,108 @@
+"""Continuous-batching scheduler lifecycle: FIFO admission against the
+page budget, lazy page growth, eviction/reclamation, and the
+``continuous=False`` degradation to naive padded batching."""
+import numpy as np
+import pytest
+
+from pipegoose_tpu.serving import PagePool, Request, Scheduler, Status
+
+
+def _req(prompt_len, max_new, eos=None):
+    return Request(
+        prompt=np.arange(1, prompt_len + 1, dtype=np.int64),
+        max_new_tokens=max_new, eos_token_id=eos,
+    )
+
+
+def test_submit_validates():
+    sched = Scheduler(2, PagePool(9, 4), max_context=32)
+    with pytest.raises(ValueError, match="empty prompt"):
+        sched.submit(_req(0, 4), now=0.0)
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        sched.submit(_req(4, 0), now=0.0)
+    with pytest.raises(ValueError, match="context"):
+        sched.submit(_req(30, 4), now=0.0)  # 34 > 32
+    with pytest.raises(ValueError, match="pool only"):
+        # fits max_context but not the pool: 8 allocatable pages = 32
+        # slots, yet max_context bounds at 32 too -> shrink the pool
+        sched2 = Scheduler(2, PagePool(5, 4), max_context=32)
+        sched2.submit(_req(20, 8), now=0.0)
+
+
+def test_admission_respects_worst_case_reservation():
+    """Second request's WORST case (not current use) must fit before it
+    is admitted, so lazy growth can never fail mid-flight."""
+    pool = PagePool(9, 4)  # 8 allocatable pages
+    sched = Scheduler(2, pool, max_context=32)
+    sched.submit(_req(8, 16), now=0.0)   # worst case 6 pages
+    sched.submit(_req(4, 8), now=0.0)    # worst case 3 pages -> 9 > 8
+    admitted = sched.admit(now=1.0)
+    assert [r.prompt_len for r in admitted] == [8]
+    assert admitted[0].status is Status.PREFILL
+    assert admitted[0].t_admit == 1.0
+    # prompt pages allocated eagerly, decode pages reserved lazily
+    assert len(admitted[0].pages) == 2
+    assert admitted[0].outstanding == 4
+    # head of line still queued: only 2 free-beyond-reservation pages
+    assert len(sched.queue) == 1
+    assert sched.admit(now=2.0) == []
+
+
+def test_lazy_growth_and_reclamation():
+    pool = PagePool(9, 4)
+    sched = Scheduler(1, pool, max_context=32)
+    sched.submit(_req(4, 5), now=0.0)  # worst 3 pages: 1 prompt + 2 decode
+    (req,) = sched.admit(now=0.0)
+    assert (len(req.pages), req.outstanding) == (1, 2)
+    for step, tok in enumerate([7, 7, 7, 7, 7]):
+        sched.ensure_page(req)
+        sched.record_token(req, tok, now=float(step))
+    # 4 prompt + 4 cached generated needed page 2 at the 5th token
+    assert req.status is Status.DONE and req.finish_reason == "length"
+    assert req.pages == [] and req.outstanding == 0
+    assert pool.used_count == 0 and sched._outstanding_total == 0
+    assert sched.all_done()
+
+
+def test_eos_finishes_early_and_frees_slot():
+    pool = PagePool(17, 4)
+    sched = Scheduler(2, pool, max_context=32)
+    sched.submit(_req(4, 8, eos=9), now=0.0)
+    sched.submit(_req(4, 8), now=0.0)
+    a, b = sched.admit(now=0.0)
+    sched.record_token(a, 9, now=1.0)  # eos on the first token
+    assert a.status is Status.DONE and a.finish_reason == "eos"
+    assert sched.slots[a.slot] is None  # slot reusable mid-stream
+    assert b.status is Status.PREFILL  # untouched
+    assert a.t_first_token == a.t_done == 1.0
+
+
+def test_continuous_refills_mid_stream_static_drains():
+    """The one-flag A/B the serving bench builds on: continuous admission
+    backfills a freed slot immediately; static waits for a full drain."""
+    def drive(continuous):
+        sched = Scheduler(2, PagePool(33, 4), max_context=32,
+                          continuous=continuous)
+        for _ in range(3):
+            sched.submit(_req(4, 4, eos=5), now=0.0)
+        first = sched.admit(now=0.0)
+        assert len(first) == 2
+        sched.record_token(first[0], 5, now=1.0)  # finishes, slot frees
+        sched.record_token(first[1], 1, now=1.0)  # still decoding
+        return sched.admit(now=2.0)
+
+    assert len(drive(continuous=True)) == 1   # backfilled mid-stream
+    assert len(drive(continuous=False)) == 0  # drains first
+
+
+def test_fifo_head_of_line_is_deterministic():
+    """A small request behind a too-big head does NOT jump the queue —
+    admission order is a pure function of submit order."""
+    pool = PagePool(5, 4)  # 4 allocatable pages
+    sched = Scheduler(2, pool, max_context=16)
+    sched.submit(_req(8, 8), now=0.0)   # 4 pages: admitted
+    sched.submit(_req(8, 8), now=0.0)   # 4 pages: blocked
+    sched.submit(_req(1, 1), now=0.0)   # 1 page: would fit, must wait
+    admitted = sched.admit(now=0.0)
+    assert [r.uid for r in admitted] == [0]
+    assert [r.uid for r in sched.queue] == [1, 2]

@@ -40,10 +40,7 @@ def test_pp_loss_matches_single_device(setup, devices):
     try:
         specs = bloom.pp_specs(params)
 
-        try:
-            from jax import shard_map
-        except ImportError:
-            from jax.experimental.shard_map import shard_map
+        from pipegoose_tpu.distributed.compat import shard_map
 
         fn = jax.jit(
             shard_map(

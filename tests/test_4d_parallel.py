@@ -25,10 +25,7 @@ from pipegoose_tpu.models import mixtral
 from pipegoose_tpu.optim.zero import DistributedOptimizer
 from pipegoose_tpu.parallel import make_hybrid_train_step
 
-try:
-    from jax import shard_map
-except ImportError:
-    from jax.experimental.shard_map import shard_map
+from pipegoose_tpu.distributed.compat import shard_map
 
 STEPS = 3
 BATCH, SEQ = 8, 12

@@ -24,6 +24,8 @@ CASES = [
     ("long_context.py", ["--fake-devices", "8"]),
     ("encoder_mlm.py", ["--fake-devices", "8", "--tp", "2", "--dp", "4",
                         "--seq", "32"]),
+    ("serve_bloom.py", ["--fake-devices", "8", "--tp", "2", "--requests",
+                        "4", "--max-context", "32"]),
 ]
 
 
