@@ -2,6 +2,11 @@
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 
+Also writes a telemetry JSONL artifact (``BENCH_TELEMETRY_JSONL``,
+default ``bench_telemetry.jsonl``; empty string disables): per-variant
+events, the serving engine's per-step time series, and a final metrics
+snapshot (pipegoose_tpu/telemetry/, docs/observability.md).
+
 The reference publishes no throughput numbers (BASELINE.md) — its
 acceptance bar is convergence only. ``vs_baseline`` therefore reports
 achieved MFU / 0.40, the north-star MFU threshold from BASELINE.json.
@@ -26,17 +31,6 @@ import sys
 import threading
 import time
 
-# per-chip peak bf16 FLOP/s
-PEAK_FLOPS = {
-    "v5 lite": 197e12,  # v5e
-    "v5e": 197e12,
-    "v5p": 459e12,
-    "v6 lite": 918e12,  # v6e (Trillium)
-    "v6e": 918e12,
-    "v4": 275e12,
-    "cpu": 1e12,  # nominal, CPU fallback only
-}
-
 PROBE_TIMEOUT_S = int(os.environ.get("BENCH_PROBE_TIMEOUT_S", "120"))
 TPU_BENCH_TIMEOUT_S = int(os.environ.get("BENCH_TPU_TIMEOUT_S", "1200"))
 # backend-attach retries: the axon tunnel is single-client, so a lingering
@@ -48,11 +42,12 @@ PROBE_BACKOFF_S = int(os.environ.get("BENCH_PROBE_BACKOFF_S", "45"))
 
 
 def _peak_flops(device_kind: str) -> float:
-    kind = device_kind.lower()
-    for k, v in PEAK_FLOPS.items():
-        if k in kind:
-            return v
-    return 1e12
+    # the peak table lives in telemetry.derived (single source of truth
+    # for the MFU denominator); import lazily — the parent process must
+    # not import jax-adjacent modules before spawning the child
+    from pipegoose_tpu.telemetry.derived import peak_flops_for
+
+    return peak_flops_for(device_kind)
 
 
 def _run_bench_child():
@@ -204,6 +199,24 @@ def run_bench(force_cpu: bool) -> None:
         "cpu-fallback" if force_cpu else "cpu"
     )
 
+    # telemetry JSONL artifact alongside the stdout JSON line: variant
+    # events + the serving engine's step time series + final snapshot.
+    # File I/O only — the one-JSON-line stdout contract is untouched.
+    from pipegoose_tpu import telemetry
+
+    reg = telemetry.get_registry()
+    tel_path = os.environ.get("BENCH_TELEMETRY_JSONL", "bench_telemetry.jsonl")
+    tel = None
+    if tel_path:
+        # enable ONLY when an artifact is wanted: an empty path opts out
+        # of the measurement overhead (fenced spans, histograms) too
+        reg.enable()
+        # mode="w": each run_bench invocation owns the artifact — a
+        # retried child attempt or the CPU fallback must not interleave
+        # with a previous attempt's stream
+        tel = telemetry.JSONLExporter(tel_path, registry=reg, mode="w")
+        reg.event("bench.start", device=device_kind, on_tpu=on_tpu)
+
     if on_tpu:
         steps = 10
         # variant -> (config, batch, seq); CHAMPION FIRST — the child
@@ -342,8 +355,18 @@ def run_bench(force_cpu: bool) -> None:
         sequence lengths (serving/engine.py A/B). Prompt lengths stay
         inside ONE page bucket so each arm compiles a single prefill
         program; the raggedness that padded batching pays for comes
-        from the mixed max_new_tokens."""
-        from pipegoose_tpu.serving import serving_ab_benchmark
+        from the mixed max_new_tokens.
+
+        Telemetry is DISABLED for the timed A/B — the continuous arm
+        would otherwise pay a JSONL write+flush per decode step that
+        the padded arm doesn't, skewing the reported speedup — and the
+        per-step time series is captured by ONE extra instrumented run
+        afterwards, outside the measurement."""
+        from pipegoose_tpu.serving import (
+            Request,
+            ServingEngine,
+            serving_ab_benchmark,
+        )
 
         if on_tpu:
             scfg = bloom.BloomConfig.bloom_560m(dtype=jnp.bfloat16)
@@ -360,7 +383,23 @@ def run_bench(force_cpu: bool) -> None:
             kw = dict(num_slots=2, num_pages=13, page_size=8,
                       max_context=32)
         sparams = bloom.init_params(scfg, jax.random.PRNGKey(1))
-        return serving_ab_benchmark(sparams, scfg, specs, **kw)
+        was_enabled = reg.enabled
+        reg.disable()
+        try:
+            res = serving_ab_benchmark(sparams, scfg, specs, **kw)
+        finally:
+            if was_enabled:
+                reg.enable()
+        if tel is not None:
+            srng = np.random.RandomState(0)
+            vocab = getattr(scfg, "valid_vocab_size", None) or scfg.vocab_size
+            engine = ServingEngine(sparams, scfg, **kw)
+            engine.run([
+                Request(prompt=srng.randint(1, vocab, (int(s),)),
+                        max_new_tokens=int(n))
+                for s, n in specs
+            ])
+        return res
 
     def emit(results, serving=None) -> bool:
         ok = {k: v for k, v in results.items() if "error" not in v}
@@ -402,6 +441,10 @@ def run_bench(force_cpu: bool) -> None:
                 results[name] = measure(cfg, b, seq)
                 results[name]["batch"] = b
                 results[name]["seq"] = seq
+                reg.gauge(f"bench.{name}.tokens_per_s").set(
+                    results[name]["tokens_per_sec"]
+                )
+                reg.gauge(f"bench.{name}.mfu").set(results[name]["mfu"])
                 break
             except Exception as e:  # noqa: BLE001
                 if "RESOURCE_EXHAUSTED" in str(e) and b > 1:
@@ -409,6 +452,7 @@ def run_bench(force_cpu: bool) -> None:
                     continue
                 results[name] = {"error": f"{type(e).__name__}: {e}"[:500]}
                 break
+        reg.event("bench.variant", name=name, **results[name])
         # cumulative emission (CHILD mode only — the parent filters to
         # the last line; in direct/fallback mode it would break the
         # one-JSON-line stdout contract): a later variant hanging or
@@ -422,6 +466,12 @@ def run_bench(force_cpu: bool) -> None:
         serving = serving_block()
     except Exception as e:  # noqa: BLE001
         serving = {"error": f"{type(e).__name__}: {e}"[:300]}
+    if tel is not None:
+        reg.event("bench.serving", **{
+            k: v for k, v in serving.items() if not isinstance(v, dict)
+        })
+        tel.export_snapshot(reg)
+        tel.close()
     if os.environ.get("BENCH_CHILD"):
         emit(results, serving)  # final cumulative line carries serving
         ok_any = bool({k: v for k, v in results.items() if "error" not in v})

@@ -14,6 +14,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from pipegoose_tpu.telemetry.spans import span
+
 # bounded: a long-lived process generating from many prompt lengths /
 # temperatures would otherwise retain every compiled program pair
 _JIT_CACHE: dict = {}
@@ -129,11 +131,18 @@ def autoregressive_generate(
         _JIT_CACHE[key] = _JIT_CACHE.pop(key)  # LRU refresh on hit
     prefill, decode_all = _JIT_CACHE[key]
 
-    first, cache = prefill(params, input_ids, cache, rng, extras)
+    # spans are no-ops unless telemetry is enabled; fencing then pins the
+    # prefill/decode device work to the right span (telemetry/spans.py)
+    with span("generate.prefill", attrs={"prompt_len": s, "batch": b}) as sp:
+        first, cache = prefill(params, input_ids, cache, rng, extras)
+        sp.fence(first)
     if max_new_tokens == 1:
         return jnp.concatenate([input_ids, first[:, None]], axis=1)
     keys = jax.random.split(jax.random.fold_in(rng, 1), max_new_tokens - 1)
-    rest = decode_all(params, first, cache, keys, extras)
+    with span("generate.decode",
+              attrs={"new_tokens": max_new_tokens, "batch": b}) as sp:
+        rest = decode_all(params, first, cache, keys, extras)
+        sp.fence(rest)
     out = jnp.concatenate([first[:, None], rest.T], axis=1)
     return jnp.concatenate([input_ids, out], axis=1)
 
@@ -230,5 +239,11 @@ def autoregressive_generate_sharded(
             check_vma=False,
         )
     )
-    out = fn(params, input_ids, extras)
+    # prefill + decode fuse into ONE shard_map program here, so a single
+    # span covers the whole sharded generation
+    with span("generate.sharded",
+              attrs={"prompt_len": s, "new_tokens": max_new_tokens,
+                     "batch": b, "tp": tp}) as sp:
+        out = fn(params, input_ids, extras)
+        sp.fence(out)
     return jnp.concatenate([input_ids, out], axis=1)

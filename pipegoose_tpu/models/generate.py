@@ -15,6 +15,12 @@ programs are shared across masks; ALiBi uses the mask-aware positions
 (build_alibi semantics) and pad slots stay masked as keys for the whole
 generation. Without a mask, prompts are assumed unpadded and plain
 global positions apply.
+
+Telemetry: the shared decode driver records ``generate.prefill`` /
+``generate.decode`` spans (fenced, so device work is attributed
+correctly) when the telemetry registry is enabled — see
+pipegoose_tpu/telemetry/ and docs/observability.md. Disabled, the spans
+are single-branch no-ops.
 """
 from __future__ import annotations
 

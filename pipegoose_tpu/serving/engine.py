@@ -28,6 +28,15 @@ Metrics follow utils/profiler.py's convention of returning plain dicts
 the caller can JSON-dump: per-request queue latency / TTFT / decode
 tok/s, plus aggregate slot and page occupancy (the utilization numbers
 that justify continuous batching over padded batches).
+
+The engine is additionally instrumented against the telemetry registry
+(pipegoose_tpu/telemetry/): queue-depth / occupancy gauges and events
+per decode step (a live TIME SERIES, where the end-of-run dict can only
+average), TTFT and per-token decode-latency histograms, token/prefill
+counters, and prefill/decode spans. Disabled-registry cost is one
+branch per site; pass ``registry=`` or enable the global one to record.
+The legacy aggregate dict keeps its exact keys — ``serving_ab_benchmark``
+and existing callers parse it.
 """
 from __future__ import annotations
 
@@ -54,6 +63,8 @@ from pipegoose_tpu.serving.kv_pool import (
     write_prompt_pages,
 )
 from pipegoose_tpu.serving.scheduler import Request, Scheduler, Status
+from pipegoose_tpu.telemetry.registry import get_registry
+from pipegoose_tpu.telemetry.spans import span
 
 
 @dataclass
@@ -65,6 +76,7 @@ class RequestOutput:
     queue_latency_s: float
     ttft_s: float
     decode_tokens_per_s: float
+    e2e_latency_s: float = 0.0  # submit -> done wall time
 
     @property
     def tokens(self) -> np.ndarray:
@@ -86,9 +98,26 @@ class ServingEngine:
     def __init__(self, params, config, *, num_slots: int = 4,
                  num_pages: int = 64, page_size: int = 16,
                  max_context: int = 256, mesh=None, param_specs=None,
-                 tp_axis: str = "tensor", continuous: bool = True):
+                 tp_axis: str = "tensor", continuous: bool = True,
+                 registry=None):
         if max_context % page_size:
             raise ValueError("max_context must be a multiple of page_size")
+        self.registry = registry if registry is not None else get_registry()
+        # resolve metric handles ONCE: inc/set/observe check the enabled
+        # flag themselves, so the hot loop's disabled cost stays one
+        # branch per site (no per-step registry lock + name lookup)
+        reg = self.registry
+        self._m_tokens = reg.counter("serving.tokens_total")
+        self._m_prefills = reg.counter("serving.prefills_total")
+        self._m_steps = reg.counter("serving.decode_steps_total")
+        self._m_ttft = reg.histogram("serving.ttft_seconds")
+        self._m_tok_lat = reg.histogram("serving.decode_token_seconds")
+        self._m_e2e = reg.histogram("serving.e2e_latency_seconds")
+        self._m_queue = reg.gauge("serving.queue_depth")
+        self._m_active = reg.gauge("serving.slots_active")
+        self._m_slot_occ = reg.gauge("serving.slot_occupancy")
+        self._m_page_occ = reg.gauge("serving.page_occupancy")
+        self._m_tps = reg.gauge("serving.tokens_per_s")
         self.params = params
         self.config = config
         self.num_slots = num_slots
@@ -177,31 +206,41 @@ class ServingEngine:
     def _prefill_request(self, req: Request, now) -> None:
         """Run the bucketed prefill, scatter the prompt KV into the
         request's pages, and record the first generated token."""
-        s = req.prompt_len
-        bucket = self.pool.pages_for(s) * self.page_size
-        pad = bucket - s
-        ids = np.zeros((1, bucket), np.int32)
-        ids[0, pad:] = np.asarray(req.prompt, np.int32)
-        mask = np.zeros((1, bucket), np.int32)
-        mask[0, pad:] = 1
-        tok, cache = self._prefill(
-            self.params, jnp.asarray(ids), jnp.asarray(mask)
-        )
-        phys = np.zeros((self.table_width,), np.int32)
-        phys[:len(req.pages)] = req.pages
-        self.k_pages, self.v_pages = self._write(
-            self.k_pages, self.v_pages, cache, jnp.asarray(phys),
-            jnp.asarray(pad, jnp.int32),
-        )
-        self.sched.record_token(req, int(np.asarray(tok)[0]), now())
+        with span("serving.prefill", registry=self.registry):
+            s = req.prompt_len
+            bucket = self.pool.pages_for(s) * self.page_size
+            pad = bucket - s
+            ids = np.zeros((1, bucket), np.int32)
+            ids[0, pad:] = np.asarray(req.prompt, np.int32)
+            mask = np.zeros((1, bucket), np.int32)
+            mask[0, pad:] = 1
+            tok, cache = self._prefill(
+                self.params, jnp.asarray(ids), jnp.asarray(mask)
+            )
+            phys = np.zeros((self.table_width,), np.int32)
+            phys[:len(req.pages)] = req.pages
+            self.k_pages, self.v_pages = self._write(
+                self.k_pages, self.v_pages, cache, jnp.asarray(phys),
+                jnp.asarray(pad, jnp.int32),
+            )
+            # the token fetch syncs the device, so the span's wall time
+            # covers the prefill's actual device work
+            self.sched.record_token(req, int(np.asarray(tok)[0]), now())
+        self._m_prefills.inc()
+        self._m_tokens.inc()  # the prefill's token
+        if req.t_first_token is not None and req.t_submit is not None:
+            self._m_ttft.observe(req.t_first_token - req.t_submit)
 
     # -- API ---------------------------------------------------------------
 
     def run(self, requests: Sequence[Request], now=time.perf_counter):
         """Serve ``requests`` to completion; returns
         (list[RequestOutput] in submit order, aggregate-metrics dict)."""
+        reg = self.registry
         for r in requests:
             self.sched.submit(r, now())
+        self._m_queue.set(len(self.sched.queue))
+        tok0 = self._m_tokens.value
         done: List[Request] = []
         steps = prefills = 0
         occ_slots = occ_pages = 0.0
@@ -216,6 +255,7 @@ class ServingEngine:
                 if req.status is Status.DONE:
                     done.append(req)
             active = self.sched.active()
+            self._m_queue.set(len(self.sched.queue))
             if not active:
                 continue  # everything admitted finished at prefill
             table.fill(0)
@@ -226,25 +266,47 @@ class ServingEngine:
                 table[req.slot, :len(req.pages)] = req.pages
                 seq_lens[req.slot] = req.cached_len
                 tokens[req.slot] = req.generated[-1]
-            nxt, self.k_pages, self.v_pages = self._step(
-                self.params, jnp.asarray(tokens), self.k_pages,
-                self.v_pages, jnp.asarray(table), jnp.asarray(seq_lens),
-            )
-            nxt = np.asarray(nxt)
+            t_step = now()
+            with span("serving.decode_step", registry=reg):
+                nxt, self.k_pages, self.v_pages = self._step(
+                    self.params, jnp.asarray(tokens), self.k_pages,
+                    self.v_pages, jnp.asarray(table), jnp.asarray(seq_lens),
+                )
+                nxt = np.asarray(nxt)  # host fetch syncs: span = device work
             t = now()
             steps += 1
-            occ_slots += len(active) / self.num_slots
-            occ_pages += self.pool.used_count / self.pool.capacity
+            slot_occ = len(active) / self.num_slots
+            page_occ = self.pool.used_count / self.pool.capacity
+            occ_slots += slot_occ
+            occ_pages += page_occ
+            # every active slot received exactly one token this step, so
+            # the step latency IS the per-token decode latency
+            self._m_tok_lat.observe(t - t_step)
+            self._m_steps.inc()
+            self._m_tokens.inc(len(active))
+            self._m_active.set(len(active))
+            self._m_slot_occ.set(slot_occ)
+            self._m_page_occ.set(page_occ)
+            # the occupancy TIME SERIES the end-of-run averages flatten
+            reg.event("serving.step", step=steps, active=len(active),
+                      queue_depth=len(self.sched.queue), dur_s=t - t_step,
+                      slot_occupancy=slot_occ, page_occupancy=page_occ)
             for req in active:
                 self.sched.record_token(req, int(nxt[req.slot]), t)
                 if req.status is Status.DONE:
                     done.append(req)
         wall = max(now() - t0, 1e-9)
+        # telemetry tokens/s from the COUNTER delta: cross-checks the
+        # per-step instrumentation against the legacy aggregate below
+        # (tests pin agreement within 1%)
+        self._m_tps.set((self._m_tokens.value - tok0) / wall)
 
         done.sort(key=lambda r: r.uid)
         outputs, per_request = [], []
         for r in done:
             decode_s = max(r.t_done - r.t_admit, 1e-9)
+            e2e = r.t_done - r.t_submit
+            self._m_e2e.observe(e2e)
             outputs.append(RequestOutput(
                 uid=r.uid, prompt=np.asarray(r.prompt),
                 generated=np.asarray(r.generated, np.int64),
@@ -252,6 +314,7 @@ class ServingEngine:
                 queue_latency_s=r.t_admit - r.t_submit,
                 ttft_s=r.t_first_token - r.t_submit,
                 decode_tokens_per_s=len(r.generated) / decode_s,
+                e2e_latency_s=e2e,
             ))
             per_request.append({
                 "uid": r.uid,
@@ -260,6 +323,7 @@ class ServingEngine:
                 "finish_reason": r.finish_reason,
                 "queue_latency_s": round(r.t_admit - r.t_submit, 6),
                 "ttft_s": round(r.t_first_token - r.t_submit, 6),
+                "e2e_latency_s": round(e2e, 6),
                 "decode_tokens_per_s": round(len(r.generated) / decode_s, 2),
             })
         generated = sum(len(o.generated) for o in outputs)

@@ -1,0 +1,72 @@
+"""Unified telemetry: metrics registry, span tracing, derived gauges
+(MFU / tokens/s / HBM / comm bytes), and JSONL + Prometheus exporters.
+
+The observability layer the reference never had (its
+``DistributedLogger`` was an empty stub and it had no timeline tracing,
+SURVEY.md §5). Library hot paths (trainer fit loop, serving engine,
+decode driver) are instrumented against the GLOBAL registry, which
+starts disabled — un-observed runs pay one branch per site. Turn it on
+with ``telemetry.enable()`` (or by adding a ``TelemetryCallback`` /
+constructing an engine with an enabled registry) and attach exporters:
+
+    from pipegoose_tpu import telemetry
+
+    telemetry.enable()
+    jsonl = telemetry.JSONLExporter("run.jsonl",
+                                    registry=telemetry.get_registry())
+    ...train / serve...
+    jsonl.export_snapshot()
+    telemetry.PrometheusTextfileExporter("run.prom").write(
+        telemetry.get_registry())
+
+See docs/observability.md for the metric catalog and the MFU
+methodology.
+"""
+from pipegoose_tpu.telemetry.callback import TelemetryCallback
+from pipegoose_tpu.telemetry.derived import (
+    PEAK_FLOPS,
+    collective_bytes,
+    compiled_step_stats,
+    hbm_utilization,
+    mfu,
+    peak_flops_for,
+    step_flops,
+    tokens_per_second,
+)
+from pipegoose_tpu.telemetry.exporters import (
+    JSONLExporter,
+    PrometheusTextfileExporter,
+)
+from pipegoose_tpu.telemetry.registry import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    disable,
+    enable,
+    get_registry,
+)
+from pipegoose_tpu.telemetry.spans import current_span_path, span
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "JSONLExporter",
+    "MetricsRegistry",
+    "PEAK_FLOPS",
+    "PrometheusTextfileExporter",
+    "TelemetryCallback",
+    "collective_bytes",
+    "compiled_step_stats",
+    "current_span_path",
+    "disable",
+    "enable",
+    "get_registry",
+    "hbm_utilization",
+    "mfu",
+    "peak_flops_for",
+    "span",
+    "step_flops",
+    "tokens_per_second",
+]

@@ -1,0 +1,189 @@
+"""Derived gauges: MFU, tokens/s, HBM occupancy, per-step comm bytes.
+
+The GSPMD / Mesh-TensorFlow lineage (arxiv 2105.04663, 1811.02084)
+treats the COMPILER's cost model as the ground truth for utilization on
+TPU: XLA already knows the per-step FLOPs and every collective it
+emitted. This module turns those into operator-facing numbers:
+
+- ``mfu``: achieved model-FLOPs utilization from ``compiled_cost``
+  FLOPs (utils/profiler.py) against the per-device peak-FLOPs table;
+- ``compiled_step_stats``: ONE lower+compile yielding flops, bytes
+  accessed, AND per-collective communication bytes parsed from the
+  compiled HLO (all-reduce / all-gather / reduce-scatter / all-to-all /
+  collective-permute output shapes);
+- ``hbm_utilization``: live HBM occupancy from ``device_memory_stats``
+  (empty off-TPU — CPU devices report no memory stats).
+
+``PEAK_FLOPS`` is the single source of truth for per-chip peak bf16
+FLOP/s — bench.py imports it from here rather than keeping its own
+copy.
+"""
+from __future__ import annotations
+
+import re
+from typing import Any, Callable, Dict, Optional
+
+import jax
+
+from pipegoose_tpu.utils.profiler import compiled_cost, device_memory_stats
+
+# per-chip peak bf16 FLOP/s (the MFU denominator; docs/observability.md
+# documents the sources). "cpu" is a nominal placeholder so CPU smoke
+# runs produce a finite, clearly-not-real number.
+PEAK_FLOPS: Dict[str, float] = {
+    "v5 lite": 197e12,  # v5e
+    "v5e": 197e12,
+    "v5p": 459e12,
+    "v6 lite": 918e12,  # v6e (Trillium)
+    "v6e": 918e12,
+    "v4": 275e12,
+    "cpu": 1e12,
+}
+
+
+def peak_flops_for(device_kind: Optional[str] = None) -> float:
+    """Peak FLOP/s for a device-kind string (substring match, like
+    bench.py always did); defaults to the first visible device."""
+    if device_kind is None:
+        dev = jax.devices()[0]
+        device_kind = getattr(dev, "device_kind", dev.platform)
+    kind = device_kind.lower()
+    for k, v in PEAK_FLOPS.items():
+        if k in kind:
+            return v
+    return 1e12
+
+
+def mfu(flops_per_step: float, step_seconds: float,
+        device_kind: Optional[str] = None, peak: Optional[float] = None,
+        n_devices: int = 1) -> float:
+    """Achieved / peak FLOP/s. ``flops_per_step`` is the WHOLE step's
+    model FLOPs (e.g. XLA's cost analysis of the jitted step);
+    ``n_devices`` divides the peak pool it ran against."""
+    if step_seconds <= 0:
+        return 0.0
+    peak = peak if peak is not None else peak_flops_for(device_kind)
+    return flops_per_step / step_seconds / (peak * max(n_devices, 1))
+
+
+def tokens_per_second(tokens: float, seconds: float) -> float:
+    return tokens / seconds if seconds > 0 else 0.0
+
+
+def hbm_utilization(device: Optional[Any] = None) -> dict:
+    """{"bytes_in_use", "bytes_limit", "utilization"} from the device's
+    live memory stats; {} where the backend reports none (CPU)."""
+    stats = device_memory_stats(device)
+    used = stats.get("bytes_in_use")
+    limit = stats.get("bytes_limit") or stats.get("bytes_reservable_limit")
+    if used is None:
+        return {}
+    out = {"bytes_in_use": int(used)}
+    if limit:
+        out["bytes_limit"] = int(limit)
+        out["utilization"] = used / limit
+    return out
+
+
+# -- communication accounting from compiled HLO ---------------------------
+
+_ITEMSIZE = {
+    "pred": 1, "s8": 1, "u8": 1, "f8e4m3fn": 1, "f8e5m2": 1,
+    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4,
+    "s64": 8, "u64": 8, "f64": 8, "c64": 8,
+    "c128": 16,
+}
+
+_COLLECTIVES = ("all-reduce", "all-gather", "reduce-scatter", "all-to-all",
+                "collective-permute")
+
+# "f32[8,128]" with optional layout suffix "{1,0}"
+_SHAPE_RE = re.compile(r"([a-z][a-z0-9]*)\[([0-9,]*)\]")
+
+
+def _shape_bytes_list(shape_part: str) -> list:
+    out = []
+    for dtype, dims in _SHAPE_RE.findall(shape_part):
+        size = _ITEMSIZE.get(dtype)
+        if size is None:
+            continue  # token/opaque types carry no payload
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        out.append(n * size)
+    return out
+
+
+def _shape_bytes(shape_part: str) -> int:
+    return sum(_shape_bytes_list(shape_part))
+
+
+def _async_start_bytes(shape_part: str) -> int:
+    """Output payload of an async ``-start`` result tuple, whose shape
+    is ``(operand..., output..., [context scalars])``: strip trailing
+    scalar contexts (<= 8 bytes, e.g. the u32[] slots of
+    collective-permute-start), then take the SECOND half — the output
+    buffers. Correct for asymmetric collectives too (all-gather output
+    > input, reduce-scatter output < input), where halving the summed
+    tuple would miscount."""
+    shapes = _shape_bytes_list(shape_part)
+    while len(shapes) > 2 and shapes[-1] <= 8:
+        shapes.pop()
+    if len(shapes) < 2:
+        return sum(shapes)  # unexpected non-tuple form: count as-is
+    return sum(shapes[len(shapes) // 2:])
+
+
+def collective_bytes(hlo_text: str) -> Dict[str, int]:
+    """Per-collective output bytes summed over an HLO module's text:
+    {"all-reduce": N, ..., "total": M}. Output-shape bytes are the
+    standard proxy for wire traffic (exact for all-reduce/all-gather
+    payloads; a ring all-reduce moves ~2x on the wire — this counts the
+    logical payload, the per-algorithm constant is the reader's)."""
+    out = {k: 0 for k in _COLLECTIVES}
+    for line in hlo_text.splitlines():
+        for op in _COLLECTIVES:
+            # "%name = f32[8,16]{1,0} all-reduce(..." — shape(s) sit
+            # between '=' and the op name; skip the "-done" async half
+            # (its result duplicates the "-start" tuple's output)
+            m = re.search(rf"=\s*(.*?)\s{op}(-start)?\(", line)
+            if m:
+                # async "-start" results are (operand..., output...)
+                # tuples: count only the output half
+                nbytes = (_async_start_bytes(m.group(1)) if m.group(2)
+                          else _shape_bytes(m.group(1)))
+                out[op] += nbytes
+                break
+    out["total"] = sum(out[k] for k in _COLLECTIVES)
+    return out
+
+
+def compiled_step_stats(fn: Callable, *args, **kwargs) -> dict:
+    """ONE lower+compile of ``jit(fn)`` at these arg shapes, returning
+    {"flops", "bytes_accessed", "comm_bytes", "comm_by_op"} — the
+    compiler-ground-truth inputs to the MFU and comms gauges."""
+    lowered = jax.jit(fn).lower(*args, **kwargs)
+    compiled = lowered.compile()
+    ca = compiled.cost_analysis()
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    ca = dict(ca or {})
+    try:
+        comm = collective_bytes(compiled.as_text())
+    except Exception:  # noqa: BLE001 - backends without HLO text export
+        comm = {"total": 0}
+    return {
+        "flops": float(ca.get("flops", 0.0)),
+        "bytes_accessed": float(ca.get("bytes accessed", 0.0)),
+        "comm_bytes": int(comm.get("total", 0)),
+        "comm_by_op": {k: v for k, v in comm.items()
+                       if k != "total" and v},
+    }
+
+
+def step_flops(fn: Callable, *args, **kwargs) -> float:
+    """XLA-reported FLOPs of one call of ``jit(fn)`` (compiled_cost
+    sugar for the common MFU input)."""
+    return float(compiled_cost(fn, *args, **kwargs).get("flops", 0.0))

@@ -1,0 +1,134 @@
+"""Telemetry exporters: JSONL event stream + Prometheus textfile.
+
+Two complementary shapes, both plain files (no daemon, no deps):
+
+- ``JSONLExporter`` — an append-only event stream (one JSON object per
+  line). Attach it to a registry and every ``registry.event(...)`` /
+  span exit lands as a line; ``export_snapshot`` additionally embeds a
+  full metrics snapshot as a ``"snapshot"`` event. The format bench.py
+  and scripts consume for time series (occupancy, step durations).
+- ``PrometheusTextfileExporter`` — the node-exporter textfile-collector
+  convention: one atomic snapshot file a scraper ingests. Written via
+  tmp+rename so a concurrent scrape never sees a torn file.
+
+Both reuse ``DistributedLogger``'s rank convention: only the process
+with ``jax.process_index() == rank`` writes (``rank=None`` = all
+processes, each should then get its own path). The process index is
+looked up lazily and cached after the first success, so constructing an
+exporter never forces backend initialization.
+"""
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import threading
+from typing import IO, Optional
+
+from pipegoose_tpu.telemetry.registry import MetricsRegistry
+from pipegoose_tpu.utils.procindex import RankFilter as _RankFilter
+
+
+class JSONLExporter:
+    """Append-only JSONL event sink (see module docstring).
+
+    Callable — satisfies the registry sink protocol — and attaches
+    itself when constructed with ``registry=``.
+    """
+
+    def __init__(self, path: str, registry: Optional[MetricsRegistry] = None,
+                 rank: Optional[int] = 0, mode: str = "a"):
+        """``mode="a"`` (default) appends across exporter lifetimes —
+        one long-lived stream; ``mode="w"`` truncates on first write,
+        for per-run artifacts (bench.py) where stale events from a
+        previous attempt must not interleave."""
+        if mode not in ("a", "w"):
+            raise ValueError(f"mode must be 'a' or 'w', got {mode!r}")
+        self.path = path
+        self._mode = mode
+        self._rank_ok = _RankFilter(rank)
+        self._file: Optional[IO[str]] = None
+        self._lock = threading.Lock()
+        self._registry = registry
+        if registry is not None:
+            registry.attach(self)
+
+    def _handle(self) -> Optional[IO[str]]:
+        if not self._rank_ok():
+            return None
+        if self._file is None:
+            d = os.path.dirname(os.path.abspath(self.path))
+            os.makedirs(d, exist_ok=True)
+            self._file = open(self.path, self._mode)
+        return self._file
+
+    def __call__(self, event: dict) -> None:
+        # serialize OUTSIDE the lock, then one locked write+flush: two
+        # threads sharing this sink (serving engine + trainer callback)
+        # must not interleave bytes into torn JSONL lines
+        line = json.dumps(event, default=_jsonable) + "\n"
+        with self._lock:
+            f = self._handle()
+            if f is None:
+                return
+            f.write(line)
+            f.flush()
+
+    def export_snapshot(self, registry: Optional[MetricsRegistry] = None) -> None:
+        """Write the full metrics snapshot as one ``"snapshot"`` event."""
+        reg = registry or self._registry
+        if reg is None:
+            raise ValueError("no registry to snapshot")
+        import time
+
+        self({"ts": time.time(), "kind": "snapshot", **reg.snapshot()})
+
+    def close(self) -> None:
+        if self._registry is not None:
+            self._registry.detach(self)
+        if self._file is not None:
+            self._file.close()
+            self._file = None
+
+    def __enter__(self) -> "JSONLExporter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+class PrometheusTextfileExporter:
+    """Atomic Prometheus text-exposition snapshot writer."""
+
+    def __init__(self, path: str, rank: Optional[int] = 0):
+        self.path = path
+        self._rank_ok = _RankFilter(rank)
+
+    def write(self, registry: MetricsRegistry) -> Optional[str]:
+        """Render ``registry`` and atomically replace ``self.path``;
+        returns the path written, or None when rank-filtered out."""
+        if not self._rank_ok():
+            return None
+        text = registry.to_prometheus()
+        d = os.path.dirname(os.path.abspath(self.path)) or "."
+        os.makedirs(d, exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=d, suffix=".prom.tmp")
+        try:
+            with os.fdopen(fd, "w") as f:
+                f.write(text)
+            os.replace(tmp, self.path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+        return self.path
+
+
+def _jsonable(x):
+    """Best-effort conversion for numpy/jax scalars reaching the stream."""
+    try:
+        return float(x)
+    except (TypeError, ValueError):
+        return repr(x)

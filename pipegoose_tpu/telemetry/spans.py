@@ -1,0 +1,131 @@
+"""Span tracing: named, nestable wall-time regions with device fencing.
+
+``with span("decode_step") as sp: ...`` records the region's wall time
+into the active registry as both a histogram
+(``span.<dotted.path>.seconds``) and a ``"span"`` event for the JSONL
+stream. Spans nest through a thread-local stack — a span opened inside
+another records under the joined path (``step.forward``) — which is how
+the per-step breakdown (data/forward/backward/optimizer/comms) is
+assembled without any global schema.
+
+**Fencing.** JAX dispatch is asynchronous: the host returns from a
+jitted call long before the device finishes, so a naive wall-time span
+around a dispatch measures enqueue cost, not work. ``sp.fence(x)``
+registers arrays to ``jax.block_until_ready`` at span exit so the
+device work that produced them is attributed to THIS span. Fencing only
+happens when the span is live (registry enabled) — disabled runs keep
+full async pipelining.
+
+**Jit safety.** ``span()`` returns a shared no-op when the registry is
+disabled OR a jit trace is in progress: entering a span inside a traced
+function must neither crash nor record trace-time (the fence would also
+be meaningless — you cannot block on a tracer). Guarded by
+tests/telemetry/test_spans.py.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Optional
+
+import jax
+
+from pipegoose_tpu.telemetry.registry import (
+    MetricsRegistry,
+    _tracing,
+    get_registry,
+)
+
+_tls = threading.local()
+
+
+def _stack() -> list:
+    s = getattr(_tls, "stack", None)
+    if s is None:
+        s = _tls.stack = []
+    return s
+
+
+class _NoopSpan:
+    """Shared disabled/trace-time span: every operation is a no-op."""
+
+    __slots__ = ()
+
+    def __enter__(self) -> "_NoopSpan":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        return False
+
+    def fence(self, *arrays: Any) -> None:
+        pass
+
+
+_NOOP = _NoopSpan()
+
+
+class Span:
+    __slots__ = ("name", "path", "_registry", "_attrs", "_t0", "_fences")
+
+    def __init__(self, name: str, registry: MetricsRegistry,
+                 attrs: Optional[dict] = None):
+        self.name = name
+        self.path = name  # finalized on __enter__ (nesting)
+        self._registry = registry
+        self._attrs = attrs
+        self._t0 = 0.0
+        self._fences: list = []
+
+    def fence(self, *arrays: Any) -> None:
+        """Block on these arrays at span exit so their device work lands
+        in this span's duration."""
+        self._fences.extend(arrays)
+
+    def __enter__(self) -> "Span":
+        stack = _stack()
+        self.path = ".".join([s.path for s in stack[-1:]] + [self.name])
+        stack.append(self)
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        for x in self._fences:
+            try:
+                jax.block_until_ready(x)
+            except Exception:  # noqa: BLE001 - non-array fence targets
+                pass
+        dur = time.perf_counter() - self._t0
+        stack = _stack()
+        if stack and stack[-1] is self:
+            stack.pop()
+        if exc_type is StopIteration:
+            # iterator-protocol control flow, not work: a span around
+            # `next(it)` (trainer.fit's data span) would otherwise log a
+            # phantom near-zero sample for the final exhausted pull,
+            # skewing the data-time quantiles it exists to report
+            return False
+        reg = self._registry
+        reg.histogram(f"span.{self.path}.seconds").observe(dur)
+        reg.event("span", span=self.path, dur_s=dur,
+                  **(self._attrs or {}))
+        return False
+
+
+def span(name: str, *, registry: Optional[MetricsRegistry] = None,
+         attrs: Optional[dict] = None):
+    """Context manager timing a named region (see module docstring).
+
+    Returns a shared no-op object when telemetry is disabled or a jit
+    trace is in progress — the disabled cost is one branch, safe to
+    leave in library hot loops.
+    """
+    reg = registry if registry is not None else get_registry()
+    if not reg._enabled or _tracing():
+        return _NOOP
+    return Span(name, reg, attrs)
+
+
+def current_span_path() -> Optional[str]:
+    """Dotted path of the innermost live span on this thread, or None."""
+    stack = _stack()
+    return stack[-1].path if stack else None

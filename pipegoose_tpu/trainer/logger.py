@@ -9,8 +9,11 @@ process 0 emits, matching the reference's intended rank-0 filtering.
 from __future__ import annotations
 
 import logging
+import os
 import sys
 from typing import Optional
+
+from pipegoose_tpu.utils.procindex import RankFilter
 
 
 class DistributedLogger:
@@ -24,6 +27,7 @@ class DistributedLogger:
         """``rank``: only this process index logs; None = all processes."""
         self.name = name
         self.rank = rank
+        self._rank_ok = RankFilter(rank)  # cached process-index check
         self._logger = logging.getLogger(name)
         self._logger.setLevel(level)
         self._logger.propagate = False  # avoid duplicate lines via root
@@ -38,7 +42,7 @@ class DistributedLogger:
             self._logger.addHandler(h)
         if logfile and not any(
             isinstance(h, logging.FileHandler)
-            and getattr(h, "baseFilename", None) == __import__("os").path.abspath(logfile)
+            and getattr(h, "baseFilename", None) == os.path.abspath(logfile)
             for h in self._logger.handlers
         ):
             fh = logging.FileHandler(logfile)
@@ -46,11 +50,10 @@ class DistributedLogger:
             self._logger.addHandler(fh)
 
     def _should_log(self) -> bool:
-        if self.rank is None:
-            return True
-        import jax
-
-        return jax.process_index() == self.rank
+        # process_index() cached after the first successful lookup (why
+        # that is safe: utils/procindex.py, shared with the telemetry
+        # exporters) instead of re-queried per line
+        return self._rank_ok()
 
     def info(self, msg: str) -> None:
         if self._should_log():

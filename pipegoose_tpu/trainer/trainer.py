@@ -16,6 +16,7 @@ from jax.sharding import PartitionSpec as P
 from pipegoose_tpu.distributed.parallel_context import ParallelContext
 from pipegoose_tpu.optim.zero import DistributedOptimizer
 from pipegoose_tpu.parallel.hybrid import make_hybrid_train_step
+from pipegoose_tpu.telemetry.spans import span
 from pipegoose_tpu.trainer.callback import Callback
 from pipegoose_tpu.trainer.logger import DistributedLogger
 from pipegoose_tpu.trainer.state import TrainerState, TrainerStatus
@@ -44,6 +45,10 @@ class Trainer:
         self.state = TrainerState()
         self.with_rng = with_rng
         self.tokens_per_step = 0  # updated from batch shapes each step
+        # TelemetryCallback's cost-probe input: valid only DURING the
+        # step-end callback round, cleared right after so the trainer
+        # never pins a batch past its step
+        self.last_batch: Any = None
 
         init_fn, make_step = make_hybrid_train_step(
             loss_fn,
@@ -229,7 +234,11 @@ class Trainer:
                 if max_steps is not None and self.state.step >= max_steps:
                     break
                 try:
-                    batch = next(it)
+                    # disabled-registry spans are one branch; enabled,
+                    # they split host-side data time from step dispatch
+                    # in the JSONL stream (telemetry/spans.py)
+                    with span("train.data"):
+                        batch = next(it)
                 except StopIteration:
                     break
                 step = self.state.step
@@ -237,10 +246,15 @@ class Trainer:
                     cb.on_step_start(self, step)
                 leaves = jax.tree_util.tree_leaves(batch)
                 self.tokens_per_step = int(leaves[0].size) if leaves else 0
+                self.last_batch = batch
                 args = (self.params, self.opt_state, batch)
                 if self.with_rng:
                     args = args + (jax.random.fold_in(rng, step),)
-                self.params, self.opt_state, loss = self._step_fn(*args)
+                # UNFENCED: measures dispatch; in steady state the queue
+                # backpressures to device step time. TelemetryCallback
+                # (fence=True) gives exact per-step device attribution.
+                with span("train.step"):
+                    self.params, self.opt_state, loss = self._step_fn(*args)
                 # keep loss as a device array: float() here would block the
                 # host every step and kill JAX's async dispatch; callbacks
                 # convert only when they actually log
@@ -249,6 +263,7 @@ class Trainer:
                 self.state.losses.append(loss)
                 for cb in self.callbacks:
                     cb.on_step_end(self, self.state.step, loss)
+                self.last_batch = None  # don't pin the batch past its step
         except KeyboardInterrupt:
             self.state.status = TrainerStatus.INTERRUPTED
             self.logger.warning("interrupted")
@@ -259,6 +274,11 @@ class Trainer:
             # callers inspect trainer.state after fit() raises
             self.state.status = TrainerStatus.FAILED
             raise
+        finally:
+            # the per-iteration clear misses aborted steps (an OOM raise
+            # or interrupt between assignment and clear would pin the
+            # batch for the trainer's lifetime)
+            self.last_batch = None
         self.state.status = TrainerStatus.FINISHED
         for cb in self.callbacks:
             cb.on_fit_end(self)

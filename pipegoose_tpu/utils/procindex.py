@@ -1,0 +1,33 @@
+"""Shared lazily-cached 'is this the emitting process?' check.
+
+Used by ``trainer.logger.DistributedLogger`` (rank-filtered logging)
+and the telemetry exporters (rank-filtered file writes) so the caching
+subtlety lives in exactly one place.
+
+Caching after the first successful lookup is safe: ``process_index()``
+forces backend initialization, and ``jax.distributed.initialize()``
+RAISES once any backend exists, so the process topology (and this
+index) cannot change after a successful lookup. The jax import stays
+lazy — constructing a filter must not force backend init.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+
+class RankFilter:
+    __slots__ = ("rank", "_idx")
+
+    def __init__(self, rank: Optional[int]):
+        """``rank``: only this process index passes; None = all do."""
+        self.rank = rank
+        self._idx: Optional[int] = None
+
+    def __call__(self) -> bool:
+        if self.rank is None:
+            return True
+        if self._idx is None:
+            import jax
+
+            self._idx = jax.process_index()
+        return self._idx == self.rank

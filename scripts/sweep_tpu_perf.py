@@ -241,9 +241,17 @@ def serving_sweep():
     params = bloom.init_params(cfg, jax.random.PRNGKey(1))
     specs = [(10, 50), (30, 15), (20, 35), (5, 60), (28, 25), (12, 8),
              (25, 45), (8, 22), (17, 40), (22, 12), (9, 55), (14, 30)]
+    # timed A/B runs with telemetry DISABLED: the continuous arm would
+    # otherwise pay per-step event I/O the padded arm doesn't (the
+    # __main__ wiring re-enables for the end-of-run snapshot)
+    from pipegoose_tpu import telemetry
+
+    reg = telemetry.get_registry()
+    was_enabled = reg.enabled
     results = {}
     for slots in (2, 4, 8):
         label = f"slots{slots}"
+        reg.disable()
         try:
             results[label] = serving_ab_benchmark(
                 params, cfg, specs, num_slots=slots,
@@ -251,14 +259,42 @@ def serving_sweep():
             )
         except Exception as e:  # noqa: BLE001
             results[label] = {"error": f"{type(e).__name__}: {e}"[:300]}
+        finally:
+            if was_enabled:
+                reg.enable()
+        reg.event("sweep.result", label=label, **{
+            k: v for k, v in results[label].items()
+            if not isinstance(v, dict)
+        })
         print(label, json.dumps(results[label]), flush=True)
     print(json.dumps(results))
 
 
 if __name__ == "__main__":
+    import os
+
     mode = sys.argv[1] if len(sys.argv) > 1 else "kernel"
     modes = {"kernel": kernel_sweep, "model": model_sweep,
              "fusedce": fusedce_sweep, "serving": serving_sweep}
     if mode not in modes:
         raise SystemExit(f"unknown mode {mode!r}; pick one of {sorted(modes)}")
-    modes[mode]()
+    # telemetry JSONL artifact (the serving sweep's engines emit their
+    # per-step time series into it; every mode gets a final snapshot) —
+    # set SWEEP_TELEMETRY_JSONL="" to disable
+    from pipegoose_tpu import telemetry
+
+    tel_path = os.environ.get(
+        "SWEEP_TELEMETRY_JSONL", f"sweep_{mode}_telemetry.jsonl"
+    )
+    tel = None
+    if tel_path:
+        reg = telemetry.get_registry()
+        reg.enable()
+        tel = telemetry.JSONLExporter(tel_path, registry=reg, mode="w")
+        reg.event("sweep.start", mode=mode)
+    try:
+        modes[mode]()
+    finally:
+        if tel is not None:
+            tel.export_snapshot(telemetry.get_registry())
+            tel.close()

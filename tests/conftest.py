@@ -57,6 +57,10 @@ FAST_FILES = {
     "tests/models/test_generate.py",            # KV-cache decode
     "tests/serving/test_kv_pool.py",            # paged-KV allocator/gather
     "tests/serving/test_serving_scheduler.py",  # continuous-batching lifecycle
+    "tests/telemetry/test_registry.py",         # metrics + <5µs overhead guard
+    "tests/telemetry/test_spans.py",            # span tracing + jit safety
+    "tests/telemetry/test_exporters.py",        # JSONL / Prometheus / rank-0
+    "tests/utils/test_profiler.py",             # cost analysis arithmetic
 }
 FAST_TESTS = {
     # TP layers + losses
@@ -110,6 +114,9 @@ FAST_TESTS = {
     # serving: continuous batching == per-request generate, 1-device + tp
     "tests/serving/test_engine.py::test_mixed_lengths_token_identical_to_generate",
     "tests/serving/test_engine.py::test_tp_sharded_serving_matches_generate[2]",
+    # telemetry: engine instrumentation vs legacy dict + compiled comms
+    "tests/serving/test_engine.py::test_engine_telemetry_agrees_with_legacy_metrics",
+    "tests/telemetry/test_derived.py::test_compiled_step_stats_reports_flops_and_comms",
     # memory dry passes (analytic only; the AOT compile is `slow`)
     "tests/test_8x7b_memory.py::test_8x7b_param_count",
     "tests/test_8x7b_memory.py::test_8x7b_fits_v5p64_4d_sharding",

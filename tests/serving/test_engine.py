@@ -145,6 +145,85 @@ def test_tp_sharded_serving_matches_generate(setup, devices, tp):
         ctx.destroy()
 
 
+def test_engine_telemetry_agrees_with_legacy_metrics(setup):
+    """ISSUE 2 acceptance: the per-step telemetry instrumentation and
+    the legacy end-of-run aggregate dict describe the SAME run — token
+    counters match exactly, derived tokens/s within 1% — and the new
+    per-request latency fields are consistent."""
+    from pipegoose_tpu.telemetry import MetricsRegistry
+
+    cfg, params, prompts = setup
+    reg = MetricsRegistry(enabled=True)
+    eng = ServingEngine(params, cfg, num_slots=3, num_pages=32,
+                        page_size=4, max_context=64, registry=reg)
+    outs, metrics = eng.run([
+        Request(prompt=p, max_new_tokens=n)
+        for p, (_, n) in zip(prompts, MIXED)
+    ])
+    snap = reg.snapshot()
+    # counters vs aggregates: exact
+    assert snap["counters"]["serving.tokens_total"] == metrics["generated_tokens"]
+    assert snap["counters"]["serving.prefills_total"] == metrics["prefills"]
+    assert snap["counters"]["serving.decode_steps_total"] == metrics["decode_steps"]
+    # derived throughput: within 1% of the legacy dict
+    tel_tps = snap["gauges"]["serving.tokens_per_s"]
+    assert tel_tps == pytest.approx(metrics["decode_tokens_per_s"], rel=0.01)
+    # latency histograms: one TTFT per request, one decode observation
+    # per step, e2e recorded for every finished request
+    assert snap["histograms"]["serving.ttft_seconds"]["count"] == len(MIXED)
+    assert (snap["histograms"]["serving.decode_token_seconds"]["count"]
+            == metrics["decode_steps"])
+    assert snap["histograms"]["serving.e2e_latency_seconds"]["count"] == len(MIXED)
+    # per-request outputs carry the new submit->done latency, consistent
+    # with TTFT and the dict
+    for o, pr in zip(outs, metrics["requests"]):
+        assert o.e2e_latency_s >= o.ttft_s > 0
+        assert pr["e2e_latency_s"] == pytest.approx(o.e2e_latency_s, abs=1e-5)
+
+
+def test_engine_telemetry_step_events_time_series(setup):
+    """The engine emits a live occupancy time series (events), not just
+    the end-of-run averages."""
+    from pipegoose_tpu.telemetry import MetricsRegistry
+
+    cfg, params, prompts = setup
+    reg = MetricsRegistry(enabled=True)
+    events = []
+    reg.attach(events.append)
+    eng = ServingEngine(params, cfg, num_slots=2, num_pages=32,
+                        page_size=4, max_context=64, registry=reg)
+    _, metrics = eng.run([
+        Request(prompt=p, max_new_tokens=n)
+        for p, (_, n) in zip(prompts[:4], MIXED[:4])
+    ])
+    steps = [e for e in events if e["kind"] == "serving.step"]
+    assert len(steps) == metrics["decode_steps"]
+    assert all(0 < e["slot_occupancy"] <= 1 for e in steps)
+    assert all(e["dur_s"] > 0 for e in steps)
+    # the mean of the time series equals the dict's aggregate
+    mean_occ = sum(e["slot_occupancy"] for e in steps) / len(steps)
+    assert mean_occ == pytest.approx(metrics["slot_occupancy"], abs=1e-3)
+    spans = [e for e in events if e["kind"] == "span"]
+    assert {"serving.prefill", "serving.decode_step"} <= {
+        e["span"] for e in spans
+    }
+
+
+def test_engine_default_registry_disabled_records_nothing(setup):
+    """Without opt-in the engine's instrumentation must leave the global
+    registry untouched (the near-zero-overhead contract)."""
+    from pipegoose_tpu.telemetry import get_registry
+
+    cfg, params, prompts = setup
+    reg = get_registry()
+    assert not reg.enabled  # tests never enable the global registry
+    eng = ServingEngine(params, cfg, num_slots=2, num_pages=32,
+                        page_size=4, max_context=64)
+    eng.run([Request(prompt=prompts[0], max_new_tokens=3)])
+    snap = reg.snapshot()
+    assert snap["counters"].get("serving.tokens_total", 0.0) == 0.0
+
+
 def test_serving_ab_benchmark_reports_speedup(setup):
     """The bench entry point returns both arms + occupancy numbers."""
     cfg, params, _ = setup

@@ -1,0 +1,100 @@
+"""TelemetryCallback on a real (tiny) hybrid Trainer run: per-step
+histograms/counters/gauges, the auto cost probe's MFU + comm-bytes
+gauges, and the JSONL stream."""
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from pipegoose_tpu.distributed import ParallelContext
+from pipegoose_tpu.models import bloom
+from pipegoose_tpu.optim.zero import DistributedOptimizer
+from pipegoose_tpu.telemetry import MetricsRegistry, TelemetryCallback
+from pipegoose_tpu.trainer import Trainer
+
+
+@pytest.fixture()
+def parts(devices):
+    cfg = bloom.BloomConfig(vocab_size=64, hidden_size=32, n_layer=2, n_head=2)
+    params = bloom.init_params(cfg, jax.random.PRNGKey(0))
+    ctx = ParallelContext(tensor_parallel_size=2, data_parallel_size=4)
+    yield cfg, params, ctx
+    ctx.destroy()
+
+
+def _fit(parts, cb, steps=3, batch=8, seq=8):
+    cfg, params, ctx = parts
+
+    def loss_fn(p, ids):
+        return bloom.loss_fn(p, ids, None, ids, cfg, tp_axis="tensor")
+
+    trainer = Trainer(
+        loss_fn, params, bloom.tp_specs(params),
+        DistributedOptimizer(optax.adam(1e-3), axis_name="data"),
+        ctx, callbacks=[cb],
+    )
+    rng = np.random.RandomState(0)
+    ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, seq)))
+    trainer.fit([ids] * steps)
+    return trainer
+
+
+def test_callback_records_step_metrics_and_jsonl(parts, tmp_path):
+    reg = MetricsRegistry(enabled=False)  # callback enables it
+    jl = str(tmp_path / "t.jsonl")
+    cb = TelemetryCallback(registry=reg, jsonl=jl, fence=True)
+    _fit(parts, cb, steps=3)
+
+    assert reg.enabled
+    snap = reg.snapshot()
+    assert snap["counters"]["train.steps_total"] == 3
+    assert snap["counters"]["train.tokens_total"] == 3 * 8 * 8
+    assert snap["histograms"]["train.step_seconds"]["count"] == 3
+    assert snap["gauges"]["train.tokens_per_s"] > 0
+    # fit-loop spans recorded against the SAME registry? No — the fit
+    # loop instruments the GLOBAL registry; this callback used its own.
+    # The per-step timing above is the callback's, by design.
+
+    lines = [json.loads(l) for l in open(jl)]
+    kinds = [l["kind"] for l in lines]
+    assert kinds[0] == "train.fit_start"
+    assert kinds.count("train.step") == 3
+    assert kinds[-2] == "train.fit_end"
+    assert kinds[-1] == "snapshot"  # on_fit_end exports the snapshot
+    step_ev = next(l for l in lines if l["kind"] == "train.step")
+    assert step_ev["tokens_per_s"] > 0 and step_ev["dur_s"] > 0
+
+
+def test_auto_cost_probe_sets_mfu_and_comm_gauges(parts):
+    reg = MetricsRegistry(enabled=True)
+    cb = TelemetryCallback(registry=reg, auto_cost=True, fence=True,
+                           device_kind="cpu")
+    _fit(parts, cb, steps=2)
+    snap = reg.snapshot()
+    assert snap["gauges"]["train.flops_per_step"] > 0
+    assert 0 < snap["gauges"]["train.mfu"] < 1
+    # the tp=2 x dp=4 hybrid step all-reduces/gathers: comm bytes > 0
+    assert snap["gauges"]["train.comm_bytes_per_step"] > 0
+
+
+def test_explicit_flops_skips_probe(parts):
+    reg = MetricsRegistry(enabled=True)
+    cb = TelemetryCallback(registry=reg, flops_per_step=1e9,
+                           device_kind="cpu")
+    _fit(parts, cb, steps=2)
+    snap = reg.snapshot()
+    assert snap["gauges"]["train.mfu"] > 0
+    assert "train.flops_per_step" not in snap["gauges"]  # no probe ran
+
+
+def test_prom_written_on_fit_end(parts, tmp_path):
+    prom = str(tmp_path / "m.prom")
+    reg = MetricsRegistry(enabled=True)
+    cb = TelemetryCallback(registry=reg, prom=prom)
+    _fit(parts, cb, steps=2)
+    text = open(prom).read()
+    assert "train_steps_total 2.0" in text
+    assert "# TYPE train_step_seconds histogram" in text

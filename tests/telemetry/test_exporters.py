@@ -1,0 +1,92 @@
+"""Exporters: JSONL event stream round-trip, Prometheus textfile
+atomicity/content, and the rank-0 DistributedLogger convention."""
+import json
+import os
+
+from pipegoose_tpu.telemetry import (
+    JSONLExporter,
+    MetricsRegistry,
+    PrometheusTextfileExporter,
+)
+
+
+def _reg():
+    reg = MetricsRegistry(enabled=True)
+    reg.counter("tok.total").inc(42)
+    reg.gauge("tps").set(1234.5)
+    reg.histogram("lat.seconds").observe(0.02)
+    return reg
+
+
+def test_jsonl_events_and_snapshot_roundtrip(tmp_path):
+    path = str(tmp_path / "events.jsonl")
+    reg = _reg()
+    with JSONLExporter(path, registry=reg) as ex:
+        reg.event("step", i=0, tokens_per_s=10.0)
+        reg.event("step", i=1, tokens_per_s=12.0)
+        ex.export_snapshot()
+    lines = [json.loads(l) for l in open(path)]
+    assert [l["kind"] for l in lines] == ["step", "step", "snapshot"]
+    assert lines[1]["tokens_per_s"] == 12.0
+    snap = lines[2]
+    assert snap["counters"]["tok.total"] == 42.0
+    assert snap["gauges"]["tps"] == 1234.5
+    assert snap["histograms"]["lat.seconds"]["count"] == 1
+
+
+def test_jsonl_close_detaches_sink(tmp_path):
+    path = str(tmp_path / "e.jsonl")
+    reg = _reg()
+    ex = JSONLExporter(path, registry=reg)
+    reg.event("a")
+    ex.close()
+    reg.event("b")  # after close: not written
+    kinds = [json.loads(l)["kind"] for l in open(path)]
+    assert kinds == ["a"]
+
+
+def test_jsonl_serializes_numpy_scalars(tmp_path):
+    import numpy as np
+
+    path = str(tmp_path / "np.jsonl")
+    reg = _reg()
+    with JSONLExporter(path, registry=reg):
+        reg.event("x", v=np.float32(1.5), n=np.int64(3))
+    (line,) = [json.loads(l) for l in open(path)]
+    assert line["v"] == 1.5 and line["n"] == 3
+
+
+def test_prometheus_textfile_write(tmp_path):
+    path = str(tmp_path / "metrics.prom")
+    reg = _reg()
+    out = PrometheusTextfileExporter(path).write(reg)
+    assert out == path
+    text = open(path).read()
+    assert "tok_total 42.0" in text
+    assert "tps 1234.5" in text
+    assert 'lat_seconds_bucket{le="+Inf"} 1' in text
+    # atomic write leaves no temp litter
+    assert [f for f in os.listdir(tmp_path) if f.endswith(".tmp")] == []
+
+
+def test_rank_filter_suppresses_non_matching_rank(tmp_path):
+    """Rank filtering reuses the DistributedLogger convention: only
+    jax.process_index() == rank writes. This single-process test IS
+    process 0, so rank=1 exporters must produce nothing."""
+    jl = str(tmp_path / "r1.jsonl")
+    reg = _reg()
+    ex = JSONLExporter(jl, registry=reg, rank=1)
+    reg.event("x")
+    ex.export_snapshot()
+    ex.close()
+    assert not os.path.exists(jl)
+
+    prom = str(tmp_path / "r1.prom")
+    assert PrometheusTextfileExporter(prom, rank=1).write(reg) is None
+    assert not os.path.exists(prom)
+
+    # rank=None: every process writes
+    all_path = str(tmp_path / "all.jsonl")
+    with JSONLExporter(all_path, registry=reg):
+        reg.event("y")
+    assert os.path.exists(all_path)

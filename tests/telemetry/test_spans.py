@@ -1,0 +1,118 @@
+"""Span tracing: nesting paths, fencing, event emission, and the
+jit-trace no-op regression (ISSUE 2: spans entered inside traced code
+must neither crash nor record)."""
+import jax
+import jax.numpy as jnp
+
+from pipegoose_tpu.telemetry import MetricsRegistry, span
+from pipegoose_tpu.telemetry.spans import _NOOP, current_span_path
+
+
+def test_span_records_histogram_and_event():
+    reg = MetricsRegistry(enabled=True)
+    events = []
+    reg.attach(events.append)
+    with span("load", registry=reg, attrs={"shard": 3}):
+        pass
+    h = reg.histogram("span.load.seconds")
+    assert h.count == 1
+    assert h.sum >= 0
+    (ev,) = events
+    assert ev["kind"] == "span" and ev["span"] == "load" and ev["shard"] == 3
+    assert ev["dur_s"] >= 0
+
+
+def test_nested_spans_join_paths():
+    reg = MetricsRegistry(enabled=True)
+    with span("step", registry=reg):
+        assert current_span_path() == "step"
+        with span("forward", registry=reg):
+            assert current_span_path() == "step.forward"
+            with span("attn", registry=reg):
+                assert current_span_path() == "step.forward.attn"
+        with span("backward", registry=reg):
+            assert current_span_path() == "step.backward"
+    assert current_span_path() is None
+    hists = set(reg.snapshot()["histograms"])
+    assert {
+        "span.step.seconds",
+        "span.step.forward.seconds",
+        "span.step.forward.attn.seconds",
+        "span.step.backward.seconds",
+    } <= hists
+
+
+def test_fence_blocks_on_device_work():
+    reg = MetricsRegistry(enabled=True)
+    with span("compute", registry=reg) as sp:
+        x = jax.jit(lambda a: (a @ a).sum())(jnp.ones((64, 64)))
+        sp.fence(x)
+    assert reg.histogram("span.compute.seconds").count == 1
+    # fencing a non-array must not raise
+    with span("odd", registry=reg) as sp:
+        sp.fence(object())
+    assert reg.histogram("span.odd.seconds").count == 1
+
+
+def test_disabled_registry_returns_shared_noop():
+    reg = MetricsRegistry(enabled=False)
+    s = span("x", registry=reg)
+    assert s is _NOOP
+    with s as sp:
+        sp.fence(jnp.ones(2))  # all no-ops
+    assert reg.snapshot()["histograms"] == {}
+
+
+def test_span_inside_jit_noops_cleanly():
+    """Regression: a span (and metrics) inside a jitted body is a clean
+    no-op — compiled fn still runs, nothing is recorded, repeated
+    executions don't accumulate phantom trace-time."""
+    reg = MetricsRegistry(enabled=True)
+    c = reg.counter("inner.count")
+
+    @jax.jit
+    def f(a):
+        with span("traced", registry=reg) as sp:
+            c.inc()
+            sp.fence(a)  # fencing a tracer must not raise
+            return a + 1
+
+    for _ in range(4):
+        out = f(jnp.zeros(3))
+    assert list(out) == [1.0, 1.0, 1.0]
+    assert c.value == 0.0
+    assert not any(
+        "traced" in k for k in reg.snapshot()["histograms"]
+    )
+
+
+def test_exception_inside_span_still_pops_stack():
+    reg = MetricsRegistry(enabled=True)
+    try:
+        with span("boom", registry=reg):
+            raise RuntimeError("x")
+    except RuntimeError:
+        pass
+    assert current_span_path() is None
+    # the aborted span still recorded its duration (observability of
+    # failing regions is the point)
+    assert reg.histogram("span.boom.seconds").count == 1
+
+
+def test_stopiteration_exit_not_recorded():
+    """A span around `next(it)` (trainer.fit's data span) must not log a
+    phantom sample for the final exhausted pull — StopIteration is
+    control flow, not work."""
+    reg = MetricsRegistry(enabled=True)
+    it = iter([1, 2])
+    pulls = 0
+    while True:
+        try:
+            with span("data", registry=reg):
+                next(it)
+            pulls += 1
+        except StopIteration:
+            break
+    assert pulls == 2
+    assert current_span_path() is None
+    assert reg.histogram("span.data.seconds").count == 2  # not 3

@@ -26,6 +26,9 @@ CASES = [
                         "--seq", "32"]),
     ("serve_bloom.py", ["--fake-devices", "8", "--tp", "2", "--requests",
                         "4", "--max-context", "32"]),
+    ("telemetry_demo.py", ["--fake-devices", "8", "--tp", "2", "--dp", "4",
+                           "--requests", "4", "--out-dir",
+                           "/tmp/pipegoose_telemetry_demo_test"]),
 ]
 
 
