@@ -5,7 +5,10 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 Also writes a telemetry JSONL artifact (``BENCH_TELEMETRY_JSONL``,
 default ``bench_telemetry.jsonl``; empty string disables): per-variant
 events, the serving engine's per-step time series, and a final metrics
-snapshot (pipegoose_tpu/telemetry/, docs/observability.md).
+snapshot (pipegoose_tpu/telemetry/, docs/observability.md) — plus a
+sibling Perfetto timeline (``BENCH_TRACE_JSON``, default
+``bench_telemetry_trace.json``; open in ui.perfetto.dev) of the same
+run's spans.
 
 The reference publishes no throughput numbers (BASELINE.md) — its
 acceptance bar is convergence only. ``vs_baseline`` therefore reports
@@ -206,7 +209,7 @@ def run_bench(force_cpu: bool) -> None:
 
     reg = telemetry.get_registry()
     tel_path = os.environ.get("BENCH_TELEMETRY_JSONL", "bench_telemetry.jsonl")
-    tel = None
+    tel = trace = None
     if tel_path:
         # enable ONLY when an artifact is wanted: an empty path opts out
         # of the measurement overhead (fenced spans, histograms) too
@@ -215,6 +218,13 @@ def run_bench(force_cpu: bool) -> None:
         # retried child attempt or the CPU fallback must not interleave
         # with a previous attempt's stream
         tel = telemetry.JSONLExporter(tel_path, registry=reg, mode="w")
+        # sibling Perfetto timeline of the same run (ui.perfetto.dev);
+        # same opt-out, same per-run ownership (write() replaces)
+        trace_path = os.environ.get(
+            "BENCH_TRACE_JSON", os.path.splitext(tel_path)[0] + "_trace.json"
+        )
+        if trace_path:
+            trace = telemetry.ChromeTraceExporter(trace_path, registry=reg)
         reg.event("bench.start", device=device_kind, on_tpu=on_tpu)
 
     if on_tpu:
@@ -472,6 +482,9 @@ def run_bench(force_cpu: bool) -> None:
         })
         tel.export_snapshot(reg)
         tel.close()
+    if trace is not None:
+        trace.write()
+        trace.close()
     if os.environ.get("BENCH_CHILD"):
         emit(results, serving)  # final cumulative line carries serving
         ok_any = bool({k: v for k, v in results.items() if "error" not in v})

@@ -52,6 +52,17 @@ class GPipeScheduler:
     def total_backward_clocks(self) -> int:
         return self.total_forward_clocks
 
+    @property
+    def bubble_fraction(self) -> float:
+        """Idle share of the stage-clock grid: P stages over M + P - 1
+        clocks hold M tasks each, so (P-1)/(M+P-1) of every stage's
+        timeline is bubble (torchgpipe §3.3; identical for the forward
+        and backward halves, and for the 1F1B reordering — it moves the
+        idle clocks, it doesn't remove them). The theoretical ceiling
+        the ``pipeline.bubble_fraction`` gauge reports
+        (telemetry/chrometrace.py)."""
+        return (self.n_partitions - 1) / self.total_forward_clocks
+
     def get_forward_schedules(self) -> List[List[Task]]:
         """clock -> tasks, forward: task (m, p) runs at clock m + p."""
         out: List[List[Task]] = []

@@ -26,14 +26,20 @@ from pipegoose_tpu.optim.zero import (
     state_specs,
 )
 
-
-def _spec_mentions(spec: P, axis: str) -> bool:
+def spec_mentions(spec: P, axis: str) -> bool:
+    """Whether a PartitionSpec shards any dim over ``axis`` — the one
+    axis-membership helper (telemetry/health.py imports it from here;
+    the reverse direction would cycle through the telemetry package
+    __init__ back into trainer/hybrid)."""
     for entry in spec:
         if entry == axis:
             return True
         if isinstance(entry, (tuple, list)) and axis in entry:
             return True
     return False
+
+
+_spec_mentions = spec_mentions  # module-internal alias
 
 
 def sync_replicated_grads(grads: Any, param_specs: Any, axes: tuple) -> Any:
@@ -88,6 +94,7 @@ def make_hybrid_train_step(
     grad_sync_axes: tuple = (),
     with_rng: bool = False,
     n_accum: int = 1,
+    with_health: bool = False,
 ):
     """Build (init_fn, step_fn), both jitted over the context's mesh.
 
@@ -112,6 +119,16 @@ def make_hybrid_train_step(
     is split into ``n_accum`` microbatches scanned with rematerialization
     (core/accumulation.py), so peak activation memory is one
     microbatch's while the optimizer sees the full-batch gradient.
+
+    ``with_health=True``: step_fn additionally returns a small
+    replicated pytree of in-graph health scalars (global and
+    per-top-level-module grad norms, applied-update max-abs/norm,
+    nonfinite-leaf counts, update/param norm ratio —
+    telemetry/health.py), fused into the SAME compiled program. The
+    flag is resolved at build time, so the off path lowers to a
+    byte-identical program (zero recompiles, zero per-step cost —
+    pinned by tests/telemetry/test_health.py); on, it costs one grad
+    all-reduce tree plus two scalar-vector collectives.
     """
     ctx = parallel_context or ParallelContext.get_context()
     if ctx is None:
@@ -137,23 +154,41 @@ def make_hybrid_train_step(
         )
         return jax.jit(f)(params)
 
+    loss_axes = loss_axis if isinstance(loss_axis, tuple) else (loss_axis,)
+    if with_health:
+        from pipegoose_tpu.telemetry.health import health_stats
+
+        # grads of params replicated over an already-synced axis
+        # (grad_sync_axes ran first) are exact; the remaining loss axes
+        # still hold per-rank partials and need the health pmean
+        synced = {e[0] if isinstance(e, tuple) else e for e in grad_sync_axes}
+        health_mean_axes = tuple(a for a in loss_axes if a not in synced)
+
     def _step(params, opt_state, batch, *rng):
         loss, grads = jax.value_and_grad(loss_fn)(params, batch, *rng)
         if grad_sync_axes:
             grads = sync_replicated_grads(grads, param_specs, grad_sync_axes)
         new_params, new_state = optimizer.step(grads, opt_state, params)
-        for ax in loss_axis if isinstance(loss_axis, tuple) else (loss_axis,):
+        for ax in loss_axes:
             loss = lax.pmean(loss, ax)
-        return new_params, new_state, loss
+        if not with_health:
+            return new_params, new_state, loss
+        health = health_stats(
+            grads, params, new_params, param_specs,
+            axes=tuple(mesh.axis_names), mean_axes=health_mean_axes,
+        )
+        return new_params, new_state, loss, health
 
     def make_step(params):
         spec = _state_spec_for(params)
         in_specs = (param_specs, spec, batch_spec) + ((P(),) if with_rng else ())
+        # the health tree is all replicated scalars: one P() prefix spec
+        out_specs = (param_specs, spec, P()) + ((P(),) if with_health else ())
         f = shard_map(
             _step,
             mesh=mesh,
             in_specs=in_specs,
-            out_specs=(param_specs, spec, P()),
+            out_specs=out_specs,
             check_vma=False,
         )
         return jax.jit(f, donate_argnums=(0, 1))

@@ -99,9 +99,21 @@ class ServingEngine:
                  num_pages: int = 64, page_size: int = 16,
                  max_context: int = 256, mesh=None, param_specs=None,
                  tp_axis: str = "tensor", continuous: bool = True,
-                 registry=None):
+                 registry=None, recorder=None, stall_patience: int = 100):
+        """``recorder``: optional ``telemetry.FlightRecorder`` — every
+        decode step lands in its ring, and the no-decode-progress
+        watchdog dumps a black box through it before raising.
+        ``stall_patience``: scheduler iterations that admit nothing and
+        decode nothing before the watchdog declares a stall (admission
+        is deterministic, so a genuinely stuck queue stops progressing
+        after ONE such iteration; the slack absorbs future time-based
+        admission policies)."""
         if max_context % page_size:
             raise ValueError("max_context must be a multiple of page_size")
+        if stall_patience < 1:
+            raise ValueError(f"stall_patience must be >= 1, got {stall_patience}")
+        self.recorder = recorder
+        self.stall_patience = stall_patience
         self.registry = registry if registry is not None else get_registry()
         # resolve metric handles ONCE: inc/set/observe check the enabled
         # flag themselves, so the hot loop's disabled cost stays one
@@ -231,6 +243,39 @@ class ServingEngine:
         if req.t_first_token is not None and req.t_submit is not None:
             self._m_ttft.observe(req.t_first_token - req.t_submit)
 
+    def _stall(self, steps: int, wall_s: float) -> None:
+        """No-decode-progress watchdog tripped: dump a black box (when a
+        recorder is attached) and raise instead of livelocking."""
+        queued = len(self.sched.queue)
+        head = self.sched.queue[0] if queued else None
+        reason = (
+            f"no decode progress for {self.stall_patience} scheduler "
+            f"iterations: {queued} queued, 0 active, "
+            f"{self.pool.free_count}/{self.pool.capacity} pages free"
+        )
+        if head is not None:
+            worst = self.pool.pages_for(head.prompt_len + head.max_new_tokens)
+            reason += (
+                f"; queue head uid={head.uid} needs {worst} pages worst-case"
+            )
+        where = ""
+        if self.recorder is not None:
+            trig = self.recorder.trigger_decode_stall(
+                steps, reason,
+                context={
+                    "num_slots": self.num_slots,
+                    "page_size": self.page_size,
+                    "pages_free": self.pool.free_count,
+                    "pages_total": self.pool.capacity,
+                    "queued": queued,
+                    "decode_steps": steps,
+                    "wall_s": wall_s,
+                },
+            )
+            if trig.dump_path:
+                where = f" (black box: {trig.dump_path})"
+        raise RuntimeError(f"serving decode stall: {reason}{where}")
+
     # -- API ---------------------------------------------------------------
 
     def run(self, requests: Sequence[Request], now=time.perf_counter):
@@ -248,8 +293,10 @@ class ServingEngine:
         seq_lens = np.zeros((self.num_slots,), np.int32)
         tokens = np.zeros((self.num_slots,), np.int32)
         t0 = now()
+        stalled = 0
         while not self.sched.all_done():
-            for req in self.sched.admit(now()):
+            admitted = self.sched.admit(now())
+            for req in admitted:
                 self._prefill_request(req, now)
                 prefills += 1
                 if req.status is Status.DONE:
@@ -257,7 +304,19 @@ class ServingEngine:
             active = self.sched.active()
             self._m_queue.set(len(self.sched.queue))
             if not active:
+                # no admission AND no decode work: nothing in this loop
+                # is time-dependent, so repeated no-progress iterations
+                # mean the queue is stuck (e.g. a reservation the pool
+                # can never cover). The watchdog turns that silent
+                # livelock into a black-box dump + a loud error.
+                if admitted:
+                    stalled = 0
+                else:
+                    stalled += 1
+                    if stalled >= self.stall_patience:
+                        self._stall(steps, now() - t0)
                 continue  # everything admitted finished at prefill
+            stalled = 0
             table.fill(0)
             seq_lens.fill(0)
             tokens.fill(0)
@@ -291,6 +350,12 @@ class ServingEngine:
             reg.event("serving.step", step=steps, active=len(active),
                       queue_depth=len(self.sched.queue), dur_s=t - t_step,
                       slot_occupancy=slot_occ, page_occupancy=page_occ)
+            if self.recorder is not None:
+                self.recorder.observe_serving_step(
+                    steps, active=len(active),
+                    queue_depth=len(self.sched.queue), dur_s=t - t_step,
+                    tokens=len(active),
+                )
             for req in active:
                 self.sched.record_token(req, int(nxt[req.slot]), t)
                 if req.status is Status.DONE:

@@ -19,10 +19,25 @@ constructing an engine with an enabled registry) and attach exporters:
     telemetry.PrometheusTextfileExporter("run.prom").write(
         telemetry.get_registry())
 
+On top of the substrate sits the health/forensics layer: in-graph
+health stats fused into the compiled train step (``health_stats``,
+``make_hybrid_train_step(with_health=True)``), the anomaly
+``FlightRecorder`` (ring buffer + structured triggers + atomic JSON
+black-box dumps, feeding ``FailureDetector``/``AutoRecovery``), and
+Perfetto/Chrome trace export (``ChromeTraceExporter``,
+``pipeline_trace_events``, the ``pipeline.bubble_fraction`` gauge).
+
 See docs/observability.md for the metric catalog and the MFU
 methodology.
 """
 from pipegoose_tpu.telemetry.callback import TelemetryCallback
+from pipegoose_tpu.telemetry.chrometrace import (
+    ChromeTraceExporter,
+    pipeline_trace_events,
+    register_pipeline_gauges,
+    span_events_to_trace,
+    trace_from_jsonl,
+)
 from pipegoose_tpu.telemetry.derived import (
     PEAK_FLOPS,
     collective_bytes,
@@ -37,6 +52,8 @@ from pipegoose_tpu.telemetry.exporters import (
     JSONLExporter,
     PrometheusTextfileExporter,
 )
+from pipegoose_tpu.telemetry.flightrec import FlightRecorder, TriggerEvent
+from pipegoose_tpu.telemetry.health import health_stats, host_health
 from pipegoose_tpu.telemetry.registry import (
     Counter,
     Gauge,
@@ -49,7 +66,9 @@ from pipegoose_tpu.telemetry.registry import (
 from pipegoose_tpu.telemetry.spans import current_span_path, span
 
 __all__ = [
+    "ChromeTraceExporter",
     "Counter",
+    "FlightRecorder",
     "Gauge",
     "Histogram",
     "JSONLExporter",
@@ -57,6 +76,7 @@ __all__ = [
     "PEAK_FLOPS",
     "PrometheusTextfileExporter",
     "TelemetryCallback",
+    "TriggerEvent",
     "collective_bytes",
     "compiled_step_stats",
     "current_span_path",
@@ -64,9 +84,15 @@ __all__ = [
     "enable",
     "get_registry",
     "hbm_utilization",
+    "health_stats",
+    "host_health",
     "mfu",
     "peak_flops_for",
+    "pipeline_trace_events",
+    "register_pipeline_gauges",
     "span",
+    "span_events_to_trace",
     "step_flops",
     "tokens_per_second",
+    "trace_from_jsonl",
 ]

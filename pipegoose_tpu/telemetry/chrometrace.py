@@ -1,0 +1,297 @@
+"""Perfetto / Chrome ``trace_event`` timeline export.
+
+The PR-2 span stream (telemetry/spans.py) is a flat JSONL of named
+durations; this module renders it as the Trace Event JSON that
+https://ui.perfetto.dev and chrome://tracing open natively — every
+``span`` event becomes a complete ("ph": "X") slice on its thread's
+row, so a ``trainer.fit`` run reads as an actual timeline (data pulls
+interleaved with step dispatches, serving prefills vs. decode steps)
+instead of quantile tables.
+
+Because the pipeline schedule is *compiled into* the program (one
+``lax.scan`` clock per ``GPipeScheduler`` cycle — nn/pipeline_parallel/
+pipeline.py), its per-stage activity cannot be host-traced; instead
+:func:`pipeline_trace_events` renders the scheduler's deterministic
+clock timetable as one row per stage (the torchgpipe-style
+microbatch/clock diagram), and :func:`register_pipeline_gauges` derives
+the **bubble fraction** — the idle share of the stage-clock grid that
+upper-bounds pipeline efficiency — as a gauge next to the PR-2 MFU
+gauge, with the measured ``span.train.step.seconds`` turning the
+fraction into lost seconds.
+
+Format notes (the subset Perfetto accepts strictly): timestamps and
+durations are MICROSECONDS; ``pid``/``tid`` are ints, named via
+``"M"``-phase ``process_name``/``thread_name`` metadata events; the
+file is one JSON object ``{"traceEvents": [...]}``.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, Iterable, List, Optional
+
+from pipegoose_tpu.telemetry.registry import MetricsRegistry, get_registry
+from pipegoose_tpu.utils.procindex import RankFilter as _RankFilter
+
+# fixed pid per row family so multiple writers agree
+PID_HOST = 1        # host-side spans (trainer/serving/decode driver)
+PID_PIPELINE = 2    # theoretical pipeline clock timeline
+
+
+def span_events_to_trace(
+    events: Iterable[dict], *, pid: int = PID_HOST
+) -> List[dict]:
+    """``"span"`` event dicts (JSONL schema: ``ts`` = exit wall-clock
+    seconds, ``dur_s``) -> complete trace events. Non-span events pass
+    through as instant events so step markers stay visible."""
+    out: List[dict] = []
+    for ev in events:
+        kind = ev.get("kind")
+        extra = {
+            k: v for k, v in ev.items()
+            if k not in ("kind", "span", "ts", "dur_s", "tid")
+        }
+        if kind == "span":
+            dur = float(ev.get("dur_s", 0.0))
+            end = float(ev.get("ts", 0.0))
+            out.append({
+                "name": ev.get("span", "?"),
+                "cat": "span",
+                "ph": "X",
+                "ts": (end - dur) * 1e6,
+                "dur": dur * 1e6,
+                "pid": pid,
+                "tid": int(ev.get("tid", 0)),
+                "args": extra,
+            })
+        elif kind is not None:
+            out.append({
+                "name": str(kind),
+                "cat": "event",
+                "ph": "i",
+                "s": "t",  # thread-scoped instant
+                "ts": float(ev.get("ts", 0.0)) * 1e6,
+                "pid": pid,
+                "tid": int(ev.get("tid", 0)),
+                "args": extra,
+            })
+    return out
+
+
+def pipeline_trace_events(
+    scheduler: Any,
+    *,
+    clock_s: float = 1e-3,
+    t0_s: float = 0.0,
+    include_backward: bool = True,
+    pid: int = PID_PIPELINE,
+) -> List[dict]:
+    """Render a ``GPipeScheduler`` (or subclass) clock timetable as one
+    trace row PER PIPELINE STAGE: task (m, p) becomes an ``F{m}`` slice
+    at clock ``m + p`` on stage p's row, backwards follow as ``B{m}``
+    after the forward clocks — the microbatch/clock diagram torchgpipe
+    §3.2.1 draws, loadable next to the measured spans. ``clock_s`` is
+    the nominal seconds per clock (pure visualization scale)."""
+    events: List[dict] = [
+        {
+            "name": "process_name", "ph": "M", "pid": pid,
+            "args": {"name": "pipeline (theoretical clock timeline)"},
+        }
+    ]
+    for p in range(scheduler.n_partitions):
+        events.append({
+            "name": "thread_name", "ph": "M", "pid": pid, "tid": p,
+            "args": {"name": f"stage {p}"},
+        })
+
+    def emit(tasks_by_clock, label, clock_offset):
+        for c, tasks in enumerate(tasks_by_clock):
+            for t in tasks:
+                events.append({
+                    "name": f"{label}{t.microbatch_idx}",
+                    "cat": f"pipeline.{'forward' if label == 'F' else 'backward'}",
+                    "ph": "X",
+                    "ts": (t0_s + (clock_offset + c) * clock_s) * 1e6,
+                    "dur": clock_s * 1e6,
+                    "pid": pid,
+                    "tid": t.partition_idx,
+                    "args": {
+                        "microbatch": t.microbatch_idx,
+                        "stage": t.partition_idx,
+                        "clock": clock_offset + c,
+                    },
+                })
+
+    emit(scheduler.get_forward_schedules(), "F", 0)
+    if include_backward:
+        emit(
+            scheduler.get_backward_schedules(), "B",
+            scheduler.total_forward_clocks,
+        )
+    return events
+
+
+def register_pipeline_gauges(
+    scheduler: Any,
+    registry: Optional[MetricsRegistry] = None,
+    step_seconds: Optional[float] = None,
+) -> float:
+    """Set ``pipeline.bubble_fraction`` (theoretical idle share of the
+    clock timeline, ``(P-1)/(M+P-1)``) alongside the PR-2 ``train.mfu``
+    gauge; with a measured step time (e.g. the
+    ``span.train.step.seconds`` p50) also ``pipeline.bubble_seconds`` —
+    the wall-clock that fraction costs per step. Returns the fraction."""
+    reg = registry if registry is not None else get_registry()
+    frac = scheduler.bubble_fraction
+    reg.gauge(
+        "pipeline.bubble_fraction",
+        help="theoretical pipeline idle fraction (P-1)/(M+P-1)",
+    ).set(frac)
+    reg.gauge("pipeline.n_microbatches").set(float(scheduler.n_microbatches))
+    reg.gauge("pipeline.n_partitions").set(float(scheduler.n_partitions))
+    if step_seconds is not None:
+        reg.gauge(
+            "pipeline.bubble_seconds",
+            help="measured step seconds x theoretical bubble fraction",
+        ).set(frac * step_seconds)
+    return frac
+
+
+class ChromeTraceExporter:
+    """Registry sink accumulating span/step events as trace events;
+    ``write()`` emits one Perfetto-loadable JSON file atomically.
+
+    Same conventions as ``JSONLExporter``: callable (the sink
+    protocol), attaches itself when constructed with ``registry=``,
+    rank-0 filtered file writes. Events are buffered in memory (one
+    small dict per span — bound a long run with ``max_events``, which
+    keeps the NEWEST events) and annotated with the capturing thread so
+    serving-engine and trainer rows separate naturally. Rows beyond the
+    live capture (the pipeline clock timeline) are added with
+    :meth:`add_events` / :meth:`add_pipeline_timeline`."""
+
+    def __init__(
+        self,
+        path: str,
+        registry: Optional[MetricsRegistry] = None,
+        rank: Optional[int] = 0,
+        max_events: int = 100_000,
+    ):
+        self.path = path
+        self._rank_ok = _RankFilter(rank)
+        self._lock = threading.Lock()
+        # deque(maxlen): O(1) append-with-drop — a list would memmove
+        # the whole buffer per event once the cap is hit, on the
+        # instrumented hot path of exactly the longest runs
+        self.max_events = int(max_events)
+        self._events: deque = deque(maxlen=self.max_events)
+        self._extra: List[dict] = []        # pre-rendered trace events
+        self._tids: Dict[int, int] = {}     # thread ident -> compact tid
+        self._dropped = 0
+        self._registry = registry
+        if registry is not None:
+            registry.attach(self)
+
+    def __call__(self, event: dict) -> None:
+        # rank-filter at CAPTURE, not just at write: non-emitting ranks
+        # must not spend memory/copies buffering events they will never
+        # render (JSONLExporter drops per-event the same way)
+        if not self._rank_ok():
+            return
+        ident = threading.get_ident()
+        with self._lock:
+            tid = self._tids.setdefault(ident, len(self._tids))
+            ev = dict(event)
+            ev["tid"] = tid
+            if len(self._events) == self.max_events:
+                self._dropped += 1  # deque drops the oldest on append
+            self._events.append(ev)
+
+    def add_events(self, trace_events: Iterable[dict]) -> None:
+        with self._lock:
+            self._extra.extend(trace_events)
+
+    def add_pipeline_timeline(self, scheduler: Any, **kwargs: Any) -> None:
+        """Attach a ``GPipeScheduler`` clock timeline's rows (see
+        :func:`pipeline_trace_events`)."""
+        self.add_events(pipeline_trace_events(scheduler, **kwargs))
+
+    def write(self, path: Optional[str] = None) -> Optional[str]:
+        """Render and atomically write the trace JSON; returns the path
+        (None when rank-filtered out)."""
+        if not self._rank_ok():
+            return None
+        path = path or self.path
+        with self._lock:
+            events = list(self._events)
+            extra = list(self._extra)
+            tids = dict(self._tids)
+            dropped = self._dropped
+        trace: List[dict] = [
+            {
+                "name": "process_name", "ph": "M", "pid": PID_HOST,
+                "args": {"name": "pipegoose_tpu host spans"},
+            }
+        ]
+        for ident, tid in tids.items():
+            trace.append({
+                "name": "thread_name", "ph": "M", "pid": PID_HOST,
+                "tid": tid, "args": {"name": f"thread {ident}"},
+            })
+        trace.extend(span_events_to_trace(events))
+        trace.extend(extra)
+        payload = {
+            "traceEvents": trace,
+            "displayTimeUnit": "ms",
+            "otherData": {
+                "exporter": "pipegoose_tpu.telemetry.chrometrace",
+                "created_ts": time.time(),
+                "dropped_events": dropped,
+            },
+        }
+        from pipegoose_tpu.telemetry.exporters import (
+            atomic_write_text,
+            safe_json_dumps,
+        )
+
+        atomic_write_text(path, safe_json_dumps(payload), suffix=".trace.tmp")
+        return path
+
+    def close(self) -> None:
+        if self._registry is not None:
+            self._registry.detach(self)
+            self._registry = None
+
+    def __enter__(self) -> "ChromeTraceExporter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def trace_from_jsonl(jsonl_path: str, trace_path: str) -> str:
+    """Offline conversion: a ``JSONLExporter`` stream (e.g. a run's
+    ``telemetry.jsonl`` artifact) -> Perfetto trace JSON. Snapshot
+    lines are skipped; malformed lines are ignored (a truncated last
+    line from a killed run must not block the post-mortem)."""
+    events: List[dict] = []
+    with open(jsonl_path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                ev = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if ev.get("kind") == "snapshot":
+                continue
+            events.append(ev)
+    exp = ChromeTraceExporter(trace_path, rank=None)
+    for ev in events:
+        exp(ev)
+    out = exp.write()
+    assert out is not None  # rank=None never filters
+    return out

@@ -1,5 +1,10 @@
 """Telemetry exporters: JSONL event stream + Prometheus textfile.
 
+(The third exporter shape — Perfetto/Chrome ``trace_event`` JSON — has
+its own module, ``telemetry.chrometrace``: ``ChromeTraceExporter``
+follows the same sink/rank conventions as ``JSONLExporter`` here, and
+``trace_from_jsonl`` converts an existing JSONL stream offline.)
+
 Two complementary shapes, both plain files (no daemon, no deps):
 
 - ``JSONLExporter`` — an append-only event stream (one JSON object per
@@ -20,6 +25,7 @@ exporter never forces backend initialization.
 from __future__ import annotations
 
 import json
+import math
 import os
 import tempfile
 import threading
@@ -27,6 +33,25 @@ from typing import IO, Optional
 
 from pipegoose_tpu.telemetry.registry import MetricsRegistry
 from pipegoose_tpu.utils.procindex import RankFilter as _RankFilter
+
+
+def atomic_write_text(path: str, text: str, suffix: str = ".tmp") -> None:
+    """tmp + rename so a concurrent reader never sees a torn file — the
+    one atomic-write implementation every telemetry artifact writer
+    (Prometheus textfile, black-box dumps, Chrome traces) shares."""
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=d, suffix=suffix)
+    try:
+        with os.fdopen(fd, "w") as f:
+            f.write(text)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
 
 
 class JSONLExporter:
@@ -66,7 +91,7 @@ class JSONLExporter:
         # serialize OUTSIDE the lock, then one locked write+flush: two
         # threads sharing this sink (serving engine + trainer callback)
         # must not interleave bytes into torn JSONL lines
-        line = json.dumps(event, default=_jsonable) + "\n"
+        line = safe_json_dumps(event) + "\n"
         with self._lock:
             f = self._handle()
             if f is None:
@@ -109,26 +134,40 @@ class PrometheusTextfileExporter:
         returns the path written, or None when rank-filtered out."""
         if not self._rank_ok():
             return None
-        text = registry.to_prometheus()
-        d = os.path.dirname(os.path.abspath(self.path)) or "."
-        os.makedirs(d, exist_ok=True)
-        fd, tmp = tempfile.mkstemp(dir=d, suffix=".prom.tmp")
-        try:
-            with os.fdopen(fd, "w") as f:
-                f.write(text)
-            os.replace(tmp, self.path)
-        except BaseException:
-            try:
-                os.unlink(tmp)
-            except OSError:
-                pass
-            raise
+        atomic_write_text(self.path, registry.to_prometheus(),
+                          suffix=".prom.tmp")
         return self.path
 
 
 def _jsonable(x):
-    """Best-effort conversion for numpy/jax scalars reaching the stream."""
+    """Best-effort conversion for numpy/jax scalars reaching the stream.
+    Non-finite values become strings: json.dumps would otherwise emit
+    bare ``Infinity``/``NaN`` tokens, which are NOT JSON — jq, JS
+    ``JSON.parse``, and log pipelines reject the artifact exactly when
+    a nonfinite anomaly (the interesting case) is in it."""
     try:
-        return float(x)
+        f = float(x)
     except (TypeError, ValueError):
         return repr(x)
+    return f if math.isfinite(f) else repr(f)
+
+
+def _sanitize(obj):
+    """Recursively stringify non-finite floats (see ``_jsonable``) —
+    plain python floats never reach a ``default=`` hook, so payloads
+    holding inf/nan (health trees, NaN-loss events) need this pass."""
+    if isinstance(obj, float):
+        return obj if math.isfinite(obj) else repr(obj)
+    if isinstance(obj, dict):
+        return {k: _sanitize(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_sanitize(v) for v in obj]
+    return obj
+
+
+def safe_json_dumps(obj, **kwargs) -> str:
+    """``json.dumps`` that emits strictly valid (RFC 8259) JSON: every
+    non-finite float — nested or numpy/jax-scalar — lands as the string
+    ``'inf'``/``'-inf'``/``'nan'``. All telemetry artifact writers
+    (JSONL stream, black-box dumps, Chrome traces) route through it."""
+    return json.dumps(_sanitize(obj), default=_jsonable, **kwargs)

@@ -1,0 +1,397 @@
+"""Anomaly flight recorder: a black box for diverging runs.
+
+When a multi-chip run dies today the only artifact is a host-synced
+NaN loss — no record of which module's gradients exploded, what the
+last N healthy steps looked like, or what the serving engine was doing
+when decode stopped making progress. The flight recorder is the
+crash-forensics layer on top of the PR-2 telemetry substrate:
+
+- a HOST-SIDE ring buffer of the last ``capacity`` step records —
+  loss, fenced step time, the in-graph health pytree
+  (telemetry/health.py, host-converted), and per-step span summaries
+  drained from the registry's event stream;
+- STRUCTURED triggers evaluated on every checked step: non-finite
+  anywhere (loss, grads, optimizer updates — the reason names the
+  offending top-level module group), loss-spike z-score, grad-norm
+  explosion vs. the running median, and a serving no-decode-progress
+  watchdog (driven by ``ServingEngine``);
+- on trigger, an ATOMIC JSON "black box" dump: the ring contents, the
+  trigger (name + reason + details), mesh/topology context, and
+  jax/library versions — everything a post-mortem needs and the
+  donated-buffer train step can no longer provide after the fact.
+
+Recovery integration: ``FailureDetector``/``AutoRecovery``
+(trainer/recovery.py) accept ``recorder=``; a fired trigger is
+consumed by the detector in the SAME callback round (the recorder runs
+at order -20, before the detector's -10), so recovery reacts to
+*which* signal fired — "nonfinite gradients in module group 'embed'"
+— instead of a bare NaN loss, and the black box is already on disk
+before any restore rewinds the evidence.
+
+The recorder is opt-in and host-synced by design: converting the loss
+and health tree to floats each checked step drains the dispatch
+pipeline exactly like ``TelemetryCallback(fence=True)`` — which is
+also what makes the recorded step time a FENCED device time. Use
+``check_every > 1`` to amortize when that matters.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+import os
+import statistics
+import sys
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+from pipegoose_tpu.trainer.callback import Callback, _host_scalar
+
+
+@dataclasses.dataclass
+class TriggerEvent:
+    """One fired anomaly trigger (and its black-box dump, if written)."""
+
+    name: str          # "nonfinite" | "loss_spike" | "grad_explosion" | "decode_stall"
+    reason: str        # human-readable; names the offending module group
+    step: int
+    details: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    dump_path: Optional[str] = None
+
+
+def _finite(x: Optional[float]) -> bool:
+    return x is not None and isinstance(x, (int, float)) and math.isfinite(x)
+
+
+class FlightRecorder(Callback):
+    """Ring-buffer step recorder with structured anomaly triggers.
+
+    As a trainer callback it records every ``check_every``-th step and
+    evaluates the training triggers; ``ServingEngine`` drives the same
+    object through :meth:`observe_serving_step` /
+    :meth:`trigger_decode_stall`. A fired trigger is held in
+    ``last_trigger`` until a consumer (``FailureDetector`` with
+    ``recorder=``) calls :meth:`take_trigger`.
+
+    ``loss_spike_z``: z-score of the step loss against the trailing
+    ``window`` finite losses (arms at ``window // 2`` history).
+    ``grad_explosion_factor``: global grad norm vs. the trailing
+    median (needs the trainer's ``with_health=True``; silently ignored
+    otherwise). ``max_dumps`` bounds disk usage under a persistent
+    failure loop.
+    """
+
+    order = -20  # record + trigger BEFORE FailureDetector (-10) consumes
+
+    def __init__(
+        self,
+        directory: str,
+        capacity: int = 128,
+        check_every: int = 1,
+        loss_spike_z: Optional[float] = 6.0,
+        grad_explosion_factor: Optional[float] = 25.0,
+        window: int = 50,
+        max_dumps: int = 8,
+        registry=None,
+        context: Optional[Dict[str, Any]] = None,
+    ):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        if check_every < 1:
+            raise ValueError(f"check_every must be >= 1, got {check_every}")
+        if window < 2:
+            raise ValueError(f"window must be >= 2, got {window}")
+        self.directory = directory
+        self.check_every = check_every
+        self.loss_spike_z = loss_spike_z
+        self.grad_explosion_factor = grad_explosion_factor
+        self.window = window
+        self.max_dumps = max_dumps
+        self.context = dict(context or {})
+        self.records: deque = deque(maxlen=capacity)
+        self.dumps: List[str] = []
+        self.last_trigger: Optional[TriggerEvent] = None
+        self._loss_hist: deque = deque(maxlen=window)
+        self._grad_hist: deque = deque(maxlen=window)
+        self._registry = registry
+        self._span_acc: Dict[str, List[float]] = {}
+        self._lock = threading.Lock()
+        self._t0: Optional[float] = None
+        self._attached = False
+
+    # -- ring --------------------------------------------------------------
+
+    def record(self, kind: str, **fields: Any) -> dict:
+        """Append one timestamped record to the ring and return it."""
+        rec = {"ts": time.time(), "kind": kind, **fields}
+        with self._lock:
+            self.records.append(rec)
+        return rec
+
+    # -- span summaries (registry event sink) ------------------------------
+
+    def _sink(self, event: dict) -> None:
+        if event.get("kind") != "span":
+            return
+        with self._lock:
+            acc = self._span_acc.setdefault(event.get("span", "?"), [0, 0.0])
+            acc[0] += 1
+            acc[1] += float(event.get("dur_s", 0.0))
+
+    def _drain_spans(self) -> Dict[str, dict]:
+        with self._lock:
+            out = {
+                k: {"n": int(n), "total_s": t}
+                for k, (n, t) in self._span_acc.items()
+            }
+            self._span_acc.clear()
+        return out
+
+    # -- trainer callback interface ----------------------------------------
+
+    def _maybe_attach(self) -> None:
+        from pipegoose_tpu.telemetry.registry import get_registry
+
+        if self._attached:
+            return
+        reg = self._registry if self._registry is not None else get_registry()
+        # span summaries ride the event stream; a disabled registry
+        # emits none, and attaching would change nothing — skip so the
+        # recorder never implicitly turns telemetry on. Re-checked every
+        # step (one branch when attached): a TelemetryCallback in the
+        # same callback list enables the registry AFTER this recorder's
+        # on_fit_start (it runs at order 5, the recorder at -20), so a
+        # fit-start-only check would silently drop all span summaries
+        # in exactly the documented wiring.
+        if reg.enabled:
+            reg.attach(self._sink)
+            self._registry = reg
+            self._attached = True
+
+    def on_fit_start(self, trainer: Any) -> None:
+        self._maybe_attach()
+
+    def on_fit_end(self, trainer: Any) -> None:
+        if self._attached and self._registry is not None:
+            self._registry.detach(self._sink)
+            self._attached = False
+
+    def on_step_start(self, trainer: Any, step: int) -> None:
+        self._maybe_attach()
+        self._t0 = time.perf_counter()
+
+    def on_step_end(self, trainer: Any, step: int, loss: Any) -> None:
+        if step % self.check_every:
+            return
+        from pipegoose_tpu.telemetry.health import host_health
+
+        loss_f = _host_scalar(loss)  # syncs the step: the time below is fenced
+        dt = (
+            time.perf_counter() - self._t0 if self._t0 is not None else None
+        )
+        health = host_health(getattr(trainer.state, "last_health", None))
+        self.record(
+            "train.step", step=step, loss=loss_f, step_time_s=dt,
+            health=health, spans=self._drain_spans(),
+        )
+        trig = self._train_trigger(step, loss_f, health)
+        if trig is not None:
+            trig.dump_path = self.dump(trig, context=self._train_context(trainer))
+            self.last_trigger = trig
+            return
+        # only healthy steps feed the baselines (a spike must not
+        # poison the median it is judged against)
+        if _finite(loss_f):
+            self._loss_hist.append(loss_f)
+        if health is not None and _finite(health.get("grad_norm")):
+            self._grad_hist.append(health["grad_norm"])
+
+    # -- triggers ----------------------------------------------------------
+
+    def _train_trigger(
+        self, step: int, loss: Optional[float], health: Optional[dict]
+    ) -> Optional[TriggerEvent]:
+        # 1) non-finite anywhere — name the module group, not just "NaN"
+        bad_bits = []
+        details: Dict[str, Any] = {}
+        if health is not None:
+            per_mod = health.get("grad_norm_per_module", {}) or {}
+            bad_mods = sorted(
+                m for m, v in per_mod.items() if not _finite(v)
+            )
+            if health.get("nonfinite_grad_leaves", 0) or bad_mods:
+                mods = (
+                    f" in module group(s) {', '.join(repr(m) for m in bad_mods)}"
+                    if bad_mods else ""
+                )
+                bad_bits.append(
+                    f"non-finite gradients{mods} "
+                    f"({health.get('nonfinite_grad_leaves', 0):.0f} leaves)"
+                )
+                details["bad_modules"] = bad_mods
+            if health.get("nonfinite_update_leaves", 0):
+                bad_bits.append(
+                    "non-finite optimizer updates "
+                    f"({health['nonfinite_update_leaves']:.0f} leaves)"
+                )
+            details["health"] = health
+        if loss is not None and not _finite(loss):
+            bad_bits.append(f"non-finite loss {loss}")
+        if bad_bits:
+            return TriggerEvent(
+                "nonfinite", "; ".join(bad_bits), step, details
+            )
+
+        # 2) grad-norm explosion vs. the trailing median
+        if (
+            self.grad_explosion_factor is not None
+            and health is not None
+            and _finite(health.get("grad_norm"))
+            and len(self._grad_hist) >= max(2, self.window // 2)
+        ):
+            gn = health["grad_norm"]
+            med = statistics.median(self._grad_hist)
+            if med > 0 and gn > self.grad_explosion_factor * med:
+                per_mod = {
+                    m: v
+                    for m, v in (health.get("grad_norm_per_module") or {}).items()
+                    if _finite(v)
+                }
+                worst = max(per_mod, key=per_mod.get) if per_mod else None
+                at = (
+                    f" (largest module group {worst!r} = {per_mod[worst]:.3g})"
+                    if worst else ""
+                )
+                return TriggerEvent(
+                    "grad_explosion",
+                    f"grad norm {gn:.3g} > {self.grad_explosion_factor} x "
+                    f"median {med:.3g}{at}",
+                    step,
+                    {"grad_norm": gn, "median": med, "health": health},
+                )
+
+        # 3) loss-spike z-score
+        if (
+            self.loss_spike_z is not None
+            and _finite(loss)
+            and len(self._loss_hist) >= max(2, self.window // 2)
+        ):
+            mean = statistics.fmean(self._loss_hist)
+            std = statistics.pstdev(self._loss_hist)
+            if std > 0:
+                z = (loss - mean) / std
+                if z > self.loss_spike_z:
+                    return TriggerEvent(
+                        "loss_spike",
+                        f"loss {loss:.4g} is {z:.1f} sigma above the "
+                        f"trailing mean {mean:.4g} (window {len(self._loss_hist)})",
+                        step,
+                        {"z": z, "mean": mean, "std": std},
+                    )
+        return None
+
+    def take_trigger(self) -> Optional[TriggerEvent]:
+        """Consume the pending trigger (recovery's entry point)."""
+        trig, self.last_trigger = self.last_trigger, None
+        return trig
+
+    def reset_after_restore(self, restored_step: int) -> None:
+        """Called by ``AutoRecovery`` after a checkpoint rollback: the
+        spike/explosion baselines span the rolled-back timeline and a
+        marker record keeps the ring's history interpretable."""
+        self._loss_hist.clear()
+        self._grad_hist.clear()
+        self.last_trigger = None
+        self.record("restore", step=restored_step)
+
+    # -- serving -----------------------------------------------------------
+
+    def observe_serving_step(self, step: int, **fields: Any) -> None:
+        self.record("serving.step", step=step, **fields)
+
+    def trigger_decode_stall(
+        self, step: int, reason: str, context: Optional[dict] = None,
+        **details: Any,
+    ) -> TriggerEvent:
+        """Fire the serving watchdog trigger and dump the black box."""
+        trig = TriggerEvent("decode_stall", reason, step, details)
+        trig.dump_path = self.dump(trig, context=context)
+        self.last_trigger = trig
+        return trig
+
+    # -- dump --------------------------------------------------------------
+
+    def _train_context(self, trainer: Any) -> Dict[str, Any]:
+        out: Dict[str, Any] = {"tokens_per_step": getattr(trainer, "tokens_per_step", None)}
+        ctx = getattr(trainer, "parallel_context", None)
+        mesh = getattr(ctx, "mesh", None)
+        if mesh is not None:
+            out["mesh_axes"] = {k: int(v) for k, v in dict(mesh.shape).items()}
+            devs = mesh.devices.reshape(-1)
+            out["n_devices"] = int(devs.size)
+            d0 = devs[0]
+            out["device_kind"] = getattr(d0, "device_kind", getattr(d0, "platform", "?"))
+        return out
+
+    @staticmethod
+    def _environment() -> Dict[str, Any]:
+        env: Dict[str, Any] = {"python": sys.version.split()[0]}
+        try:
+            import jax
+
+            env["jax"] = jax.__version__
+            try:
+                import jaxlib
+
+                env["jaxlib"] = jaxlib.__version__
+            except Exception:  # noqa: BLE001
+                pass
+            env["backend"] = jax.default_backend()
+            env["device_count"] = jax.device_count()
+            env["process_index"] = jax.process_index()
+        except Exception:  # noqa: BLE001 - never let forensics crash the run
+            pass
+        try:
+            import numpy
+
+            env["numpy"] = numpy.__version__
+        except Exception:  # noqa: BLE001
+            pass
+        return env
+
+    def dump(
+        self, trigger: TriggerEvent, context: Optional[dict] = None
+    ) -> Optional[str]:
+        """Atomically write the black-box JSON; returns its path (None
+        once ``max_dumps`` is exhausted — the ring keeps recording)."""
+        if len(self.dumps) >= self.max_dumps:
+            return None
+        from pipegoose_tpu.telemetry.exporters import (
+            atomic_write_text,
+            safe_json_dumps,
+        )
+
+        path = os.path.join(
+            self.directory,
+            f"blackbox_step{trigger.step:08d}_{trigger.name}.json",
+        )
+        with self._lock:
+            records = list(self.records)
+        payload = {
+            "trigger": {
+                "name": trigger.name,
+                "reason": trigger.reason,
+                "step": trigger.step,
+                "details": trigger.details,
+            },
+            "records": records,
+            "context": {**self.context, **(context or {})},
+            "environment": self._environment(),
+            "created_ts": time.time(),
+        }
+        atomic_write_text(
+            path, safe_json_dumps(payload, indent=1), suffix=".blackbox.tmp"
+        )
+        self.dumps.append(path)
+        return path

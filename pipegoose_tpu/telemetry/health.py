@@ -1,0 +1,196 @@
+"""In-graph training-health statistics.
+
+The reference pipegoose's only divergence signal is the host-synced
+loss (trainer/trainer.py stub + SURVEY.md §5: no health checks of any
+kind); in this port the whole optimizer step is ONE compiled SPMD
+program, so by the time a NaN loss reaches the host there is no record
+of *which* module's gradients exploded or whether the optimizer update
+itself overflowed. This module computes that record INSIDE the compiled
+step — a fused reduction over the grad/param/update trees — so the
+diagnosis costs one extra all-reduce tree instead of a post-hoc host
+sweep over materialized gradients (which the donated-buffer train step
+couldn't even provide).
+
+``health_stats`` is called from ``make_hybrid_train_step`` behind the
+``with_health`` flag (parallel/hybrid.py): off, the step program is
+byte-identical to the unflagged one (zero recompiles, zero overhead —
+pinned by tests/telemetry/test_health.py's HLO guard); on, the step
+returns one extra small pytree of replicated f32 scalars:
+
+- ``grad_norm`` — global L2 norm of the (data-axis-meaned) gradient;
+- ``grad_norm_per_module`` — the same, split by TOP-LEVEL param group
+  (``embed`` / ``blocks`` / ``ln_f`` ...), which is what lets a flight
+  recorder dump name the offending module instead of "somewhere";
+- ``update_max_abs`` / ``update_norm`` — the applied optimizer update
+  (``new_params - params``), catching overflowed Adam moments that a
+  pre-update loss canary misses (the CheckpointCallback guard's blind
+  spot, trainer/callback.py);
+- ``param_norm`` and ``update_ratio`` (``||update|| / ||param||``) —
+  the classic lr-sanity ratio (~1e-3 healthy, ~1 means the step is
+  rewriting the network);
+- ``nonfinite_grad_leaves`` / ``nonfinite_update_leaves`` — count of
+  param leaves containing any non-finite value (for a leaf sharded
+  over a mesh axis each bad SHARD counts once, so the number can
+  exceed the leaf count — it is a severity signal whose load-bearing
+  property is ``> 0``).
+
+Sharding correctness: inside ``shard_map`` every leaf is a local
+shard. For leaves *sharded* over a mesh axis the local partial sums
+add up across that axis; for leaves *replicated* over an axis the
+copies are identical and must be counted once. Both cases fold into a
+single ``psum`` over ALL mesh axes by pre-dividing each leaf's partial
+by the total size of the axes it is replicated over — so the whole
+stats tree costs exactly one fused psum (sums + flag counts) plus one
+pmax (maxima) beyond the grad-mean tree.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+
+# the one spec-axis-membership helper lives in parallel/hybrid.py; by
+# the time this module loads (via the telemetry package __init__,
+# whose callback import already pulled trainer -> hybrid) it is
+# initialized, while the reverse import direction would cycle
+from pipegoose_tpu.parallel.hybrid import spec_mentions as _spec_mentions
+
+
+def _key_name(k: Any) -> str:
+    """Pretty name of one tree_flatten_with_path key entry."""
+    for attr in ("key", "idx", "name"):
+        if hasattr(k, attr):
+            return str(getattr(k, attr))
+    return str(k)
+
+
+def module_of(path: Tuple[Any, ...]) -> str:
+    """Top-level param-group name of a tree path ('' for a bare leaf)."""
+    return _key_name(path[0]) if path else ""
+
+
+def _replication_factor(spec: P, axes: Sequence[str]) -> Any:
+    """Product of mesh-axis sizes this leaf is REPLICATED over (static
+    python int under shard_map: compat's axis_size const-folds)."""
+    n = 1
+    for ax in axes:
+        if not _spec_mentions(spec, ax):
+            n *= lax.axis_size(ax)
+    return n
+
+
+def health_stats(
+    grads: Any,
+    params: Any,
+    new_params: Any,
+    param_specs: Any,
+    *,
+    axes: Sequence[str] = (),
+    mean_axes: Sequence[str] = (),
+    eps: float = 1e-12,
+) -> Dict[str, Any]:
+    """Fused health reduction over one step's grad/param/update trees.
+
+    ``axes``: ALL mesh axis names bound by the surrounding shard_map
+    (empty = single-device / outside shard_map: no collectives emitted,
+    the same arithmetic runs locally — how the equivalence tests use
+    it). ``mean_axes``: axes over which replicated-param grads are
+    still PARTIAL per rank (the data axis before the optimizer's
+    reduce-scatter); those leaves get a ``pmean`` first — the "one
+    extra all-reduce tree" the with_health flag buys.
+
+    Returns a flat dict of f32 scalars (plus the per-module sub-dict),
+    replicated across the mesh — ``out_specs=P()`` downstream.
+    """
+    axes = tuple(axes)
+    mean_axes = tuple(mean_axes)
+    spec_leaves = jax.tree_util.tree_leaves(
+        param_specs, is_leaf=lambda x: isinstance(x, P)
+    )
+    g_paths = jax.tree_util.tree_flatten_with_path(grads)[0]
+    p_leaves = jax.tree_util.tree_leaves(params)
+    q_leaves = jax.tree_util.tree_leaves(new_params)
+    if not (len(spec_leaves) == len(g_paths) == len(p_leaves) == len(q_leaves)):
+        raise ValueError(
+            f"tree mismatch: {len(g_paths)} grad leaves, {len(p_leaves)} "
+            f"param leaves, {len(q_leaves)} updated leaves, "
+            f"{len(spec_leaves)} specs"
+        )
+
+    modules = sorted({module_of(path) for path, _ in g_paths})
+    mod_sq = {m: jnp.float32(0.0) for m in modules}
+    g_sq = u_sq = p_sq = jnp.float32(0.0)
+    g_bad = u_bad = jnp.float32(0.0)
+    u_mx = jnp.float32(0.0)
+
+    for (path, g), p, q, spec in zip(g_paths, p_leaves, q_leaves, spec_leaves):
+        # replicated-over-data grads are per-rank partials: mean them so
+        # the norm below is the norm of the TRUE (optimizer-seen) grad
+        for ax in mean_axes:
+            if not _spec_mentions(spec, ax):
+                g = lax.pmean(g, ax)
+        repl = _replication_factor(spec, axes)
+        g32 = g.astype(jnp.float32)
+        u32 = (q.astype(jnp.float32) - p.astype(jnp.float32))
+        p32 = p.astype(jnp.float32)
+
+        g_sq += jnp.sum(jnp.square(g32)) / repl
+        mod_sq[module_of(path)] += jnp.sum(jnp.square(g32)) / repl
+        u_sq += jnp.sum(jnp.square(u32)) / repl
+        p_sq += jnp.sum(jnp.square(p32)) / repl
+        # per-leaf-shard flags (any nonfinite element) summed into
+        # counts; replicated copies are de-duplicated by the same
+        # divide-then-psum as the sq-sums
+        g_bad += jnp.any(~jnp.isfinite(g32)).astype(jnp.float32) / repl
+        u_bad += jnp.any(~jnp.isfinite(u32)).astype(jnp.float32) / repl
+        u_mx = jnp.maximum(u_mx, jnp.max(jnp.abs(u32)))
+
+    if axes:
+        # ONE fused psum for every additive stat (sums AND the flag
+        # counts — the leaf flags were divided by their replication
+        # factor, so the all-axes psum restores exact 0/1-per-leaf
+        # counts), one pmax for maxima. NaN caveat: a nonfinite shard
+        # makes its sq-sum nonfinite — exactly the signal we want
+        # propagated — while the *_bad flags use any(~isfinite), which
+        # never yields NaN itself.
+        stacked = lax.psum(
+            jnp.stack(
+                [g_sq, u_sq, p_sq, g_bad, u_bad]
+                + [mod_sq[m] for m in modules]
+            ),
+            axes,
+        )
+        u_mx = lax.pmax(u_mx, axes)
+        g_sq, u_sq, p_sq, g_bad, u_bad = (
+            stacked[0], stacked[1], stacked[2], stacked[3], stacked[4]
+        )
+        mod_sq = {m: stacked[5 + i] for i, m in enumerate(modules)}
+
+    g_norm = jnp.sqrt(g_sq)
+    u_norm = jnp.sqrt(u_sq)
+    p_norm = jnp.sqrt(p_sq)
+    # rounding in the flag psums: counts are integral by construction
+    g_bad = jnp.round(g_bad)
+    u_bad = jnp.round(u_bad)
+    return {
+        "grad_norm": g_norm,
+        "grad_norm_per_module": {m: jnp.sqrt(mod_sq[m]) for m in modules},
+        "nonfinite_grad_leaves": g_bad,
+        "nonfinite_update_leaves": u_bad,
+        "update_max_abs": u_mx,
+        "update_norm": u_norm,
+        "param_norm": p_norm,
+        "update_ratio": u_norm / (p_norm + eps),
+    }
+
+
+def host_health(health: Any) -> Any:
+    """Device health pytree -> plain nested dict of python floats (one
+    blocking fetch; the flight recorder's record format)."""
+    if health is None:
+        return None
+    return jax.tree_util.tree_map(float, health)

@@ -48,6 +48,16 @@ class FailureDetector(Callback):
     ``spike_factor``: optional; flag loss > spike_factor * median of the
     last ``window`` finite losses (needs at least ``window // 2``
     history before it arms — startup loss drops must not trip it).
+
+    ``recorder``: optional ``telemetry.FlightRecorder`` sharing the
+    callback list (the recorder runs at order -20, this detector at
+    -10, so a trigger fired this step is already recorded AND dumped by
+    the time it is consumed here). When a structured trigger is
+    pending, ``handle_failure`` fires with the trigger's reason —
+    "nonfinite: non-finite gradients in module group 'embed'" — instead
+    of this detector's bare loss check, so recovery reacts to *which*
+    signal fired (grad overflow, update overflow, loss spike) and the
+    black-box path lands in the raised/logged message.
     """
 
     order = -10  # run before logging/checkpoint callbacks see the step
@@ -57,6 +67,7 @@ class FailureDetector(Callback):
         check_every: int = 1,
         spike_factor: Optional[float] = None,
         window: int = 50,
+        recorder: Optional[Any] = None,
     ):
         if check_every < 1:
             raise ValueError(f"check_every must be >= 1, got {check_every}")
@@ -65,6 +76,7 @@ class FailureDetector(Callback):
         self.check_every = check_every
         self.spike_factor = spike_factor
         self.window = window
+        self.recorder = recorder
         self._history: deque = deque(maxlen=window)
 
     def _is_divergent(self, loss: float) -> Optional[str]:
@@ -81,6 +93,16 @@ class FailureDetector(Callback):
         return None
 
     def on_step_end(self, trainer: Any, step: int, loss) -> None:
+        if self.recorder is not None:
+            trig = self.recorder.take_trigger()
+            if trig is not None:
+                where = (
+                    f" (black box: {trig.dump_path})" if trig.dump_path else ""
+                )
+                self.handle_failure(
+                    trainer, step, f"{trig.name}: {trig.reason}{where}"
+                )
+                return
         if step % self.check_every:
             return
         from pipegoose_tpu.trainer.callback import _host_scalar
@@ -106,8 +128,9 @@ class AutoRecovery(FailureDetector):
         check_every: int = 1,
         spike_factor: Optional[float] = None,
         window: int = 50,
+        recorder: Optional[Any] = None,
     ):
-        super().__init__(check_every, spike_factor, window)
+        super().__init__(check_every, spike_factor, window, recorder)
         self.directory = directory
         self.max_restores = max_restores
         self.restores = 0
@@ -128,6 +151,11 @@ class AutoRecovery(FailureDetector):
             ) from e
         self.restores += 1
         self._history.clear()
+        if self.recorder is not None:
+            # the spike/explosion baselines span the rolled-back steps;
+            # also drops any still-pending trigger so the NEXT round
+            # doesn't re-fire on the pre-restore evidence
+            self.recorder.reset_after_restore(restored_step)
         # drop the post-restore-invalid tail of the loss record so later
         # consumers (plots, early stopping) don't see the divergence.
         # losses counts entries since THIS trainer started (a resumed

@@ -35,6 +35,7 @@ class Trainer:
         grad_sync_axes: tuple = (),
         with_rng: bool = False,
         n_accum: int = 1,
+        with_health: bool = False,
         callbacks: Sequence[Callback] = (),
         logger: Optional[DistributedLogger] = None,
         resume_dir: Optional[str] = None,
@@ -44,6 +45,10 @@ class Trainer:
         self.callbacks = sorted(callbacks, key=lambda c: c.order)
         self.state = TrainerState()
         self.with_rng = with_rng
+        # with_health: the compiled step also returns the in-graph
+        # health pytree (telemetry/health.py), kept on-device in
+        # state.last_health for callbacks (FlightRecorder) to consume
+        self.with_health = with_health
         self.tokens_per_step = 0  # updated from batch shapes each step
         # TelemetryCallback's cost-probe input: valid only DURING the
         # step-end callback round, cleared right after so the trainer
@@ -60,6 +65,7 @@ class Trainer:
             grad_sync_axes=grad_sync_axes,
             with_rng=with_rng,
             n_accum=n_accum,
+            with_health=with_health,
         )
         self.param_specs = param_specs
         self.optimizer = optimizer
@@ -254,7 +260,15 @@ class Trainer:
                 # backpressures to device step time. TelemetryCallback
                 # (fence=True) gives exact per-step device attribution.
                 with span("train.step"):
-                    self.params, self.opt_state, loss = self._step_fn(*args)
+                    if self.with_health:
+                        self.params, self.opt_state, loss, health = (
+                            self._step_fn(*args)
+                        )
+                        # device pytree, same async-dispatch rule as the
+                        # loss: consumers fetch when they actually look
+                        self.state.last_health = health
+                    else:
+                        self.params, self.opt_state, loss = self._step_fn(*args)
                 # keep loss as a device array: float() here would block the
                 # host every step and kill JAX's async dispatch; callbacks
                 # convert only when they actually log

@@ -1,0 +1,27 @@
+#!/usr/bin/env bash
+# Fast-tier CI: the curated `pytest -m fast` smoke (one representative
+# slice per subsystem, < 5 min on one core — see tests/conftest.py's
+# FAST_FILES/FAST_TESTS tables) on fake CPU devices.
+#
+# The telemetry disabled-cost guards run FIRST and separately, so a
+# perf regression in the always-on instrumentation (the < 5 µs
+# counter/span contract, the health-off byte-identical-program
+# contract) fails loudly up front instead of drowning in the tier's
+# output:
+#
+#   ./scripts/ci_fast.sh            # guards + full fast tier
+#   ./scripts/ci_fast.sh -x -q      # extra pytest args pass through
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+
+echo "== telemetry disabled-cost guards =="
+python -m pytest -q -p no:cacheprovider \
+    "tests/telemetry/test_registry.py::test_disabled_overhead_under_5us" \
+    "tests/telemetry/test_health.py::test_health_off_lowers_to_the_unchanged_program" \
+    "$@"
+
+echo "== fast tier =="
+python -m pytest tests/ -q -m fast -p no:cacheprovider \
+    --continue-on-collection-errors "$@"

@@ -60,6 +60,9 @@ FAST_FILES = {
     "tests/telemetry/test_registry.py",         # metrics + <5µs overhead guard
     "tests/telemetry/test_spans.py",            # span tracing + jit safety
     "tests/telemetry/test_exporters.py",        # JSONL / Prometheus / rank-0
+    "tests/telemetry/test_flightrec.py",        # flight recorder (host-only)
+    "tests/telemetry/test_chrometrace.py",      # Perfetto export + bubble
+    "tests/trainer/test_logger.py",             # rank-0 logging (host-only)
     "tests/utils/test_profiler.py",             # cost analysis arithmetic
 }
 FAST_TESTS = {
@@ -117,6 +120,11 @@ FAST_TESTS = {
     # telemetry: engine instrumentation vs legacy dict + compiled comms
     "tests/serving/test_engine.py::test_engine_telemetry_agrees_with_legacy_metrics",
     "tests/telemetry/test_derived.py::test_compiled_step_stats_reports_flops_and_comms",
+    # health stats: pure math + the health-off zero-cost guard
+    "tests/telemetry/test_health.py::test_health_stats_math_single_device",
+    "tests/telemetry/test_health.py::test_health_off_lowers_to_the_unchanged_program",
+    # serving stall watchdog (no jitted work: pure scheduler livelock)
+    "tests/serving/test_engine.py::test_stall_watchdog_dumps_and_raises",
     # memory dry passes (analytic only; the AOT compile is `slow`)
     "tests/test_8x7b_memory.py::test_8x7b_param_count",
     "tests/test_8x7b_memory.py::test_8x7b_fits_v5p64_4d_sharding",

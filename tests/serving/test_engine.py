@@ -236,3 +236,46 @@ def test_serving_ab_benchmark_reports_speedup(setup):
         assert res[arm]["decode_tokens_per_s"] > 0
         assert 0 < res[arm]["slot_occupancy"] <= 1.0
     assert res["continuous"]["decode_steps"] <= res["static"]["decode_steps"]
+
+
+def test_stall_watchdog_dumps_and_raises(setup, tmp_path):
+    """The no-decode-progress watchdog: a queue whose head can never be
+    admitted (pool pages exhausted behind the scheduler's back stands in
+    for a reservation-accounting bug) must raise a decode-stall error
+    with a flight-recorder black box, not livelock the run loop."""
+    from pipegoose_tpu.telemetry import FlightRecorder
+
+    cfg, params, prompts = setup
+    rec = FlightRecorder(str(tmp_path), capacity=8)
+    eng = ServingEngine(params, cfg, num_slots=2, num_pages=8,
+                        page_size=4, max_context=32, recorder=rec,
+                        stall_patience=5)
+    eng.pool.alloc(eng.pool.free_count - 1)   # strand the pool
+    with pytest.raises(RuntimeError, match="decode stall"):
+        eng.run([Request(prompt=prompts[0], max_new_tokens=4)])
+    trig = rec.take_trigger()
+    assert trig is not None and trig.name == "decode_stall"
+    assert "queued" in trig.reason and "pages free" in trig.reason
+    import json
+    import os
+
+    assert trig.dump_path and os.path.exists(trig.dump_path)
+    data = json.load(open(trig.dump_path))
+    assert data["trigger"]["name"] == "decode_stall"
+    assert data["context"]["queued"] == 1
+
+
+def test_recorder_rings_decode_steps(setup, tmp_path):
+    from pipegoose_tpu.telemetry import FlightRecorder
+
+    cfg, params, prompts = setup
+    rec = FlightRecorder(str(tmp_path), capacity=64)
+    eng = ServingEngine(params, cfg, num_slots=2, num_pages=32,
+                        page_size=4, max_context=64, recorder=rec)
+    _, metrics = eng.run([
+        Request(prompt=p, max_new_tokens=n)
+        for p, (_, n) in zip(prompts[:3], MIXED[:3])
+    ])
+    steps = [r for r in rec.records if r["kind"] == "serving.step"]
+    assert len(steps) == metrics["decode_steps"]
+    assert all(r["dur_s"] > 0 and r["active"] >= 1 for r in steps)

@@ -1,0 +1,182 @@
+"""Perfetto/Chrome trace export (telemetry/chrometrace.py): trace_event
+schema validity (the "loads in Perfetto" contract is: one JSON object
+with µs complete events, int pids/tids, metadata names), the pipeline
+clock timeline rows, and the bubble-fraction gauge."""
+import json
+import os
+import threading
+
+import pytest
+
+from pipegoose_tpu.nn.pipeline_parallel.scheduler import (
+    GPipeScheduler,
+    OneFOneBScheduler,
+)
+from pipegoose_tpu.telemetry import MetricsRegistry
+from pipegoose_tpu.telemetry.chrometrace import (
+    ChromeTraceExporter,
+    pipeline_trace_events,
+    register_pipeline_gauges,
+    span_events_to_trace,
+    trace_from_jsonl,
+)
+from pipegoose_tpu.telemetry.spans import span
+
+
+def _assert_valid_trace(payload):
+    assert set(payload) >= {"traceEvents", "displayTimeUnit"}
+    for ev in payload["traceEvents"]:
+        assert isinstance(ev["name"], str)
+        assert ev["ph"] in ("X", "M", "i")
+        assert isinstance(ev["pid"], int)
+        if ev["ph"] != "M" or "tid" in ev:
+            assert isinstance(ev.get("tid", 0), int)
+        if ev["ph"] == "X":
+            assert ev["dur"] >= 0 and ev["ts"] >= 0
+    json.dumps(payload)  # fully serializable
+
+
+def test_span_events_to_trace_microsecond_math():
+    events = [
+        {"kind": "span", "span": "train.step", "ts": 10.0, "dur_s": 0.5},
+        {"kind": "train.fit_start", "ts": 9.0},
+        {"no_kind": True},  # ignored
+    ]
+    out = span_events_to_trace(events)
+    assert len(out) == 2
+    slice_, instant = out
+    assert slice_["name"] == "train.step" and slice_["ph"] == "X"
+    assert slice_["ts"] == pytest.approx(9.5e6)   # start = end - dur
+    assert slice_["dur"] == pytest.approx(0.5e6)
+    assert instant["ph"] == "i" and instant["name"] == "train.fit_start"
+
+
+def test_pipeline_trace_events_rows_match_schedule():
+    M, P = 4, 2
+    sched = GPipeScheduler(M, P)
+    events = pipeline_trace_events(sched, clock_s=1e-3)
+    meta = [e for e in events if e["ph"] == "M"]
+    slices = [e for e in events if e["ph"] == "X"]
+    # one thread_name per stage + the process_name
+    assert {m["args"]["name"] for m in meta} == {
+        "pipeline (theoretical clock timeline)", "stage 0", "stage 1",
+    }
+    # every (microbatch, stage) task appears once per direction
+    fwd = [e for e in slices if e["cat"] == "pipeline.forward"]
+    bwd = [e for e in slices if e["cat"] == "pipeline.backward"]
+    assert len(fwd) == M * P and len(bwd) == M * P
+    # forward task (m, p) sits at clock m + p on stage p's row
+    for e in fwd:
+        m, p = e["args"]["microbatch"], e["args"]["stage"]
+        assert e["tid"] == p
+        assert e["args"]["clock"] == m + p
+        assert e["ts"] == pytest.approx((m + p) * 1e-3 * 1e6)
+    # backwards start after the forward clocks
+    n_fwd = sched.total_forward_clocks
+    assert min(e["args"]["clock"] for e in bwd) == n_fwd
+    _assert_valid_trace({"traceEvents": events, "displayTimeUnit": "ms"})
+
+
+def test_bubble_fraction_and_gauges():
+    assert GPipeScheduler(4, 4).bubble_fraction == pytest.approx(3 / 7)
+    assert GPipeScheduler(8, 1).bubble_fraction == 0.0
+    assert GPipeScheduler(1, 4).bubble_fraction == pytest.approx(3 / 4)
+    # the 1F1B reordering keeps the same bubble (it moves idle clocks)
+    assert OneFOneBScheduler(4, 4).bubble_fraction == pytest.approx(3 / 7)
+
+    reg = MetricsRegistry(enabled=True)
+    frac = register_pipeline_gauges(
+        GPipeScheduler(8, 4), registry=reg, step_seconds=0.2
+    )
+    assert frac == pytest.approx(3 / 11)
+    assert reg.gauge("pipeline.bubble_fraction").value == pytest.approx(3 / 11)
+    assert reg.gauge("pipeline.bubble_seconds").value == (
+        pytest.approx(0.2 * 3 / 11)
+    )
+    assert reg.gauge("pipeline.n_microbatches").value == 8.0
+
+
+def test_exporter_collects_spans_and_writes_atomically(tmp_path):
+    reg = MetricsRegistry(enabled=True)
+    path = str(tmp_path / "trace.json")
+    exp = ChromeTraceExporter(path, registry=reg)
+    with span("train.step", registry=reg):
+        with span("forward", registry=reg):
+            pass
+    reg.event("train.fit_end")
+    exp.add_pipeline_timeline(GPipeScheduler(2, 2), clock_s=1e-3)
+    out = exp.write()
+    assert out == path
+    assert not [f for f in os.listdir(tmp_path) if f.endswith(".tmp")]
+
+    payload = json.load(open(path))
+    _assert_valid_trace(payload)
+    names = [e["name"] for e in payload["traceEvents"]]
+    assert "train.step" in names
+    assert "train.step.forward" in names          # nesting kept the path
+    assert "train.fit_end" in names               # instant marker
+    assert "F0" in names and "B1" in names        # pipeline rows
+    # the nested span sits inside its parent's interval — with µs-scale
+    # slack: a slice start is RECONSTRUCTED as exit-wall-clock minus a
+    # perf_counter duration, and the two clocks are read a few µs apart
+    # at each exit, so exact ordering at the boundary is not guaranteed
+    slack_us = 1000.0
+    by = {e["name"]: e for e in payload["traceEvents"] if e["ph"] == "X"}
+    outer, inner = by["train.step"], by["train.step.forward"]
+    assert outer["ts"] <= inner["ts"] + slack_us
+    assert inner["ts"] + inner["dur"] <= outer["ts"] + outer["dur"] + slack_us
+    exp.close()
+    assert exp not in reg._sinks
+
+
+def test_exporter_bounds_memory_keeping_newest(tmp_path):
+    exp = ChromeTraceExporter(str(tmp_path / "t.json"), max_events=5)
+    for i in range(12):
+        exp({"kind": "span", "span": f"s{i}", "ts": float(i), "dur_s": 0.1})
+    payload_path = exp.write()
+    payload = json.load(open(payload_path))
+    spans = [e for e in payload["traceEvents"] if e["ph"] == "X"]
+    assert [e["name"] for e in spans] == ["s7", "s8", "s9", "s10", "s11"]
+    assert payload["otherData"]["dropped_events"] == 7
+
+
+def test_exporter_separates_threads(tmp_path):
+    exp = ChromeTraceExporter(str(tmp_path / "t.json"))
+    exp({"kind": "span", "span": "main", "ts": 1.0, "dur_s": 0.1})
+    t = threading.Thread(
+        target=exp, args=({"kind": "span", "span": "bg", "ts": 1.0,
+                           "dur_s": 0.1},)
+    )
+    t.start()
+    t.join()
+    payload = json.load(open(exp.write()))
+    by = {e["name"]: e for e in payload["traceEvents"] if e["ph"] == "X"}
+    assert by["main"]["tid"] != by["bg"]["tid"]
+
+
+def test_rank_filter_suppresses_write(tmp_path):
+    exp = ChromeTraceExporter(str(tmp_path / "t.json"), rank=7)
+    exp({"kind": "span", "span": "s", "ts": 1.0, "dur_s": 0.1})
+    assert exp.write() is None
+    assert not os.path.exists(tmp_path / "t.json")
+
+
+def test_trace_from_jsonl_offline_conversion(tmp_path):
+    jsonl = tmp_path / "run.jsonl"
+    lines = [
+        {"ts": 1.0, "kind": "span", "span": "serving.decode_step",
+         "dur_s": 0.01},
+        {"ts": 2.0, "kind": "snapshot", "counters": {}},  # skipped
+        {"ts": 3.0, "kind": "serving.step", "step": 1},
+    ]
+    with open(jsonl, "w") as f:
+        for l in lines:
+            f.write(json.dumps(l) + "\n")
+        f.write('{"truncated": \n')  # killed-run tail must not block
+    out = trace_from_jsonl(str(jsonl), str(tmp_path / "trace.json"))
+    payload = json.load(open(out))
+    _assert_valid_trace(payload)
+    names = [e["name"] for e in payload["traceEvents"]]
+    assert "serving.decode_step" in names
+    assert "serving.step" in names
+    assert "snapshot" not in names

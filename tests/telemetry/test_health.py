@@ -1,0 +1,193 @@
+"""In-graph health stats (telemetry/health.py) + the
+``make_hybrid_train_step(with_health=...)`` contract: sharded stats
+match a single-device reference, nonfinite injection is localized to
+the offending module group, and the OFF path lowers to a program with
+no health ops in it (the zero-cost guard)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from pipegoose_tpu.distributed import ParallelContext
+from pipegoose_tpu.models import bloom
+from pipegoose_tpu.optim.zero import DistributedOptimizer
+from pipegoose_tpu.parallel import make_hybrid_train_step
+from pipegoose_tpu.telemetry.health import health_stats, host_health
+
+
+# -- pure arithmetic (no mesh) ---------------------------------------------
+
+
+def test_health_stats_math_single_device():
+    params = {
+        "embed": {"w": jnp.asarray([[3.0, 4.0]])},       # norm 5
+        "head": {"b": jnp.asarray([0.0, 0.0])},
+    }
+    grads = {
+        "embed": {"w": jnp.asarray([[0.6, 0.8]])},       # norm 1
+        "head": {"b": jnp.asarray([2.0, 0.0])},          # norm 2
+    }
+    new_params = {
+        "embed": {"w": jnp.asarray([[3.0, 4.0]])},       # update 0
+        "head": {"b": jnp.asarray([0.5, 0.0])},          # update (.5, 0)
+    }
+    specs = jax.tree_util.tree_map(lambda _: P(), params)
+    h = host_health(health_stats(grads, params, new_params, specs))
+    assert h["grad_norm"] == pytest.approx(np.sqrt(5.0))
+    assert h["grad_norm_per_module"]["embed"] == pytest.approx(1.0)
+    assert h["grad_norm_per_module"]["head"] == pytest.approx(2.0)
+    assert h["param_norm"] == pytest.approx(5.0)
+    assert h["update_norm"] == pytest.approx(0.5)
+    assert h["update_max_abs"] == pytest.approx(0.5)
+    assert h["update_ratio"] == pytest.approx(0.1, rel=1e-5)
+    assert h["nonfinite_grad_leaves"] == 0.0
+    assert h["nonfinite_update_leaves"] == 0.0
+
+
+def test_health_stats_counts_nonfinite_leaves():
+    params = {"a": jnp.ones(3), "b": jnp.ones(3)}
+    specs = {"a": P(), "b": P()}
+    grads = {"a": jnp.asarray([1.0, jnp.nan, 1.0]), "b": jnp.ones(3)}
+    new_params = {"a": jnp.ones(3), "b": jnp.asarray([jnp.inf, 1.0, 1.0])}
+    h = host_health(health_stats(grads, params, new_params, specs))
+    assert h["nonfinite_grad_leaves"] == 1.0
+    assert h["nonfinite_update_leaves"] == 1.0
+    assert np.isnan(h["grad_norm"])                      # NaN propagates
+    assert np.isnan(h["grad_norm_per_module"]["a"])
+    assert h["grad_norm_per_module"]["b"] == pytest.approx(np.sqrt(3.0))
+
+
+def test_health_stats_tree_mismatch_raises():
+    params = {"a": jnp.ones(2)}
+    with pytest.raises(ValueError, match="tree mismatch"):
+        health_stats(
+            {"a": jnp.ones(2), "b": jnp.ones(2)}, params, params,
+            {"a": P()},
+        )
+
+
+# -- sharded step equivalence ----------------------------------------------
+
+
+@pytest.fixture()
+def parts(devices):
+    cfg = bloom.BloomConfig(vocab_size=64, hidden_size=32, n_layer=2, n_head=2)
+    params = bloom.init_params(cfg, jax.random.PRNGKey(0))
+    ctx = ParallelContext(tensor_parallel_size=2, data_parallel_size=4)
+    yield cfg, params, ctx
+    ctx.destroy()
+
+
+def _hybrid_health_step(cfg, params, ctx, loss_fn, **kwargs):
+    init_fn, make_step = make_hybrid_train_step(
+        loss_fn, bloom.tp_specs(params),
+        DistributedOptimizer(optax.adam(1e-3), axis_name="data"), ctx,
+        with_health=True, **kwargs,
+    )
+    return init_fn(params), make_step(params)
+
+
+def test_sharded_health_matches_single_device_reference(parts):
+    cfg, params, ctx = parts
+
+    def loss_fn(p, ids):
+        return bloom.loss_fn(p, ids, None, ids, cfg, tp_axis="tensor")
+
+    p0 = jax.tree_util.tree_map(jnp.copy, params)
+    opt_state, step = _hybrid_health_step(cfg, params, ctx, loss_fn)
+    ids = jnp.asarray(np.random.RandomState(0).randint(0, 64, (8, 8)))
+    new_p, _, loss, health = step(params, opt_state, ids)
+    h = host_health(health)
+
+    # reference grad norm: plain single-device value_and_grad
+    _, g = jax.value_and_grad(
+        lambda p, i: bloom.loss_fn(p, i, None, i, cfg)
+    )(p0, ids)
+    ref_sq = sum(
+        float(jnp.sum(jnp.square(x.astype(jnp.float32))))
+        for x in jax.tree_util.tree_leaves(g)
+    )
+    assert h["grad_norm"] == pytest.approx(np.sqrt(ref_sq), rel=1e-4)
+    # per-module norms recombine to the global norm
+    assert sum(v ** 2 for v in h["grad_norm_per_module"].values()) == (
+        pytest.approx(h["grad_norm"] ** 2, rel=1e-5)
+    )
+    assert set(h["grad_norm_per_module"]) == set(params.keys())
+
+    # update stats against the actually-applied update
+    upd = jax.tree_util.tree_map(
+        lambda a, b: a.astype(jnp.float32) - b.astype(jnp.float32), new_p, p0
+    )
+    ref_u = np.sqrt(sum(
+        float(jnp.sum(jnp.square(x))) for x in jax.tree_util.tree_leaves(upd)
+    ))
+    ref_umx = max(
+        float(jnp.max(jnp.abs(x))) for x in jax.tree_util.tree_leaves(upd)
+    )
+    assert h["update_norm"] == pytest.approx(ref_u, rel=1e-4)
+    assert h["update_max_abs"] == pytest.approx(ref_umx, rel=1e-4)
+    assert 0 < h["update_ratio"] < 1
+    assert h["nonfinite_grad_leaves"] == 0.0
+    assert np.isfinite(float(loss))
+
+
+def test_injected_overflow_localizes_to_module_group(parts):
+    """A gradient bomb on the embedding shows up as nonfinite leaves and
+    a nonfinite 'embed' per-module norm while other groups stay finite —
+    the signal the flight-recorder dump names."""
+    cfg, params, ctx = parts
+
+    def loss_fn(p, ids):
+        base = bloom.loss_fn(p, ids, None, ids, cfg, tp_axis="tensor")
+        bomb = jnp.where(ids[0, 0] == 0, jnp.float32(jnp.inf), 0.0)
+        return base + bomb * jnp.sum(
+            jnp.square(p["embed"]["weight"].astype(jnp.float32))
+        )
+
+    opt_state, step = _hybrid_health_step(cfg, params, ctx, loss_fn)
+    ids = np.random.RandomState(0).randint(1, 64, (8, 8))
+    ids[0, 0] = 0  # arm the bomb
+    _, _, loss, health = step(params, opt_state, jnp.asarray(ids))
+    h = host_health(health)
+    assert h["nonfinite_grad_leaves"] > 0
+    assert not np.isfinite(h["grad_norm_per_module"]["embed"])
+    assert np.isfinite(h["grad_norm_per_module"]["blocks"])
+    assert np.isfinite(h["grad_norm_per_module"]["ln_f"])
+    assert not np.isfinite(float(loss))
+
+
+# -- the zero-cost OFF guard -----------------------------------------------
+
+
+def test_health_off_lowers_to_the_unchanged_program(parts):
+    """with_health=False must cost NOTHING: same output arity as the
+    pre-feature step and a lowered program containing none of the
+    health reductions (``is-finite`` ops), so the off path cannot
+    regress step time. The ON program carries them and one extra
+    (replicated-scalars) output tree."""
+    cfg, params, ctx = parts
+
+    def loss_fn(p, ids):
+        return bloom.loss_fn(p, ids, None, ids, cfg, tp_axis="tensor")
+
+    specs = bloom.tp_specs(params)
+    opt = DistributedOptimizer(optax.adam(1e-3), axis_name="data")
+    ids = jnp.asarray(np.random.RandomState(0).randint(0, 64, (8, 8)))
+
+    lowered, arity = {}, {}
+    for flag in (False, True):
+        init_fn, make_step = make_hybrid_train_step(
+            loss_fn, specs, opt, ctx, with_health=flag
+        )
+        opt_state = jax.eval_shape(init_fn, params)
+        step = make_step(params)
+        lowered[flag] = step.lower(params, opt_state, ids).as_text()
+        arity[flag] = len(jax.eval_shape(step, params, opt_state, ids))
+
+    off, on = lowered[False], lowered[True]
+    assert "is_finite" not in off and "is-finite" not in off
+    assert "is_finite" in on or "is-finite" in on
+    # off output arity: (params, opt_state, loss) and nothing else
+    assert arity[False] == 3 and arity[True] == 4

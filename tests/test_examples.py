@@ -29,6 +29,9 @@ CASES = [
     ("telemetry_demo.py", ["--fake-devices", "8", "--tp", "2", "--dp", "4",
                            "--requests", "4", "--out-dir",
                            "/tmp/pipegoose_telemetry_demo_test"]),
+    ("flight_recorder_demo.py", ["--fake-devices", "8", "--tp", "2",
+                                 "--dp", "4", "--out-dir",
+                                 "/tmp/pipegoose_flightrec_demo_test"]),
 ]
 
 

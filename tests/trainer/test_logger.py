@@ -1,0 +1,130 @@
+"""DistributedLogger (trainer/logger.py): rank-0 filtering, the cached
+``process_index`` lookup (shared RankFilter contract with the telemetry
+exporters), level routing, and handler idempotency. Host-only — no
+device work."""
+import logging
+import uuid
+
+from pipegoose_tpu.trainer.logger import DistributedLogger
+from pipegoose_tpu.utils.procindex import RankFilter
+
+
+def _fresh_name():
+    # logging.getLogger caches by name process-wide; unique names keep
+    # handler assertions independent across tests
+    return f"pgt_test_{uuid.uuid4().hex[:8]}"
+
+
+def test_info_warning_error_paths_emit(capsys):
+    log = DistributedLogger(name=_fresh_name())
+    log.info("hello-info")
+    log.warning("hello-warning")
+    log.error("hello-error")
+    out = capsys.readouterr().out
+    assert "hello-info" in out and "INFO" in out
+    assert "hello-warning" in out and "WARNING" in out
+    assert "hello-error" in out and "ERROR" in out
+
+
+def test_debug_below_default_level_is_dropped(capsys):
+    log = DistributedLogger(name=_fresh_name())          # default INFO
+    log.debug("quiet")
+    assert "quiet" not in capsys.readouterr().out
+    log2 = DistributedLogger(name=_fresh_name(), level=logging.DEBUG)
+    log2.debug("loud")
+    assert "loud" in capsys.readouterr().out
+
+
+def test_rank_filtering(capsys, monkeypatch):
+    import jax
+
+    monkeypatch.setattr(jax, "process_index", lambda: 0)
+    # this IS process 0: rank=0 logs, rank=1 doesn't, None always does
+    DistributedLogger(name=_fresh_name(), rank=0).info("from-rank0")
+    DistributedLogger(name=_fresh_name(), rank=1).info("from-rank1")
+    DistributedLogger(name=_fresh_name(), rank=None).info("from-any")
+    out = capsys.readouterr().out
+    assert "from-rank0" in out
+    assert "from-rank1" not in out
+    assert "from-any" in out
+
+
+def test_process_index_is_cached_after_first_lookup(monkeypatch):
+    import jax
+
+    calls = {"n": 0}
+
+    def fake_index():
+        calls["n"] += 1
+        return 0
+
+    monkeypatch.setattr(jax, "process_index", fake_index)
+    log = DistributedLogger(name=_fresh_name(), rank=0)
+    assert calls["n"] == 0        # construction must not force backend init
+    log.info("a")
+    log.info("b")
+    log.warning("c")
+    assert calls["n"] == 1        # one lookup, cached thereafter
+
+    # the shared RankFilter behaves identically (the exporters' path)
+    calls["n"] = 0
+    f = RankFilter(0)
+    assert f() and f() and calls["n"] == 1
+    # rank=None never needs the index at all
+    calls["n"] = 0
+    assert RankFilter(None)()
+    assert calls["n"] == 0
+
+
+def test_handlers_not_duplicated_on_reconstruction(capsys):
+    name = _fresh_name()
+    DistributedLogger(name=name).info("once")
+    DistributedLogger(name=name).info("twice")
+    out = capsys.readouterr().out
+    # each message printed exactly once despite two constructions
+    assert out.count("once") == 1
+    assert out.count("twice") == 1
+    stream_handlers = [
+        h for h in logging.getLogger(name).handlers
+        if isinstance(h, logging.StreamHandler)
+        and not isinstance(h, logging.FileHandler)
+    ]
+    assert len(stream_handlers) == 1
+
+
+def test_logfile_handler_writes_and_deduplicates(tmp_path):
+    name = _fresh_name()
+    path = str(tmp_path / "train.log")
+    log = DistributedLogger(name=name, logfile=path)
+    log.info("to-file")
+    # re-constructing with the same logfile must not double the handler
+    DistributedLogger(name=name, logfile=path).info("again")
+    file_handlers = [
+        h for h in logging.getLogger(name).handlers
+        if isinstance(h, logging.FileHandler)
+    ]
+    assert len(file_handlers) == 1
+    for h in file_handlers:
+        h.flush()
+    text = open(path).read()
+    assert text.count("to-file") == 1
+    assert text.count("again") == 1
+
+
+def test_no_propagation_to_root(capsys):
+    """propagate=False: the root logger must not re-emit our lines
+    (double printing was the classic symptom)."""
+    records = []
+
+    class Probe(logging.Handler):
+        def emit(self, record):
+            records.append(record.getMessage())
+
+    probe = Probe()
+    logging.getLogger().addHandler(probe)
+    try:
+        DistributedLogger(name=_fresh_name()).info("contained")
+    finally:
+        logging.getLogger().removeHandler(probe)
+    assert "contained" not in records
+    assert "contained" in capsys.readouterr().out

@@ -164,6 +164,72 @@ def test_failed_status_on_divergence(parts):
     assert trainer.state.status is TrainerStatus.FAILED
 
 
+def test_flight_recorder_dump_names_module_and_recovery_continues(
+    parts, tmp_path
+):
+    """The acceptance loop for the health/forensics layer: an injected
+    mid-run GRADIENT overflow (inf localized to the embedding group, via
+    an in-graph bomb) with ``with_health=True`` must (a) write a
+    flight-recorder black box whose trigger names the offending module
+    group, (b) drive AutoRecovery through the recorder's structured
+    trigger — not the bare loss — and (c) leave training continued from
+    the restored checkpoint with finite state."""
+    import json
+
+    from pipegoose_tpu.telemetry import FlightRecorder
+
+    cfg, params, ctx = parts
+    run_dir = str(tmp_path / "run")
+    bb_dir = tmp_path / "blackbox"
+
+    def loss_fn(p, ids):
+        base = bloom.loss_fn(p, ids, None, ids, cfg, tp_axis="tensor")
+        bomb = jnp.where(ids[0, 0] == POISON, jnp.float32(jnp.inf), 0.0)
+        return base + bomb * jnp.sum(
+            jnp.square(p["embed"]["weight"].astype(jnp.float32))
+        )
+
+    recorder = FlightRecorder(str(bb_dir), capacity=16)
+    auto = AutoRecovery(run_dir, max_restores=1, recorder=recorder)
+    trainer = Trainer(
+        loss_fn, params, bloom.tp_specs(params),
+        DistributedOptimizer(optax.adam(1e-3), axis_name="data"), ctx,
+        with_health=True,
+        callbacks=[CheckpointCallback(run_dir, every=1), recorder, auto],
+    )
+    batches = [
+        _batch(cfg, 1), _batch(cfg, 2),      # steps 1-2 (ckpt each)
+        _batch(cfg, 3, poison=True),         # grad overflow -> restore @2
+        _batch(cfg, 4),                      # continues: step 3
+    ]
+    state = trainer.fit(batches)
+    assert auto.restores == 1
+    assert state.step == 3
+    assert np.isfinite(float(state.last_loss))
+    for leaf in jax.tree_util.tree_leaves(trainer.params):
+        assert np.isfinite(np.asarray(leaf)).all()
+
+    dumps = sorted(bb_dir.glob("blackbox_*.json"))
+    assert len(dumps) == 1, f"expected one black box, got {dumps}"
+    data = json.load(open(dumps[0]))
+    assert data["trigger"]["name"] == "nonfinite"
+    assert "'embed'" in data["trigger"]["reason"]    # offending group named
+    assert data["trigger"]["step"] == 3
+    assert data["trigger"]["details"]["bad_modules"] == ["embed"]
+    # the ring holds the healthy lead-up AND the failing step's health
+    steps_rec = [r for r in data["records"] if r["kind"] == "train.step"]
+    assert [r["step"] for r in steps_rec] == [1, 2, 3]
+    assert steps_rec[-1]["health"]["nonfinite_grad_leaves"] > 0
+    assert all(
+        np.isfinite(r["health"]["grad_norm"]) for r in steps_rec[:-1]
+    )
+    assert data["context"]["mesh_axes"]["tensor"] == 2
+    assert "jax" in data["environment"]
+    # post-restore: baselines were reset and the ring carries the marker
+    kinds = [r["kind"] for r in recorder.records]
+    assert "restore" in kinds
+
+
 def test_checkpoint_refuses_nonfinite_state(parts, tmp_path):
     """A detector with check_every > 1 lets divergence slip past a check
     boundary; the checkpoint callback must NOT persist state whose last

@@ -167,3 +167,53 @@ def test_evaluate_token_weighted(parts):
 
     equal = trainer.evaluate(batches)
     assert abs(equal - got) > 1e-6  # the two means genuinely differ here
+
+
+def test_loss_history_ring_bounds_and_converts():
+    """LossHistory (trainer/state.py): the per-step loss record stays
+    bounded (ring) and opportunistically converts entries older than
+    sync_lag to host floats, so long runs don't accumulate thousands of
+    live device arrays — while keeping the list API AutoRecovery's
+    rollback slicing relies on."""
+    from pipegoose_tpu.trainer.state import LossHistory
+
+    h = LossHistory(maxlen=8, sync_lag=2)
+    for i in range(20):
+        h.append(jnp.float32(i))
+    assert len(h) == 8
+    assert [float(x) for x in h] == [12.0, 13.0, 14.0, 15.0, 16.0, 17.0,
+                                     18.0, 19.0]
+    # everything older than sync_lag is already a plain host float
+    assert all(isinstance(x, float) for x in h[:-2])
+    # the newest sync_lag entries may still be device arrays
+    assert not isinstance(h[-1], float)
+    # list surgery (AutoRecovery's rollback) still works
+    del h[6:]
+    assert len(h) == 6 and float(h[-1]) == 17.0
+    with pytest.raises(ValueError, match="maxlen"):
+        LossHistory(maxlen=0)
+
+
+def test_fit_populates_bounded_losses_and_health(parts):
+    """fit() with with_health=True exposes the in-graph health pytree on
+    state.last_health, and state.losses is the bounded LossHistory."""
+    from pipegoose_tpu.telemetry.health import host_health
+    from pipegoose_tpu.trainer.state import LossHistory
+
+    cfg, params, ctx = parts
+
+    def loss_fn(p, ids):
+        return bloom.loss_fn(p, ids, None, ids, cfg, tp_axis="tensor")
+
+    trainer = Trainer(
+        loss_fn, params, bloom.tp_specs(params),
+        DistributedOptimizer(optax.adam(1e-3), axis_name="data"), ctx,
+        with_health=True,
+    )
+    state = trainer.fit(_batches(cfg, 3))
+    assert isinstance(state.losses, LossHistory)
+    assert len(state.losses) == 3
+    h = host_health(state.last_health)
+    assert h is not None and np.isfinite(h["grad_norm"])
+    assert set(h["grad_norm_per_module"]) == set(params.keys())
+    assert h["nonfinite_grad_leaves"] == 0.0
