@@ -470,6 +470,60 @@ def run_bench(force_cpu: bool) -> None:
         if os.environ.get("BENCH_CHILD"):
             emit(results)
 
+    # mesh-doctor artifact (BENCH_DOCTOR_JSON, default bench_doctor.json;
+    # empty disables): the benched step's ACTUAL shardings + per-device
+    # HBM table (telemetry/doctor.py), recorded per bench run so a
+    # partitioning regression is visible in the artifact diff, not just
+    # as a slower number. Shape-only AOT compile — nothing executes, and
+    # a doctor failure never discards the measurements above.
+    doctor_path = os.environ.get("BENCH_DOCTOR_JSON", "bench_doctor.json")
+    ok_variants = [k for k, v in results.items() if "error" not in v]
+    if doctor_path and ok_variants:
+        try:
+            import optax as _optax
+
+            from pipegoose_tpu.telemetry import doctor as _doctor
+            from pipegoose_tpu.telemetry.exporters import atomic_write_text
+
+            best_v = max(ok_variants,
+                         key=lambda k: results[k]["tokens_per_sec"])
+            dcfg, _, dseq = variants[best_v]
+            dbatch = results[best_v]["batch"]
+            p_sds = jax.eval_shape(
+                lambda k: bloom.init_params(dcfg, k), jax.random.PRNGKey(0)
+            )
+            dopt = _optax.adam(1e-4)
+            o_sds = jax.eval_shape(dopt.init, p_sds)
+            ids_sds = jax.ShapeDtypeStruct((dbatch, dseq), jnp.int32)
+
+            def one_step(params, opt_state, ids):
+                loss, grads = jax.value_and_grad(bloom.loss_fn)(
+                    params, ids, None, ids, dcfg
+                )
+                updates, opt_state = dopt.update(grads, opt_state, params)
+                return _optax.apply_updates(params, updates), opt_state, loss
+
+            report = _doctor.diagnose(
+                jax.jit(one_step, donate_argnums=(0, 1)),
+                p_sds, o_sds, ids_sds,
+                labels=("params", "opt_state", "batch"),
+            )
+            _doctor.set_doctor_gauges(report, registry=reg)
+            atomic_write_text(doctor_path, json.dumps({
+                "variant": best_v, "device": device_kind,
+                "batch": dbatch, "seq": dseq,
+                "report": report.to_json(),
+            }, indent=1))
+            if tel is not None:
+                reg.event(
+                    "bench.doctor", variant=best_v, path=doctor_path,
+                    replicated_bytes=report.sharding.replicated_bytes,
+                    resharding_bytes=report.sharding.resharding_bytes,
+                    hbm_peak_bytes=report.memory.peak_bytes,
+                )
+        except Exception as e:  # noqa: BLE001
+            sys.stderr.write(f"bench doctor failed (non-fatal): {e}\n")
+
     # serving throughput A/B LAST: the train numbers are the primary
     # contract, a serving failure must not discard them
     try:

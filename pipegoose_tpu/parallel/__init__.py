@@ -2,6 +2,7 @@ from pipegoose_tpu.parallel.auto import make_auto_train_step
 from pipegoose_tpu.parallel.hybrid import (
     make_hybrid_train_step,
     sync_replicated_grads,
+    train_step_intended_specs,
     zero_state_spec,
 )
 
@@ -9,5 +10,6 @@ __all__ = [
     "make_hybrid_train_step",
     "make_auto_train_step",
     "sync_replicated_grads",
+    "train_step_intended_specs",
     "zero_state_spec",
 ]

@@ -84,6 +84,28 @@ def zero_state_spec(
     return ZeroState(inner_spec)
 
 
+def train_step_intended_specs(
+    optimizer: DistributedOptimizer,
+    params: Any,
+    param_specs: Any,
+    mesh,
+    batch_spec: P = P("data"),
+    with_rng: bool = False,
+) -> tuple:
+    """The INTENDED PartitionSpec tuple for a hybrid train step's
+    ``(params, opt_state, batch[, rng])`` arguments — what the mesh
+    doctor (telemetry/doctor.py) diffs the compiled program against.
+    One source of truth: the same ``param_specs`` the step was built
+    with plus the derived ZeRO state specs, so a drifted spec shows up
+    as a compile-time diff instead of a slow step."""
+    specs = (
+        param_specs,
+        zero_state_spec(optimizer, params, param_specs, mesh),
+        batch_spec,
+    )
+    return specs + ((P(),) if with_rng else ())
+
+
 def make_hybrid_train_step(
     loss_fn: Callable[[Any, Any], jax.Array],
     param_specs: Any,

@@ -136,6 +136,7 @@ class ServingEngine:
         self.page_size = page_size
         self.table_width = max_context // page_size
         self.mesh = mesh
+        self.param_specs = param_specs
         self.tp_axis = tp_axis
         tp = mesh.shape[tp_axis] if mesh is not None else 1
         if config.n_head % tp:
@@ -212,6 +213,36 @@ class ServingEngine:
             sharding = NamedSharding(mesh, pspec)
             self.k_pages = jax.device_put(self.k_pages, sharding)
             self.v_pages = jax.device_put(self.v_pages, sharding)
+            self._pspec = pspec
+
+    def doctor(self, large_bytes: int = 1 << 20, registry=None):
+        """Mesh-doctor report (telemetry/doctor.py) for the compiled
+        paged DECODE step — the serving hot path: actual shardings of
+        params and KV pages diffed against the engine's intended specs
+        (head-sharded pages under TP), the collective schedule
+        (``global_greedy_pick``'s all_gathers are the only intended
+        traffic), and the per-device HBM budget dominated by the page
+        pool. Shape-only: nothing executes, no pages are touched."""
+        from pipegoose_tpu.telemetry.doctor import diagnose, set_doctor_gauges
+
+        i32 = jnp.int32
+        tokens = jax.ShapeDtypeStruct((self.num_slots,), i32)
+        table = jax.ShapeDtypeStruct((self.num_slots, self.table_width), i32)
+        seq_lens = jax.ShapeDtypeStruct((self.num_slots,), i32)
+        intended = None
+        if self.mesh is not None:
+            intended = (self.param_specs, P(), self._pspec, self._pspec,
+                        P(), P())
+        report = diagnose(
+            self._step, self.params, tokens, self.k_pages, self.v_pages,
+            table, seq_lens,
+            intended=intended,
+            labels=("params", "tokens", "k_pages", "v_pages", "table",
+                    "seq_lens"),
+            mesh=self.mesh, large_bytes=large_bytes,
+        )
+        set_doctor_gauges(report, registry=registry or self.registry)
+        return report
 
     # -- internals ---------------------------------------------------------
 

@@ -43,10 +43,22 @@ from pipegoose_tpu.telemetry.derived import (
     collective_bytes,
     compiled_step_stats,
     hbm_utilization,
+    iter_collectives,
     mfu,
     peak_flops_for,
     step_flops,
     tokens_per_second,
+)
+from pipegoose_tpu.telemetry.doctor import (
+    DoctorReport,
+    MemoryReport,
+    ShardingRegressionError,
+    ShardingReport,
+    assert_fully_sharded,
+    assert_matches_intended,
+    assert_no_resharding,
+    diagnose,
+    set_doctor_gauges,
 )
 from pipegoose_tpu.telemetry.exporters import (
     JSONLExporter,
@@ -68,28 +80,38 @@ from pipegoose_tpu.telemetry.spans import current_span_path, span
 __all__ = [
     "ChromeTraceExporter",
     "Counter",
+    "DoctorReport",
     "FlightRecorder",
     "Gauge",
     "Histogram",
     "JSONLExporter",
+    "MemoryReport",
     "MetricsRegistry",
     "PEAK_FLOPS",
     "PrometheusTextfileExporter",
+    "ShardingRegressionError",
+    "ShardingReport",
     "TelemetryCallback",
     "TriggerEvent",
+    "assert_fully_sharded",
+    "assert_matches_intended",
+    "assert_no_resharding",
     "collective_bytes",
     "compiled_step_stats",
     "current_span_path",
+    "diagnose",
     "disable",
     "enable",
     "get_registry",
     "hbm_utilization",
     "health_stats",
     "host_health",
+    "iter_collectives",
     "mfu",
     "peak_flops_for",
     "pipeline_trace_events",
     "register_pipeline_gauges",
+    "set_doctor_gauges",
     "span",
     "span_events_to_trace",
     "step_flops",

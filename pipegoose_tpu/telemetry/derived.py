@@ -88,7 +88,8 @@ def hbm_utilization(device: Optional[Any] = None) -> dict:
 # -- communication accounting from compiled HLO ---------------------------
 
 _ITEMSIZE = {
-    "pred": 1, "s8": 1, "u8": 1, "f8e4m3fn": 1, "f8e5m2": 1,
+    "pred": 1, "s4": 1, "u4": 1, "s8": 1, "u8": 1,
+    "f8e4m3fn": 1, "f8e5m2": 1,
     "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
     "s32": 4, "u32": 4, "f32": 4,
     "s64": 8, "u64": 8, "f64": 8, "c64": 8,
@@ -101,18 +102,31 @@ _COLLECTIVES = ("all-reduce", "all-gather", "reduce-scatter", "all-to-all",
 # "f32[8,128]" with optional layout suffix "{1,0}"
 _SHAPE_RE = re.compile(r"([a-z][a-z0-9]*)\[([0-9,]*)\]")
 
+_RESULT_RE = {
+    # "%name = f32[8,16]{1,0} all-reduce(..." — shape(s) sit between '='
+    # and the op name; a "-done" suffix never matches (its result
+    # duplicates the "-start" tuple's output and must not count twice)
+    op: re.compile(rf"=\s*(.*?)\s{op}(-start)?\(") for op in _COLLECTIVES
+}
+
+
+def _atom_bytes(dtype: str, dims: str) -> Optional[int]:
+    size = _ITEMSIZE.get(dtype)
+    if size is None:
+        return None  # token/opaque types carry no payload
+    n = 1
+    for d in dims.split(","):
+        if d:
+            n *= int(d)
+    return n * size
+
 
 def _shape_bytes_list(shape_part: str) -> list:
     out = []
     for dtype, dims in _SHAPE_RE.findall(shape_part):
-        size = _ITEMSIZE.get(dtype)
-        if size is None:
-            continue  # token/opaque types carry no payload
-        n = 1
-        for d in dims.split(","):
-            if d:
-                n *= int(d)
-        out.append(n * size)
+        b = _atom_bytes(dtype, dims)
+        if b is not None:
+            out.append(b)
     return out
 
 
@@ -120,20 +134,87 @@ def _shape_bytes(shape_part: str) -> int:
     return sum(_shape_bytes_list(shape_part))
 
 
+def _split_top_level(shape_part: str) -> list:
+    """Split a result-shape string into its TOP-LEVEL tuple elements,
+    respecting nesting: ``"((f32[8], u8[2]), (f32[2]), u32[])"`` ->
+    ``["(f32[8], u8[2])", "(f32[2])", "u32[]"]``. A non-tuple shape
+    comes back as a single element. Layout braces (``{1,0}``) carry no
+    parens, so only ``(``/``)`` depth matters."""
+    s = shape_part.strip()
+    if not s.startswith("("):
+        return [s]
+    body = s[1:s.rfind(")")] if ")" in s else s[1:]
+    # dims ("[2,8]") and layouts ("{1,0}") hold commas too — only a
+    # comma at depth 0 across ALL bracket kinds separates tuple elements
+    elems, depth, start = [], 0, 0
+    for i, ch in enumerate(body):
+        if ch in "([{":
+            depth += 1
+        elif ch in ")]}":
+            depth -= 1
+        elif ch == "," and depth == 0:
+            elems.append(body[start:i].strip())
+            start = i + 1
+    tail = body[start:].strip()
+    if tail:
+        elems.append(tail)
+    return elems
+
+
 def _async_start_bytes(shape_part: str) -> int:
-    """Output payload of an async ``-start`` result tuple, whose shape
-    is ``(operand..., output..., [context scalars])``: strip trailing
-    scalar contexts (<= 8 bytes, e.g. the u32[] slots of
-    collective-permute-start), then take the SECOND half — the output
-    buffers. Correct for asymmetric collectives too (all-gather output
-    > input, reduce-scatter output < input), where halving the summed
-    tuple would miscount."""
+    """Output payload of an async ``-start`` result tuple.
+
+    Two printed forms exist:
+
+    - nested (variadic): ``((operands...), (outputs...), [contexts])``
+      — the LAST nested tuple is the output buffer set; sum exactly it.
+    - flat: ``(operand..., output..., [context scalars])`` — strip the
+      trailing scalar contexts (<= 8 bytes, e.g. the u32[] slots of
+      collective-permute-start), then take the SECOND half — the output
+      buffers. Correct for asymmetric collectives too (all-gather
+      output > input, reduce-scatter output < input), where halving the
+      summed tuple would miscount.
+    """
+    elems = _split_top_level(shape_part)
+    nested = [e for e in elems if e.startswith("(")]
+    if nested:
+        return _shape_bytes(nested[-1])
     shapes = _shape_bytes_list(shape_part)
     while len(shapes) > 2 and shapes[-1] <= 8:
         shapes.pop()
     if len(shapes) < 2:
         return sum(shapes)  # unexpected non-tuple form: count as-is
     return sum(shapes[len(shapes) // 2:])
+
+
+def _sync_bytes(shape_part: str) -> int:
+    """Payload of a SYNC collective result: every top-level element is
+    an output buffer (tuple-shaped variadic reduce-scatter /
+    collective-permute included) EXCEPT trailing scalar context slots,
+    which some permute forms keep even in the sync printing."""
+    elems = _split_top_level(shape_part)
+    sizes = [_shape_bytes(e) for e in elems]
+    while len(sizes) > 1 and sizes[-1] <= 8 and elems[-1].startswith("u32"):
+        sizes.pop()
+        elems.pop()
+    return sum(sizes)
+
+
+def iter_collectives(hlo_text: str):
+    """Yield one ``{"op", "bytes", "start", "line"}`` dict per
+    collective instruction in an HLO module's text (async ``-done``
+    halves skipped). The line-level form telemetry/doctor.py builds its
+    schedule table on; ``collective_bytes`` is the aggregate view."""
+    for line in hlo_text.splitlines():
+        for op in _COLLECTIVES:
+            m = _RESULT_RE[op].search(line)
+            if m:
+                start = bool(m.group(2))
+                nbytes = (_async_start_bytes(m.group(1)) if start
+                          else _sync_bytes(m.group(1)))
+                yield {"op": op, "bytes": nbytes, "start": start,
+                       "line": line}
+                break
 
 
 def collective_bytes(hlo_text: str) -> Dict[str, int]:
@@ -143,19 +224,8 @@ def collective_bytes(hlo_text: str) -> Dict[str, int]:
     payloads; a ring all-reduce moves ~2x on the wire — this counts the
     logical payload, the per-algorithm constant is the reader's)."""
     out = {k: 0 for k in _COLLECTIVES}
-    for line in hlo_text.splitlines():
-        for op in _COLLECTIVES:
-            # "%name = f32[8,16]{1,0} all-reduce(..." — shape(s) sit
-            # between '=' and the op name; skip the "-done" async half
-            # (its result duplicates the "-start" tuple's output)
-            m = re.search(rf"=\s*(.*?)\s{op}(-start)?\(", line)
-            if m:
-                # async "-start" results are (operand..., output...)
-                # tuples: count only the output half
-                nbytes = (_async_start_bytes(m.group(1)) if m.group(2)
-                          else _shape_bytes(m.group(1)))
-                out[op] += nbytes
-                break
+    for c in iter_collectives(hlo_text):
+        out[c["op"]] += c["bytes"]
     out["total"] = sum(out[k] for k in _COLLECTIVES)
     return out
 

@@ -95,6 +95,7 @@ class FlightRecorder(Callback):
         max_dumps: int = 8,
         registry=None,
         context: Optional[Dict[str, Any]] = None,
+        doctor_report: Optional[Any] = None,
     ):
         if capacity < 1:
             raise ValueError(f"capacity must be >= 1, got {capacity}")
@@ -109,6 +110,11 @@ class FlightRecorder(Callback):
         self.window = window
         self.max_dumps = max_dumps
         self.context = dict(context or {})
+        # compiled-program context for the black box: the mesh-doctor
+        # report (telemetry/doctor.py) of the step being recorded, so a
+        # post-mortem sees the partitioning plan that produced the
+        # anomaly (set at construction or via set_doctor_report)
+        self.doctor_report = doctor_report
         self.records: deque = deque(maxlen=capacity)
         self.dumps: List[str] = []
         self.last_trigger: Optional[TriggerEvent] = None
@@ -291,6 +297,12 @@ class FlightRecorder(Callback):
                     )
         return None
 
+    def set_doctor_report(self, report: Any) -> None:
+        """Attach (or replace) the mesh-doctor report included in every
+        subsequent black-box dump — e.g. ``trainer.doctor(batch)``
+        right after construction, or a re-diagnosis after a recompile."""
+        self.doctor_report = report
+
     def take_trigger(self) -> Optional[TriggerEvent]:
         """Consume the pending trigger (recovery's entry point)."""
         trig, self.last_trigger = self.last_trigger, None
@@ -390,6 +402,9 @@ class FlightRecorder(Callback):
             "environment": self._environment(),
             "created_ts": time.time(),
         }
+        if self.doctor_report is not None:
+            rep = self.doctor_report
+            payload["doctor"] = rep.to_json() if hasattr(rep, "to_json") else rep
         atomic_write_text(
             path, safe_json_dumps(payload, indent=1), suffix=".blackbox.tmp"
         )

@@ -217,16 +217,67 @@ class Trainer:
             )
         return total / n
 
+    def doctor(
+        self,
+        batch: Any,
+        large_bytes: int = 1 << 20,
+        registry: Any = None,
+    ):
+        """Mesh-doctor report (telemetry/doctor.py) for THIS trainer's
+        compiled train step: actual vs intended shardings of every
+        param/optimizer-state/batch leaf, the collective schedule split
+        into intentional vs partitioner-inserted traffic, and the
+        per-device HBM budget. ``batch`` only provides shapes — a
+        ``jax.ShapeDtypeStruct`` pytree works; nothing executes.
+        Headline numbers land as ``doctor.*`` gauges on ``registry``
+        (default: the global one, only if enabled)."""
+        from pipegoose_tpu.parallel.hybrid import train_step_intended_specs
+        from pipegoose_tpu.telemetry.doctor import diagnose, set_doctor_gauges
+
+        args = (self.params, self.opt_state, batch)
+        labels = ["params", "opt_state", "batch"]
+        intended = train_step_intended_specs(
+            self.optimizer, self.params, self.param_specs,
+            self.parallel_context.mesh, batch_spec=self._batch_spec,
+            with_rng=self.with_rng,
+        )
+        if self.with_rng:
+            args = args + (jax.random.PRNGKey(0),)
+            labels.append("rng")
+        report = diagnose(
+            self._step_fn, *args,
+            intended=intended, labels=labels,
+            mesh=self.parallel_context.mesh, large_bytes=large_bytes,
+        )
+        set_doctor_gauges(report, registry=registry)
+        return report
+
     def fit(
         self,
         batches: Iterable[Any],
         max_steps: Optional[int] = None,
         rng: Optional[jax.Array] = None,
+        profiler_trace_dir: Optional[str] = None,
     ) -> TrainerState:
         """Run the training loop (reference Trainer.fit stub,
         trainer.py:18-30). ``batches`` yields pytrees matching the
         batch_spec; with ``with_rng`` a fresh folded key goes to every
-        step."""
+        step. ``profiler_trace_dir``: wrap the whole fit in
+        ``jax.profiler.trace(dir)`` so an XLA timeline
+        (TensorBoard/Perfetto viewable) is one flag away."""
+        if profiler_trace_dir is not None:
+            from pipegoose_tpu.utils.profiler import trace
+
+            with trace(profiler_trace_dir):
+                return self._fit(batches, max_steps, rng)
+        return self._fit(batches, max_steps, rng)
+
+    def _fit(
+        self,
+        batches: Iterable[Any],
+        max_steps: Optional[int] = None,
+        rng: Optional[jax.Array] = None,
+    ) -> TrainerState:
         self.state.status = TrainerStatus.RUNNING
         for cb in self.callbacks:
             cb.on_fit_start(self)

@@ -51,10 +51,15 @@ def compiled_cost(fn: Callable, *args, **kwargs) -> dict:
 
 def device_memory_stats(device: Optional[Any] = None) -> dict:
     """Live HBM statistics (reference measured CUDA memory_allocated,
-    profile.py:30-42)."""
+    profile.py:30-42). Backends without ``memory_stats()`` (CPU, some
+    plugin platforms) return ``{"unavailable": "<platform>"}`` instead
+    of a silent empty dict, so a caller staring at a blank HBM gauge
+    can tell "no memory pressure" from "this backend can't say"."""
     device = device or jax.devices()[0]
     stats = getattr(device, "memory_stats", lambda: None)()
-    return dict(stats) if stats else {}
+    if not stats:
+        return {"unavailable": getattr(device, "platform", "unknown")}
+    return dict(stats)
 
 
 def trace(logdir: str, **kwargs):

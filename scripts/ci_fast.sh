@@ -22,6 +22,16 @@ python -m pytest -q -p no:cacheprovider \
     "tests/telemetry/test_health.py::test_health_off_lowers_to_the_unchanged_program" \
     "$@"
 
+# The sharding-regression gate (mesh doctor, telemetry/doctor.py):
+# compile the hybrid train step AND the serving decode step on an
+# 8-fake-device mesh and fail (exit 2) on partitioner-inserted
+# resharding collectives, intended-vs-actual spec mismatches, or large
+# replicated buffers — a broken PartitionSpec dies here at compile
+# time, not in a TPU bench.
+echo "== sharding-regression guard (mesh doctor) =="
+python scripts/mesh_doctor.py --fake-devices 8 --tp 2 --dp 4 \
+    --check --serving --quiet
+
 echo "== fast tier =="
 python -m pytest tests/ -q -m fast -p no:cacheprovider \
     --continue-on-collection-errors "$@"

@@ -120,6 +120,22 @@ FAST_TESTS = {
     # telemetry: engine instrumentation vs legacy dict + compiled comms
     "tests/serving/test_engine.py::test_engine_telemetry_agrees_with_legacy_metrics",
     "tests/telemetry/test_derived.py::test_compiled_step_stats_reports_flops_and_comms",
+    # mesh doctor: pure-parsing nodes + the hybrid sharding-plan pin
+    "tests/telemetry/test_doctor.py::test_norm_spec_and_spec_str",
+    "tests/telemetry/test_doctor.py::test_parse_groups_explicit",
+    "tests/telemetry/test_doctor.py::test_parse_groups_iota_with_transpose",
+    "tests/telemetry/test_doctor.py::test_parse_groups_source_target_pairs",
+    "tests/telemetry/test_doctor.py::test_groups_to_axes_on_2d_mesh",
+    "tests/telemetry/test_doctor.py::test_collective_schedule_classifies_metadata",
+    "tests/telemetry/test_doctor.py::test_report_json_round_trip_synthetic",
+    "tests/telemetry/test_doctor.py::test_format_table_contains_flags_and_summary",
+    "tests/telemetry/test_doctor.py::test_guards_on_synthetic_report",
+    "tests/telemetry/test_doctor.py::test_set_doctor_gauges",
+    "tests/telemetry/test_doctor.py::test_hybrid_step_intended_matches_actual",
+    # HLO tuple-shape parser fixtures (ISSUE 4 satellite)
+    "tests/telemetry/test_derived.py::test_collective_bytes_tuple_shaped_sync_variadic",
+    "tests/telemetry/test_derived.py::test_collective_bytes_nested_variadic_start",
+    "tests/telemetry/test_derived.py::test_iter_collectives_line_level",
     # health stats: pure math + the health-off zero-cost guard
     "tests/telemetry/test_health.py::test_health_stats_math_single_device",
     "tests/telemetry/test_health.py::test_health_off_lowers_to_the_unchanged_program",

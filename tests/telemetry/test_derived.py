@@ -116,3 +116,54 @@ def test_hbm_utilization_empty_on_cpu():
     # CPU devices report no memory stats: the gauge source degrades to
     # an empty dict, never an exception
     assert derived.hbm_utilization() == {}
+
+
+def test_collective_bytes_tuple_shaped_sync_variadic():
+    # variadic SYNC forms print a tuple result whose elements are ALL
+    # outputs (the ISSUE-4 satellite fix: structural tuple parsing
+    # instead of treating the tuple like an async operand/output pair)
+    hlo = "\n".join([
+        "  %rs = (f32[2,8]{1,0}, f32[4]{0}) reduce-scatter(f32[8,8] %a, "
+        "f32[16] %b), dimensions={0}",
+        "  %cp = (f32[128]{0}, f32[128]{0}) collective-permute("
+        "(f32[128], f32[128]) %p), source_target_pairs={{0,1},{1,0}}",
+    ])
+    out = derived.collective_bytes(hlo)
+    assert out["reduce-scatter"] == 2 * 8 * 4 + 4 * 4
+    assert out["collective-permute"] == 2 * 128 * 4
+
+
+def test_collective_bytes_sync_permute_strips_context_slots():
+    # sync collective-permute keeping trailing u32[] context slots: the
+    # scalars are bookkeeping, not payload
+    hlo = ("  %cp = (u8[128]{0}, u32[], u32[]) collective-permute("
+           "u8[128] %z), source_target_pairs={{0,1}}")
+    assert derived.collective_bytes(hlo)["collective-permute"] == 128
+
+
+def test_collective_bytes_nested_variadic_start():
+    # async variadic start: ((operands...), (outputs...), contexts) —
+    # only the LAST nested tuple (the outputs) is payload
+    hlo = "\n".join([
+        "  %rs = ((f32[8,8]{1,0}, f32[16]{0}), (f32[2,8]{1,0}, f32[4]{0}), "
+        "u32[], u32[]) reduce-scatter-start(f32[8,8] %a, f32[16] %b)",
+        "  %d = (f32[2,8]{1,0}, f32[4]{0}) reduce-scatter-done(%rs)",
+        "  %cps = ((u8[128]{0}), (u8[128]{0}), u32[], u32[]) "
+        "collective-permute-start(u8[128] %z)",
+    ])
+    out = derived.collective_bytes(hlo)
+    assert out["reduce-scatter"] == 2 * 8 * 4 + 4 * 4  # done half skipped
+    assert out["collective-permute"] == 128
+
+
+def test_iter_collectives_line_level():
+    hlo = "\n".join([
+        "  %ar = f32[8]{0} all-reduce(f32[8] %x)",
+        "  %ag = (bf16[2,4]{1,0}, bf16[4,4]{1,0}) all-gather-start(bf16[2,4] %y)",
+        "  %agd = bf16[4,4]{1,0} all-gather-done(%ag)",
+    ])
+    items = list(derived.iter_collectives(hlo))
+    assert [(c["op"], c["bytes"], c["start"]) for c in items] == [
+        ("all-reduce", 32, False),
+        ("all-gather", 32, True),
+    ]

@@ -1,0 +1,419 @@
+"""Mesh doctor (telemetry/doctor.py): pure parsing nodes (replica
+groups, mesh-axis attribution, intentional-vs-resharding metadata
+classification, spec normalization, JSON round-trip, guards) plus
+compiled-program diffing on the 8-fake-device mesh — intended==actual
+on the hybrid train step, a deliberately replicated weight detected
+with its module path, an induced resharding all-gather detected, the
+serving decode step pinned resharding-free, and the per-device memory
+budget (ISSUE 4 acceptance)."""
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from pipegoose_tpu.telemetry import doctor as D
+
+
+# -- pure parsing ----------------------------------------------------------
+
+
+def test_norm_spec_and_spec_str():
+    assert D._norm_spec(P("data", None)) == D._norm_spec(P("data"))
+    assert D._norm_spec(P(None, ("tensor",))) == D._norm_spec(P(None, "tensor"))
+    assert D._norm_spec(P()) == ()
+    assert D._norm_spec(None) == ()
+    # multi-axis tuple entries survive
+    assert D._norm_spec(P(("data", "tensor"))) == (("data", "tensor"),)
+    assert D._spec_str(P(None, "tensor")) == "P(None, 'tensor')"
+    assert D._spec_str(P()) == "P()"
+
+
+def test_parse_groups_explicit():
+    groups = D._parse_groups(
+        "  %ar = f32[] all-reduce(f32[] %x), replica_groups={{0,1},{2,3}}, x"
+    )
+    assert groups == [[0, 1], [2, 3]]
+
+
+def test_parse_groups_iota_with_transpose():
+    # [2,4]<=[4,2]T(1,0): transpose a 4x2 iota then reshape (2,4)
+    groups = D._parse_groups("replica_groups=[2,4]<=[4,2]T(1,0)")
+    assert groups == [[0, 2, 4, 6], [1, 3, 5, 7]]
+    groups = D._parse_groups("replica_groups=[4,2]<=[8]")
+    assert groups == [[0, 1], [2, 3], [4, 5], [6, 7]]
+
+
+def test_parse_groups_source_target_pairs():
+    # a ring permutation: one connected component spanning all devices
+    groups = D._parse_groups(
+        "source_target_pairs={{0,1},{1,2},{2,3},{3,0}}"
+    )
+    assert groups == [[0, 1, 2, 3]]
+
+
+def test_groups_to_axes_on_2d_mesh():
+    mesh_axes = {"data": 4, "tensor": 2}
+    # contiguous pairs = groups over the MINOR axis (tensor)
+    assert D._groups_to_axes(
+        [[0, 1], [2, 3], [4, 5], [6, 7]], mesh_axes) == ("tensor",)
+    # stride-2 groups = the major axis (data)
+    assert D._groups_to_axes(
+        [[0, 2, 4, 6], [1, 3, 5, 7]], mesh_axes) == ("data",)
+    # one global group = both axes
+    assert D._groups_to_axes(
+        [list(range(8))], mesh_axes) == ("data", "tensor")
+    # a partition matching no axis subset resolves to None
+    assert D._groups_to_axes([[0, 3], [1, 2], [4, 7], [5, 6]],
+                             mesh_axes) is None
+    assert D._groups_to_axes(None, mesh_axes) is None
+    assert D._groups_to_axes([[0, 1]], {}) is None
+
+
+def test_collective_schedule_classifies_metadata():
+    hlo = "\n".join([
+        # user psum: intentional
+        '  %ar = f32[8,16]{1,0} all-reduce(f32[8,16] %x), '
+        'replica_groups={{0,1},{2,3},{4,5},{6,7}}, '
+        'metadata={op_name="jit(f)/jit(main)/jit(shmap_body)/psum" '
+        'source_file="x.py" source_line=7}',
+        # GSPMD partial-sum of a sharded matmul: inserted
+        '  %ar2 = f32[8,4]{1,0} all-reduce(f32[8,4] %dot), '
+        'replica_groups=[4,2]<=[8], '
+        'metadata={op_name="jit(f)/jit(main)/dot_general" '
+        'source_file="x.py" source_line=9}',
+        # GSPMD resharding gather: no metadata at all
+        "  %ag = f32[8,8]{0,1} all-gather(f32[8,4] %c), channel_id=1, "
+        "replica_groups=[4,2]<=[8], dimensions={1}",
+    ])
+    sched = D.parse_collective_schedule(hlo, {"data": 4, "tensor": 2})
+    assert [c.op for c in sched] == ["all-reduce", "all-reduce", "all-gather"]
+    assert [c.intentional for c in sched] == [True, False, False]
+    assert sched[0].source == "psum"
+    assert sched[1].source == "dot_general"
+    assert sched[2].source == ""
+    assert sched[0].mesh_axes == ("tensor",)
+    assert sched[1].mesh_axes == ("tensor",)
+    assert sched[0].bytes == 8 * 16 * 4
+
+
+def _synthetic_report():
+    buffers = [
+        D.BufferInfo(
+            path="params/blocks/attn/qkv/kernel", shape=(64, 192),
+            dtype="float32", actual="P()", intended="P(None, 'tensor')",
+            global_bytes=64 * 192 * 4, per_device_bytes=64 * 192 * 4,
+            replicated=True, role="donated input",
+            flags=["mismatch", "replicated_large"],
+        ),
+        D.BufferInfo(
+            path="params/blocks/mlp/up/kernel", shape=(64, 256),
+            dtype="float32", actual="P(None, 'tensor')",
+            intended="P(None, 'tensor')", global_bytes=64 * 256 * 4,
+            per_device_bytes=64 * 256 * 2, replicated=False,
+        ),
+        D.BufferInfo(
+            path="batch", shape=(8, 12), dtype="int32", actual="P('data')",
+            intended="P('data')", global_bytes=8 * 12 * 4,
+            per_device_bytes=8 * 12, replicated=False,
+        ),
+    ]
+    collectives = [
+        D.CollectiveInfo(op="all-reduce", bytes=1024, mesh_axes=("tensor",),
+                         source="psum", intentional=True),
+        D.CollectiveInfo(op="all-gather", bytes=49152, mesh_axes=("tensor",),
+                         source="", intentional=False),
+        D.CollectiveInfo(op="all-reduce", bytes=256, mesh_axes=("tensor",),
+                         source="dot_general", intentional=False),
+    ]
+    sharding = D.ShardingReport(
+        mesh_axes={"data": 4, "tensor": 2}, n_devices=8,
+        buffers=buffers, collectives=collectives,
+    )
+    memory = D.MemoryReport(
+        groups={"params": 1 << 20, "opt_state": 2 << 20, "batch": 384},
+        output_bytes=1 << 20, temp_bytes=1 << 19, peak_bytes=4 << 20,
+        source="memory_analysis", hbm_limit=16 << 30,
+        top=[{"path": "params/blocks/mlp/up/kernel",
+              "per_device_bytes": 32768, "role": "donated input"}],
+    )
+    return D.DoctorReport(sharding=sharding, memory=memory)
+
+
+def test_report_json_round_trip_synthetic():
+    rep = _synthetic_report()
+    blob = json.dumps(rep.to_json())
+    back = D.DoctorReport.from_json(json.loads(blob))
+    assert back.sharding.resharding_bytes == rep.sharding.resharding_bytes
+    assert back.sharding.replicated_bytes == rep.sharding.replicated_bytes
+    assert back.memory.peak_bytes == rep.memory.peak_bytes
+    assert [b.path for b in back.sharding.buffers] == \
+        [b.path for b in rep.sharding.buffers]
+    assert [c.mesh_axes for c in back.sharding.collectives] == \
+        [c.mesh_axes for c in rep.sharding.collectives]
+    # derived numbers: resharding = the two non-intentional entries
+    assert rep.sharding.resharding_bytes == 49152 + 256
+    assert rep.sharding.intentional_bytes == 1024
+    # replicated counts inputs only
+    assert rep.sharding.replicated_bytes == 64 * 192 * 4
+
+
+def test_format_table_contains_flags_and_summary():
+    rep = _synthetic_report()
+    txt = rep.format_table()
+    assert "params/blocks/attn/qkv/kernel" in txt
+    assert "mismatch" in txt and "replicated_large" in txt
+    assert "RESHARDING" in txt and "intentional" in txt
+    assert "peak" in txt and "HBM limit" in txt
+
+
+def test_guards_on_synthetic_report():
+    rep = _synthetic_report()
+    with pytest.raises(D.ShardingRegressionError, match="all-gather"):
+        D.assert_no_resharding(rep)
+    # allow-list by op, by source, and by op:source
+    with pytest.raises(D.ShardingRegressionError):
+        D.assert_no_resharding(rep, allow=["all-gather"])  # all-reduce left
+    D.assert_no_resharding(rep, allow=["all-gather",
+                                       "all-reduce:dot_general"])
+    D.assert_no_resharding(rep, allow=["all-*", "dot_general"])
+
+    with pytest.raises(D.ShardingRegressionError,
+                       match="qkv/kernel"):
+        D.assert_fully_sharded(rep, min_bytes=1 << 10)
+    D.assert_fully_sharded(rep, min_bytes=1 << 10,
+                           allow=["params/blocks/attn/*"])
+    D.assert_fully_sharded(rep, min_bytes=1 << 30)
+
+    with pytest.raises(D.ShardingRegressionError, match="intended"):
+        D.assert_matches_intended(rep)
+    D.assert_matches_intended(rep, allow=["params/blocks/attn/*"])
+
+
+def test_set_doctor_gauges():
+    from pipegoose_tpu.telemetry.registry import MetricsRegistry
+
+    reg = MetricsRegistry(enabled=True)
+    D.set_doctor_gauges(_synthetic_report(), registry=reg)
+    assert reg.gauge("doctor.replicated_bytes").value == 64 * 192 * 4
+    assert reg.gauge("doctor.resharding_bytes").value == 49152 + 256
+    assert reg.gauge("doctor.intentional_bytes").value == 1024
+    assert reg.gauge("doctor.hbm_peak_bytes").value == 4 << 20
+
+
+# -- compiled-program diffing on the fake 8-device mesh --------------------
+
+
+@pytest.fixture(scope="module")
+def hybrid_setup(devices):
+    from pipegoose_tpu.distributed import ParallelContext
+    from pipegoose_tpu.models import bloom
+    from pipegoose_tpu.optim.zero import DistributedOptimizer
+    from pipegoose_tpu.parallel import make_hybrid_train_step
+
+    cfg = bloom.BloomConfig(vocab_size=64, hidden_size=32, n_layer=2,
+                            n_head=2)
+    params = bloom.init_params(cfg, jax.random.PRNGKey(0))
+    ctx = ParallelContext(tensor_parallel_size=2, data_parallel_size=4)
+    specs = bloom.tp_specs(params)
+    opt = DistributedOptimizer(optax.adam(1e-3), axis_name="data")
+
+    def loss_fn(p, ids):
+        return bloom.loss_fn(p, ids, None, ids, cfg, tp_axis="tensor")
+
+    init_fn, make_step = make_hybrid_train_step(loss_fn, specs, opt, ctx)
+    opt_sds = jax.eval_shape(init_fn, params)
+    step = make_step(params)
+    yield cfg, params, specs, opt, ctx, step, opt_sds
+    ctx.destroy()
+
+
+def _hybrid_report(hybrid_setup, **kwargs):
+    from pipegoose_tpu.parallel import train_step_intended_specs
+
+    cfg, params, specs, opt, ctx, step, opt_sds = hybrid_setup
+    batch = jax.ShapeDtypeStruct((8, 8), jnp.int32)
+    intended = train_step_intended_specs(opt, params, specs, ctx.mesh)
+    return D.diagnose(
+        step, params, opt_sds, batch, intended=intended,
+        labels=("params", "opt_state", "batch"),
+        mesh=ctx.mesh, **kwargs,
+    )
+
+
+def test_hybrid_step_intended_matches_actual(hybrid_setup):
+    """The acceptance pin: on the 8-host-device mesh the hybrid train
+    step compiles with every leaf at its intended sharding and ZERO
+    partitioner-inserted collectives — all traffic traces back to the
+    step's own psum/reduce_scatter/all_gather primitives."""
+    rep = _hybrid_report(hybrid_setup)
+    assert rep.sharding.n_devices == 8
+    assert rep.sharding.mismatches() == []
+    assert rep.sharding.resharding_bytes == 0
+    assert rep.sharding.resharding_collectives == []
+    # the ZeRO step's own traffic is visible and attributed to axes
+    srcs = {c.source for c in rep.sharding.collectives}
+    assert {"psum", "reduce_scatter", "all_gather"} <= srcs
+    axes = {c.mesh_axes for c in rep.sharding.collectives}
+    assert ("tensor",) in axes and ("data",) in axes
+    D.assert_no_resharding(rep)
+    D.assert_matches_intended(rep)
+    # every large leaf is sharded somewhere (LN scales/biases are tiny)
+    D.assert_fully_sharded(rep, min_bytes=1 << 14)
+
+
+def test_hybrid_memory_report(hybrid_setup):
+    rep = _hybrid_report(hybrid_setup)
+    mem = rep.memory
+    assert set(mem.groups) == {"params", "opt_state", "batch"}
+    assert mem.groups["params"] > 0 and mem.groups["opt_state"] > 0
+    # XLA's memory analysis is available on CPU
+    assert mem.source == "memory_analysis"
+    assert mem.peak_bytes >= mem.groups["params"]
+    assert len(mem.top) == 10
+    assert all(t["per_device_bytes"] >= mem.top[-1]["per_device_bytes"]
+               for t in mem.top)
+    # params are donated through the step
+    assert any(b.role == "donated input" for b in rep.sharding.buffers)
+
+
+def test_hybrid_report_json_round_trip(hybrid_setup):
+    rep = _hybrid_report(hybrid_setup)
+    back = D.DoctorReport.from_json(json.loads(json.dumps(rep.to_json())))
+    assert back.sharding.resharding_bytes == rep.sharding.resharding_bytes
+    assert back.sharding.intentional_bytes == rep.sharding.intentional_bytes
+    assert back.memory.groups == rep.memory.groups
+    assert len(back.sharding.buffers) == len(rep.sharding.buffers)
+    # guards run identically on a deserialized report (the CI use case:
+    # compare/verify a report produced by another process)
+    D.assert_no_resharding(back)
+    D.assert_matches_intended(back)
+
+
+def test_replicated_weight_detected(devices):
+    """Seeded defect #1: a weight the specs say is tensor-sharded is
+    ACTUALLY replicated (auto/GSPMD path — the semantics still hold,
+    only memory/perf silently degrade). The doctor names the module
+    path and both the mismatch diff and the fully-sharded guard fire."""
+    mesh = jax.sharding.Mesh(
+        np.array(devices[:8]).reshape(4, 2), ("data", "tensor"))
+    w_good = NamedSharding(mesh, P(None, "tensor"))
+    w_bad = NamedSharding(mesh, P())  # the defect: fully replicated
+    x_sh = NamedSharding(mesh, P("data", None))
+
+    def loss(w, x):
+        return (jnp.tanh(x @ w)).sum()
+
+    w = jax.device_put(jnp.ones((64, 128)), w_bad)
+    x = jax.device_put(jnp.ones((16, 64)), x_sh)
+    step = jax.jit(loss)
+    rep = D.diagnose(
+        step, w, x,
+        intended=({"dense": {"kernel": P(None, "tensor")}}, P("data", None)),
+        labels=("params", "batch"), mesh=mesh, large_bytes=1 << 10,
+    )
+    # intended is a pytree; the bare-array arg matches its single leaf
+    # positionally via the broadcast rule only when given a single spec —
+    # here the dict spec has no matching path, so diff via the report row
+    [row] = [b for b in rep.sharding.buffers if b.path == "params"]
+    assert row.replicated
+    with pytest.raises(D.ShardingRegressionError, match="params"):
+        D.assert_fully_sharded(rep, min_bytes=1 << 10)
+
+    # same defect with an aligned intended spec: mismatch flag names it
+    rep2 = D.diagnose(
+        step, w, x, intended=(P(None, "tensor"), P("data", None)),
+        labels=("w", "x"), mesh=mesh, large_bytes=1 << 10,
+    )
+    [wrow] = [b for b in rep2.sharding.buffers if b.path == "w"]
+    assert "mismatch" in wrow.flags and "replicated_large" in wrow.flags
+    assert wrow.intended == "P(None, 'tensor')"
+    with pytest.raises(D.ShardingRegressionError, match="w"):
+        D.assert_matches_intended(rep2)
+
+    # and the healthy layout passes the same guards
+    w_ok = jax.device_put(jnp.ones((64, 128)), w_good)
+    rep3 = D.diagnose(step, w_ok, x,
+                      intended=(P(None, "tensor"), P("data", None)),
+                      labels=("w", "x"), mesh=mesh, large_bytes=1 << 10)
+    D.assert_matches_intended(rep3)
+    D.assert_fully_sharded(rep3, min_bytes=1 << 10)
+
+
+def test_induced_resharding_all_gather_detected(devices):
+    """Seeded defect #2: an output sharding that forces GSPMD to insert
+    an all-gather the user never wrote — the silent hot-path resharding
+    the doctor exists to surface."""
+    mesh = jax.sharding.Mesh(
+        np.array(devices[:8]).reshape(4, 2), ("data", "tensor"))
+    w_sh = NamedSharding(mesh, P(None, "tensor"))
+
+    def f(w):
+        return jnp.sin(w)
+
+    step = jax.jit(f, in_shardings=(w_sh,),
+                   out_shardings=NamedSharding(mesh, P()))
+    w = jax.ShapeDtypeStruct((64, 128), jnp.float32)
+    rep = D.diagnose(step, w, labels=("w",), mesh=mesh)
+    gathers = [c for c in rep.sharding.resharding_collectives
+               if c.op == "all-gather"]
+    assert gathers, rep.sharding.collectives
+    assert gathers[0].mesh_axes == ("tensor",)
+    assert rep.sharding.resharding_bytes >= 64 * 128 * 4
+    with pytest.raises(D.ShardingRegressionError, match="all-gather"):
+        D.assert_no_resharding(rep)
+    # an explicit allow-list turns the same report green
+    D.assert_no_resharding(rep, allow=["all-gather"])
+
+
+def test_serving_decode_step_zero_resharding(devices):
+    """The serving hot path compiles resharding-free under TP: every
+    collective is the decode driver's own all_gather/psum."""
+    from pipegoose_tpu.distributed import ParallelContext
+    from pipegoose_tpu.models import bloom
+    from pipegoose_tpu.serving import ServingEngine
+
+    cfg = bloom.BloomConfig(vocab_size=64, hidden_size=32, n_layer=2,
+                            n_head=2)
+    params = bloom.init_params(cfg, jax.random.PRNGKey(0))
+    ctx = ParallelContext(tensor_parallel_size=2, data_parallel_size=4)
+    try:
+        eng = ServingEngine(params, cfg, num_slots=2, num_pages=16,
+                            page_size=8, max_context=32, mesh=ctx.mesh,
+                            param_specs=bloom.tp_specs(params))
+        rep = eng.doctor()
+        assert rep.sharding.resharding_bytes == 0
+        D.assert_no_resharding(rep)
+        # KV pages are head-sharded over tensor, never replicated
+        pages = [b for b in rep.sharding.buffers
+                 if b.path.startswith(("k_pages", "v_pages"))]
+        assert pages and all(not b.replicated for b in pages)
+        srcs = {c.source for c in rep.sharding.collectives}
+        assert "all_gather" in srcs  # global_greedy_pick's vocab argmax
+    finally:
+        ctx.destroy()
+
+
+def test_flightrec_dump_includes_doctor(hybrid_setup, tmp_path):
+    """The flight recorder embeds the mesh-doctor report in its
+    black-box dumps, so a post-mortem sees the partitioning plan that
+    produced the anomaly."""
+    from pipegoose_tpu.telemetry.flightrec import FlightRecorder, TriggerEvent
+
+    rep = _hybrid_report(hybrid_setup)
+    rec = FlightRecorder(str(tmp_path), doctor_report=rep)
+    rec.record("train.step", step=1, loss=1.0)
+    path = rec.dump(TriggerEvent("nonfinite", "test", 1))
+    with open(path) as f:
+        blob = json.load(f)
+    assert "doctor" in blob
+    assert blob["doctor"]["sharding"]["resharding_bytes"] == 0
+    assert blob["doctor"]["memory"]["peak_bytes"] > 0
+
+    # set_doctor_report attaches after construction too
+    rec2 = FlightRecorder(str(tmp_path / "b"))
+    rec2.set_doctor_report(rep)
+    assert rec2.doctor_report is rep

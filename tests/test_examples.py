@@ -32,6 +32,8 @@ CASES = [
     ("flight_recorder_demo.py", ["--fake-devices", "8", "--tp", "2",
                                  "--dp", "4", "--out-dir",
                                  "/tmp/pipegoose_flightrec_demo_test"]),
+    ("mesh_doctor_demo.py", ["--fake-devices", "8", "--tp", "2",
+                             "--dp", "4"]),
 ]
 
 

@@ -217,3 +217,39 @@ def test_fit_populates_bounded_losses_and_health(parts):
     assert h is not None and np.isfinite(h["grad_norm"])
     assert set(h["grad_norm_per_module"]) == set(params.keys())
     assert h["nonfinite_grad_leaves"] == 0.0
+
+
+def test_trainer_doctor_and_profiler_trace_dir(parts, tmp_path):
+    """One Trainer, two ISSUE-4 hooks: doctor() diffs the live compiled
+    step against its own param/ZeRO/batch specs (zero mismatches, zero
+    partitioner-inserted collectives, memory budget grouped by arg),
+    and fit(profiler_trace_dir=...) wraps the loop in
+    jax.profiler.trace so an XLA timeline is one flag away."""
+    import os
+
+    from pipegoose_tpu import telemetry
+
+    cfg, params, ctx = parts
+
+    def loss_fn(p, ids):
+        return bloom.loss_fn(p, ids, None, ids, cfg, tp_axis="tensor")
+
+    trainer = Trainer(
+        loss_fn, params, bloom.tp_specs(params),
+        DistributedOptimizer(optax.adam(1e-3), axis_name="data"), ctx,
+    )
+    report = trainer.doctor(jax.ShapeDtypeStruct((8, 8), jnp.int32))
+    assert report.sharding.mismatches() == []
+    assert report.sharding.resharding_bytes == 0
+    telemetry.assert_no_resharding(report)
+    telemetry.assert_matches_intended(report)
+    assert set(report.memory.groups) == {"params", "opt_state", "batch"}
+
+    trace_dir = str(tmp_path / "xla_trace")
+    state = trainer.fit(_batches(cfg, 2), profiler_trace_dir=trace_dir)
+    assert state.step == 2
+    written = [
+        os.path.join(root, f)
+        for root, _, files in os.walk(trace_dir) for f in files
+    ]
+    assert written, f"no profiler artifacts under {trace_dir}"

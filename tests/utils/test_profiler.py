@@ -79,3 +79,11 @@ def test_compiled_cost_accepts_kwargs(shape):
 
     cost = profiler.compiled_cost(f, jnp.ones(shape), scale=3.0)
     assert isinstance(cost, dict)
+
+
+def test_device_memory_stats_says_why_unavailable():
+    # backends without memory_stats() (CPU) name themselves instead of
+    # returning a silent {} — "no pressure" vs "can't say" (ISSUE 4)
+    out = profiler.device_memory_stats(jax.devices()[0])
+    if "bytes_in_use" not in out:
+        assert out == {"unavailable": "cpu"}
