@@ -183,7 +183,14 @@ def _cached_hardware_result():
 def run_bench(force_cpu: bool) -> None:
     if force_cpu:
         # Force CPU BEFORE the first backend touch — the axon sitecustomize
-        # ignores JAX_PLATFORMS, only the config update works.
+        # ignores JAX_PLATFORMS, only the config update works. Fake 8
+        # host devices so the hybrid comm variants (overlap / int8
+        # all-reduce need a mesh) run in the CPU smoke too.
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
         import jax
 
         jax.config.update("jax_platforms", "cpu")
@@ -360,6 +367,103 @@ def run_bench(force_cpu: bool) -> None:
             "loss": float(loss),
         }
 
+    # communication-engine variants (docs/comm.md): the hybrid TP x DP
+    # step with (a) the ring collective-matmul overlap path and (b) the
+    # int8-quantized gradient reduction — variant -> (config, batch,
+    # seq, tp, grad_comm). These need >= 2 devices (the CPU smoke fakes
+    # 8); measured with the step's own jitted shard_map in a Python
+    # loop (one warm-up, RTT-corrected) so the compiled program is the
+    # production one, not a scan-wrapped cousin.
+    if on_tpu:
+        comm_base = dict(dtype=jnp.bfloat16, remat=True, use_flash=True)
+        comm_shape = (8, 1024)
+    else:
+        # flash on CPU means interpreter-mode Pallas — keep the smoke's
+        # variant LABELS (the TPU contract) but run XLA attention
+        comm_base = dict(
+            vocab_size=1024, hidden_size=256, n_layer=4, n_head=8,
+            dtype=jnp.float32,
+        )
+        comm_shape = (8, 128)
+    comm_variants = {
+        "flash+overlap": (dict(comm_base, overlap_tp=True), 2, "fp32"),
+        "flash+int8ar": (dict(comm_base), 1, "int8"),
+        "flash+overlap+int8ar": (dict(comm_base, overlap_tp=True), 2, "int8"),
+    }
+
+    def measure_hybrid(cfg_kw, tp, grad_comm, batch, seq):
+        import optax
+
+        from pipegoose_tpu.distributed import ParallelContext
+        from pipegoose_tpu.optim.zero import DistributedOptimizer
+        from pipegoose_tpu.parallel import make_hybrid_train_step
+
+        ndev = len(jax.devices())
+        if ndev < 2 or ndev % max(tp, 1):
+            raise RuntimeError(
+                f"comm variant needs a mesh ({ndev} device(s), tp={tp})"
+            )
+        cfg = (
+            bloom.BloomConfig.bloom_560m(**cfg_kw)
+            if on_tpu else bloom.BloomConfig(**cfg_kw)
+        )
+        params = bloom.init_params(cfg, jax.random.PRNGKey(0))
+        params, cfg = bloom.pad_for_tp(params, cfg, tp)
+        ctx = ParallelContext(
+            tensor_parallel_size=tp, data_parallel_size=ndev // tp
+        )
+        try:
+            specs = bloom.tp_specs(params)
+            opt = DistributedOptimizer(
+                optax.adam(1e-4), axis_name="data", grad_comm=grad_comm
+            )
+
+            def hloss(p, ids):
+                return bloom.loss_fn(p, ids, None, ids, cfg, tp_axis="tensor")
+
+            init_fn, make_step = make_hybrid_train_step(
+                loss_fn=hloss, param_specs=specs, optimizer=opt,
+                parallel_context=ctx,
+                overlap_tp=bool(cfg_kw.get("overlap_tp")),
+            )
+            opt_state = init_fn(params)
+            step = make_step(params)
+            ids = jnp.asarray(np.random.RandomState(0).randint(
+                0, cfg.valid_vocab_size or cfg.vocab_size, (batch, seq)
+            ))
+            p = params
+            p, opt_state, loss = step(p, opt_state, ids)  # compile+warm
+            loss = float(loss)
+            tiny = jax.jit(lambda x: x + 1.0)
+            z = jnp.zeros(())
+            float(tiny(z))
+            t0 = time.perf_counter()
+            for _ in range(3):
+                float(tiny(z))
+            rtt = (time.perf_counter() - t0) / 3
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                p, opt_state, loss = step(p, opt_state, ids)
+            loss = float(loss)
+            dt = max(time.perf_counter() - t0 - rtt, 1e-9)
+        finally:
+            ctx.destroy()
+        tokens_per_sec = batch * seq * steps / dt
+        n_params = sum(
+            int(np.prod(q.shape)) for q in jax.tree_util.tree_leaves(params)
+        )
+        flops_per_token = 6 * n_params + 12 * cfg.n_layer * cfg.hidden_size * seq
+        mfu = tokens_per_sec * flops_per_token / (
+            _peak_flops(device_kind) * ndev
+        )
+        return {
+            "tokens_per_sec": round(tokens_per_sec, 1),
+            "mfu": round(mfu, 4),
+            "loss": loss,
+            "mesh": f"tp{tp}xdp{ndev // tp}",
+            "grad_comm": grad_comm,
+        }
+
     def serving_block():
         """Continuous-batching vs naive padded batching at mixed
         sequence lengths (serving/engine.py A/B). Prompt lengths stay
@@ -470,6 +574,34 @@ def run_bench(force_cpu: bool) -> None:
         if os.environ.get("BENCH_CHILD"):
             emit(results)
 
+    # comm-engine variants AFTER the champions (same crash-isolation
+    # argument; they must never cost the primary numbers); OOM backs
+    # off the batch like the main loop
+    cb, cs = comm_shape
+    for name, (cfg_kw, tp, grad_comm) in comm_variants.items():
+        b = cb
+        while True:
+            try:
+                results[name] = measure_hybrid(cfg_kw, tp, grad_comm, b, cs)
+                results[name]["batch"] = b
+                results[name]["seq"] = cs
+                reg.gauge(f"bench.{name}.tokens_per_s").set(
+                    results[name]["tokens_per_sec"]
+                )
+                reg.gauge(f"bench.{name}.mfu").set(results[name]["mfu"])
+                break
+            except Exception as e:  # noqa: BLE001
+                if "RESOURCE_EXHAUSTED" in str(e) and b > 1:
+                    b //= 2
+                    continue
+                results[name] = {"error": f"{type(e).__name__}: {e}"[:500]}
+                break
+        reg.event("bench.variant", name=name, **{
+            k: v for k, v in results[name].items() if not isinstance(v, dict)
+        })
+        if os.environ.get("BENCH_CHILD"):
+            emit(results)
+
     # mesh-doctor artifact (BENCH_DOCTOR_JSON, default bench_doctor.json;
     # empty disables): the benched step's ACTUAL shardings + per-device
     # HBM table (telemetry/doctor.py), recorded per bench run so a
@@ -477,7 +609,11 @@ def run_bench(force_cpu: bool) -> None:
     # as a slower number. Shape-only AOT compile — nothing executes, and
     # a doctor failure never discards the measurements above.
     doctor_path = os.environ.get("BENCH_DOCTOR_JSON", "bench_doctor.json")
-    ok_variants = [k for k, v in results.items() if "error" not in v]
+    # comm variants carry their own mesh/step shape — the single-device
+    # AOT doctor below only understands the plain `variants` table
+    ok_variants = [
+        k for k, v in results.items() if "error" not in v and k in variants
+    ]
     if doctor_path and ok_variants:
         try:
             import optax as _optax

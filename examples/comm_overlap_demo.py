@@ -1,0 +1,132 @@
+"""Communication-engine demo: ring-overlap TP + quantized gradient
+all-reduce, verified with the mesh doctor (docs/comm.md, ISSUE 5).
+
+Story: a hybrid TP x DP BLOOM train step spends wire time in two
+places — the per-layer TP collectives serialized against the matmuls,
+and the fp32 ZeRO gradient reduce-scatter. This demo builds the same
+step three ways and shows, without trusting a stopwatch:
+
+1. baseline — monolithic collectives, fp32 gradients;
+2. overlap — ``config.overlap_tp=True``: the doctor's compiled
+   schedule shows the layer traffic turned into ``ppermute`` ring hops
+   (hideable behind the partial matmuls) with ZERO partitioner-inserted
+   resharding, and the losses still match the baseline exactly;
+3. int8 — ``grad_comm="int8"``: the gradient reduction's estimated
+   wire bytes drop ~4x (doctor accounting + the ``comm.bytes_saved``
+   gauge), and a short training run stays within tolerance of fp32.
+
+    python examples/comm_overlap_demo.py --fake-devices 8 --tp 2 --dp 4
+"""
+from __future__ import annotations
+
+import argparse
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--tp", type=int, default=2)
+    ap.add_argument("--dp", type=int, default=4)
+    ap.add_argument("--steps", type=int, default=3)
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--seq", type=int, default=16)
+    ap.add_argument("--fake-devices", type=int, default=None,
+                    help="force N fake CPU devices (works even where a "
+                         "sitecustomize pins an accelerator platform)")
+    args = ap.parse_args()
+    if args.fake_devices:
+        from pipegoose_tpu.testing import force_cpu_devices
+        force_cpu_devices(args.fake_devices)
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from pipegoose_tpu import telemetry
+    from pipegoose_tpu.distributed import ParallelContext
+    from pipegoose_tpu.models import bloom
+    from pipegoose_tpu.optim.zero import DistributedOptimizer
+    from pipegoose_tpu.parallel import make_hybrid_train_step
+
+    reg = telemetry.get_registry()
+    reg.enable()
+    ctx = ParallelContext(tensor_parallel_size=args.tp,
+                          data_parallel_size=args.dp)
+    base_cfg = dict(vocab_size=256, hidden_size=64, n_layer=2, n_head=4)
+    rng = np.random.RandomState(0)
+    batches = [
+        jnp.asarray(rng.randint(0, 256, (args.batch, args.seq)))
+        for _ in range(args.steps)
+    ]
+
+    def build_and_run(overlap, grad_comm):
+        cfg = bloom.BloomConfig(**base_cfg, overlap_tp=overlap)
+        params = bloom.init_params(cfg, jax.random.PRNGKey(0))
+        specs = bloom.tp_specs(params)
+        opt = DistributedOptimizer(
+            optax.adam(5e-3), axis_name="data", grad_comm=grad_comm,
+            error_feedback=grad_comm != "fp32",
+        )
+
+        def loss_fn(p, ids):
+            return bloom.loss_fn(p, ids, None, ids, cfg, tp_axis="tensor")
+
+        init_fn, make_step = make_hybrid_train_step(
+            loss_fn, specs, opt, ctx, overlap_tp=overlap
+        )
+        opt_sds = jax.eval_shape(init_fn, params)
+        step = make_step(params)
+        report = telemetry.diagnose(
+            step, params, opt_sds,
+            jax.ShapeDtypeStruct((args.batch, args.seq), jnp.int32),
+            labels=("params", "opt_state", "batch"), mesh=ctx.mesh,
+        )
+        opt_state = init_fn(params)
+        losses = []
+        p = params
+        for ids in batches:
+            p, opt_state, loss = step(p, opt_state, ids)
+            losses.append(float(loss))
+        return losses, report
+
+    # -- 1. baseline -------------------------------------------------------
+    base_losses, base_rep = build_and_run(False, "fp32")
+    print(f"baseline losses: {[round(x, 4) for x in base_losses]}")
+
+    # -- 2. overlap: ppermute ring, zero resharding, exact losses ----------
+    ovl_losses, ovl_rep = build_and_run(True, "fp32")
+    telemetry.assert_no_resharding(ovl_rep)
+    perms = [c for c in ovl_rep.sharding.collectives
+             if c.op == "collective-permute" and c.source == "ppermute"]
+    assert perms, "overlap step must ring with ppermute"
+    assert all(abs(a - b) < 2e-3 for a, b in zip(ovl_losses, base_losses)), (
+        ovl_losses, base_losses)
+    print(f"overlap: {len(perms)} ppermute ring hops in the compiled "
+          f"schedule, zero partitioner resharding, losses match "
+          f"{[round(x, 4) for x in ovl_losses]}")
+
+    # -- 3. int8 gradient reduction: ~4x fewer wire bytes ------------------
+    int8_losses, int8_rep = build_and_run(False, "int8")
+
+    def reduction_wire(rep):
+        by_op = telemetry.wire_bytes_by_op(rep, axes=("data",))
+        return by_op.get("reduce-scatter", 0) + by_op.get("all-to-all", 0)
+
+    fp32_wire, int8_wire = reduction_wire(base_rep), reduction_wire(int8_rep)
+    ratio = fp32_wire / max(int8_wire, 1)
+    assert ratio >= 3.0, (fp32_wire, int8_wire)
+    gap = max(abs(a - b) for a, b in zip(int8_losses, base_losses))
+    assert gap < 5e-2, (int8_losses, base_losses)
+    saved = reg.gauge("comm.bytes_saved").value
+    print(f"int8 grad reduction: wire bytes {fp32_wire} -> {int8_wire} "
+          f"({ratio:.1f}x less), comm.bytes_saved gauge = {saved:.0f}, "
+          f"max loss gap vs fp32 = {gap:.4f}")
+
+    ctx.destroy()
+    print(f"\ndone: overlap rings {len(perms)} ppermutes with exact "
+          f"losses; int8 cuts gradient wire bytes {ratio:.1f}x "
+          f"(loss gap {gap:.4f})")
+
+
+if __name__ == "__main__":
+    main()

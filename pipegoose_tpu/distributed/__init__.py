@@ -1,5 +1,8 @@
 from pipegoose_tpu.distributed.parallel_context import ParallelContext
 from pipegoose_tpu.distributed.parallel_mode import MESH_AXIS_ORDER, ParallelMode
-from pipegoose_tpu.distributed import functional
+from pipegoose_tpu.distributed import compressed, functional
 
-__all__ = ["ParallelContext", "ParallelMode", "MESH_AXIS_ORDER", "functional"]
+__all__ = [
+    "ParallelContext", "ParallelMode", "MESH_AXIS_ORDER", "functional",
+    "compressed",
+]

@@ -1,0 +1,177 @@
+"""Quantized gradient collectives (EQuARX-style, arxiv 2506.17615).
+
+The DP/ZeRO gradient reduction moves full-precision bytes over the
+wire every step; EQuARX shows a block-scaled int8 all-reduce cuts that
+~4x with negligible loss impact. This module is that trade for the
+repo's named-axis collectives, in three wire precisions selected by
+``grad_comm``:
+
+- ``"fp32"`` — the existing path (no-op here, kept for symmetry);
+- ``"bf16"`` — cast, ``psum_scatter``, upcast: 2x fewer bytes, no
+  scales (the jax-native decomposition the ISSUE names);
+- ``"int8"`` — per-destination-chunk-scaled symmetric int8. An int8
+  ``psum_scatter`` would WRAP (XLA reduces in the element type), so
+  the reduce-scatter phase is the byte-equivalent quantize ->
+  ``all_to_all`` -> local dequantize+sum: the wire moves 1-byte
+  payloads of exactly the reduce-scatter's shape, the math happens in
+  fp32 on arrival. 4x fewer gradient bytes (+ one fp32 scale per chunk).
+
+ZeRO-1 stops after the reduce-scatter phase (each rank only needs its
+shard — optim/zero.py); the plain-DP all-reduce adds a requantize +
+``all_gather`` second stage.
+
+Error feedback (optional): the local quantization residual
+``g - dequant(quant(g))`` is carried across steps and added back
+before the next quantize, so the quantization error ACCUMULATES into
+later updates instead of being lost — the standard EF trick that
+closes most of the quantized-vs-fp32 loss gap. The residual lives in
+the optimizer state (``ZeroState.ef``).
+
+All functions run inside ``shard_map`` over a named mesh axis and
+assume a static axis size.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+GRAD_COMM_MODES = ("fp32", "bf16", "int8")
+
+_INT8_MAX = 127.0
+
+
+def check_grad_comm(mode: Optional[str]) -> str:
+    mode = mode or "fp32"
+    if mode not in GRAD_COMM_MODES:
+        raise ValueError(
+            f"grad_comm must be one of {GRAD_COMM_MODES}, got {mode!r}"
+        )
+    return mode
+
+
+def _quantize_chunks(flat: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """(n_chunks, m) fp32 -> (int8 values, per-chunk fp32 scales).
+    Symmetric per-chunk max-abs scaling; an all-zero chunk gets a tiny
+    positive scale so dequantization stays exact zeros."""
+    scale = jnp.max(jnp.abs(flat), axis=1) / _INT8_MAX
+    scale = jnp.maximum(scale, jnp.finfo(jnp.float32).tiny)
+    q = jnp.clip(
+        jnp.round(flat / scale[:, None]), -_INT8_MAX, _INT8_MAX
+    ).astype(jnp.int8)
+    return q, scale
+
+
+def _dequantize(q: jax.Array, scale: jax.Array) -> jax.Array:
+    return q.astype(jnp.float32) * scale[:, None]
+
+
+def compressed_reduce_scatter_mean(
+    g_padded: jax.Array,
+    axis_name: str,
+    mode: str,
+    residual: Optional[jax.Array] = None,
+) -> Tuple[jax.Array, Optional[jax.Array]]:
+    """Mean over ``axis_name`` of the gradients, scattered so this rank
+    keeps chunk ``rank`` of dim 0 — the ZeRO-1 gradient phase, at wire
+    precision ``mode``.
+
+    ``g_padded``: dim 0 already a multiple of the axis size (the
+    caller's ``_pad_to``). ``residual``: previous step's error-feedback
+    residual of the same shape (or None). Returns
+    ``(mean_shard fp32, new_residual or None)``.
+    """
+    n = lax.axis_size(axis_name)
+    mode = check_grad_comm(mode)
+    g32 = g_padded.astype(jnp.float32)
+    if residual is not None:
+        g32 = g32 + residual
+    if mode == "fp32":
+        out = lax.psum_scatter(g32, axis_name, scatter_dimension=0, tiled=True)
+        return out / n, (jnp.zeros_like(g32) if residual is not None else None)
+    if mode == "bf16":
+        gq = g32.astype(jnp.bfloat16)
+        new_res = (
+            g32 - gq.astype(jnp.float32) if residual is not None else None
+        )
+        out = lax.psum_scatter(gq, axis_name, scatter_dimension=0, tiled=True)
+        return out.astype(jnp.float32) / n, new_res
+    # int8: quantize per destination chunk, move 1-byte payloads with
+    # all_to_all (psum_scatter would wrap in int8), reduce in fp32
+    shape = g32.shape
+    flat = g32.reshape(n, -1)  # chunk row i is bound for rank i
+    q, scale = _quantize_chunks(flat)
+    new_res = (
+        (flat - _dequantize(q, scale)).reshape(shape)
+        if residual is not None
+        else None
+    )
+    q_recv = lax.all_to_all(q, axis_name, split_axis=0, concat_axis=0, tiled=True)
+    s_recv = lax.all_to_all(
+        scale, axis_name, split_axis=0, concat_axis=0, tiled=True
+    )
+    mean = _dequantize(q_recv, s_recv).sum(axis=0) / n  # (m,)
+    return mean.reshape((shape[0] // n,) + shape[1:]), new_res
+
+
+def compressed_all_reduce_mean(
+    g: jax.Array,
+    axis_name: str,
+    mode: str,
+    residual: Optional[jax.Array] = None,
+) -> Tuple[jax.Array, Optional[jax.Array]]:
+    """Full mean-all-reduce at wire precision ``mode`` — the plain-DP
+    gradient sync: the compressed reduce-scatter phase above, then the
+    reduced chunk is requantized and ``all_gather``-ed (all-reduce =
+    reduce-scatter + all-gather; both phases move compressed bytes).
+    Any-shape ``g`` (dim 0 padded internally); returns
+    ``(mean grad, new_residual or None)`` with ``g``'s shape/dtype."""
+    n = lax.axis_size(axis_name)
+    mode = check_grad_comm(mode)
+    orig_shape, orig_dtype = g.shape, g.dtype
+    gp = g[None] if g.ndim == 0 else g
+    pad = (-gp.shape[0]) % n
+    if pad:
+        gp = jnp.pad(gp, ((0, pad),) + ((0, 0),) * (gp.ndim - 1))
+    own, new_res = compressed_reduce_scatter_mean(gp, axis_name, mode, residual)
+    if mode == "fp32":
+        full = lax.all_gather(own, axis_name, axis=0, tiled=True)
+    elif mode == "bf16":
+        full = lax.all_gather(
+            own.astype(jnp.bfloat16), axis_name, axis=0, tiled=True
+        ).astype(jnp.float32)
+    else:
+        flat = own.reshape(1, -1)
+        q, scale = _quantize_chunks(flat)
+        q_full = lax.all_gather(q, axis_name, axis=0, tiled=True)  # (n, m)
+        s_full = lax.all_gather(scale, axis_name, axis=0, tiled=True)  # (n,)
+        full = _dequantize(q_full, s_full).reshape((-1,) + own.shape[1:])
+    full = full[: orig_shape[0]] if len(orig_shape) else full[0]
+    return full.reshape(orig_shape).astype(orig_dtype), new_res
+
+
+def wire_itemsize(mode: str) -> int:
+    """Bytes per gradient element on the wire for a grad_comm mode."""
+    return {"fp32": 4, "bf16": 2, "int8": 1}[check_grad_comm(mode)]
+
+
+def grad_comm_bytes_saved(params: Any, n_ranks: int, mode: str) -> int:
+    """Analytic per-step wire-byte saving of the gradient
+    reduce-scatter phase vs fp32, for the ``comm.bytes_saved`` gauge:
+    every leaf moves ``padded_size x itemsize`` payload bytes through
+    the reduce phase; int8 adds one fp32 scale per destination chunk.
+    (The doctor's compiled-HLO payload accounting is the ground truth —
+    this gauge is the cheap always-available estimate.)"""
+    mode = check_grad_comm(mode)
+    isize = wire_itemsize(mode)
+    saved = 0
+    for p in jax.tree_util.tree_leaves(params):
+        d0 = p.shape[0] if getattr(p, "ndim", 0) else 1
+        rest = int(getattr(p, "size", 1)) // max(d0, 1)
+        padded = (-(-d0 // n_ranks) * n_ranks) * rest
+        saved += padded * (4 - isize)
+        if mode == "int8":
+            saved -= n_ranks * 4  # per-chunk fp32 scales ride along
+    return max(saved, 0)

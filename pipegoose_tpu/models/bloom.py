@@ -89,6 +89,14 @@ class BloomConfig:
     # strictly dominates ce_chunks when the kernel is available; takes
     # precedence over it
     fused_ce: bool = False
+    # ring collective-matmul overlap (nn/tensor_parallel/overlap.py):
+    # the dense/hybrid train path keeps activations TOKEN-SHARDED over
+    # the tensor axis between blocks and decomposes the column gather /
+    # row reduce into ppermute steps interleaved with partial matmuls,
+    # so TP comm hides behind compute (and activations shrink by 1/tp).
+    # Training-path flag: generate/serving and the PP/SP compositions
+    # ignore it. Requires seq % tp == 0.
+    overlap_tp: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -211,12 +219,28 @@ def _local_heads(config: BloomConfig, tp: int) -> int:
     return config.n_head // tp
 
 
-def _mlp(blk: dict, x: jax.Array, config: BloomConfig, tp_axis) -> jax.Array:
+def _mlp(
+    blk: dict, x: jax.Array, config: BloomConfig, tp_axis, overlap: bool = False
+) -> jax.Array:
     """ln_2 -> column up -> gelu -> row down (single source for the
-    dense, pipeline, and sequence-parallel block paths)."""
-    ln2 = layer_norm(blk["ln_2"], x, config.layer_norm_epsilon)
-    h = column_parallel_linear(blk["mlp"]["up"], ln2, tp_axis)
-    return row_parallel_linear(blk["mlp"]["down"], bloom_gelu(h), tp_axis)
+    dense, pipeline, and sequence-parallel block paths).
+
+    ``overlap``: ``x`` is this rank's token chunk; the up-projection
+    ring-gathers tokens while it projects and the down-projection
+    ring-reduces while it projects (nn/tensor_parallel/overlap.py), so
+    the block maps token shard -> token shard with the comm hidden.
+    ``ln_2`` then sees only local tokens, so its params route through
+    the f-operator for exact full-sequence grads."""
+    ln2_p = blk["ln_2"]
+    if overlap:
+        from pipegoose_tpu.nn.tensor_parallel.overlap import replicated_for_overlap
+
+        ln2_p = replicated_for_overlap(ln2_p, tp_axis)
+    ln2 = layer_norm(ln2_p, x, config.layer_norm_epsilon)
+    h = column_parallel_linear(blk["mlp"]["up"], ln2, tp_axis, overlap=overlap)
+    return row_parallel_linear(
+        blk["mlp"]["down"], bloom_gelu(h), tp_axis, overlap=overlap
+    )
 
 
 def _attention(
@@ -225,18 +249,28 @@ def _attention(
     bias: dict,
     config: BloomConfig,
     tp_axis: Optional[str],
+    overlap: bool = False,
 ) -> jax.Array:
     """Self-attention with heads sharded over ``tp_axis``. qkv is
     column-parallel, the output projection row-parallel — the Megatron
     pattern the reference applies by module surgery
     (tensor_parallel/parallel_mapping.py:23-31). ``bias`` is the dict
-    from :func:`attention_bias`."""
-    b, s, _ = x.shape
+    from :func:`attention_bias`.
+
+    ``overlap``: ``x`` is this rank's token chunk; the qkv projection
+    ring-gathers the sequence while it projects (attention itself needs
+    every key anyway), the attention core runs full-sequence exactly as
+    the monolithic path, and the output projection ring-reduce-scatters
+    back to the token chunk."""
+    b = x.shape[0]
     hd = config.head_dim
     tp = jax.lax.axis_size(tp_axis) if tp_axis else 1
     local_heads = _local_heads(config, tp)
 
-    fused = column_parallel_linear(blk["qkv"], x, tp_axis)  # (B,S,3H/tp)
+    fused = column_parallel_linear(
+        blk["qkv"], x, tp_axis, overlap=overlap
+    )  # (B,S,3H/tp) — full-token either way
+    s = fused.shape[1]
     fused = fused.reshape(b, s, local_heads, 3, hd)
     q, k, v = fused[..., 0, :], fused[..., 1, :], fused[..., 2, :]
 
@@ -258,7 +292,7 @@ def _attention(
         ctx = ctx * bias["qmask"][:, :, None, None].astype(ctx.dtype)
         ctx = checkpoint_name(ctx, "attn_out")  # for remat_policy="attn"
         ctx = ctx.astype(x.dtype).reshape(b, s, local_heads * hd)
-        return row_parallel_linear(blk["out"], ctx, tp_axis)
+        return row_parallel_linear(blk["out"], ctx, tp_axis, overlap=overlap)
 
     # local head slice of the alibi bias
     alibi = bias["alibi"]
@@ -280,7 +314,7 @@ def _attention(
     ctx = ctx * bias["qmask"][:, :, None, None].astype(ctx.dtype)
     ctx = checkpoint_name(ctx, "attn_out")
     ctx = ctx.astype(x.dtype).reshape(b, s, local_heads * hd)
-    return row_parallel_linear(blk["out"], ctx, tp_axis)
+    return row_parallel_linear(blk["out"], ctx, tp_axis, overlap=overlap)
 
 
 def _block(
@@ -289,13 +323,24 @@ def _block(
     bias: dict,
     config: BloomConfig,
     tp_axis: Optional[str],
+    overlap: bool = False,
 ) -> jax.Array:
     """One transformer block, HF BloomBlock ordering (pre-LN, residual
-    from the un-normalized stream)."""
+    from the un-normalized stream).
+
+    ``overlap``: the ring collective-matmul path — ``x`` is this rank's
+    token chunk of the residual stream; the dense/hybrid forward sets
+    it from ``config.overlap_tp``, the PP/SP compositions keep the
+    monolithic path (their stream is already sharded differently)."""
     eps = config.layer_norm_epsilon
-    ln1 = layer_norm(blk["ln_1"], x, eps)
-    x = x + _attention(blk["attn"], ln1, bias, config, tp_axis)
-    return x + _mlp(blk, x, config, tp_axis)
+    ln1_p = blk["ln_1"]
+    if overlap:
+        from pipegoose_tpu.nn.tensor_parallel.overlap import replicated_for_overlap
+
+        ln1_p = replicated_for_overlap(ln1_p, tp_axis)
+    ln1 = layer_norm(ln1_p, x, eps)
+    x = x + _attention(blk["attn"], ln1, bias, config, tp_axis, overlap=overlap)
+    return x + _mlp(blk, x, config, tp_axis, overlap=overlap)
 
 
 def embed_tokens(
@@ -340,7 +385,13 @@ def forward_hidden(
     config: BloomConfig,
     tp_axis: Optional[str] = None,
 ) -> jax.Array:
-    """Embedding -> scanned blocks -> final LN. Returns (B, S, H)."""
+    """Embedding -> scanned blocks -> final LN. Returns (B, S, H).
+
+    With ``config.overlap_tp`` (and a tensor axis) the residual stream
+    between blocks is TOKEN-SHARDED over ``tp_axis``: one f/g scatter
+    after the (replicated) embedding, ring collective-matmuls inside
+    every block, one f/g gather before the final LN — the hidden the
+    caller sees is identical (fp32 allclose) to the monolithic path."""
     b, s = input_ids.shape
     if attention_mask is None:
         attention_mask = jnp.ones((b, s), dtype=jnp.int32)
@@ -348,7 +399,19 @@ def forward_hidden(
     x = embed_tokens(params, input_ids, config, tp_axis)
     bias = attention_bias(attention_mask, config)
 
-    block = partial(_block, config=config, tp_axis=tp_axis)
+    overlap = bool(getattr(config, "overlap_tp", False)) and tp_axis is not None
+    if overlap:
+        from pipegoose_tpu.distributed.functional import scatter_to_tensor_group
+
+        tp = jax.lax.axis_size(tp_axis)
+        if s % tp:
+            raise ValueError(
+                f"overlap_tp: sequence length {s} must be divisible by "
+                f"the tensor axis size {tp} (token chunks ride the ring)"
+            )
+        x = scatter_to_tensor_group(x, tp_axis, dim=1)
+
+    block = partial(_block, config=config, tp_axis=tp_axis, overlap=overlap)
     if config.remat:
         block = _remat_wrap(block, config)
 
@@ -356,6 +419,10 @@ def forward_hidden(
         return block(blk, carry, bias), None
 
     x, _ = jax.lax.scan(scan_fn, x, params["blocks"])
+    if overlap:
+        from pipegoose_tpu.distributed.functional import gather_from_tensor_group
+
+        x = gather_from_tensor_group(x, tp_axis, dim=1)
     return layer_norm(params["ln_f"], x, config.layer_norm_epsilon)
 
 

@@ -31,20 +31,35 @@ def average_gradients(
     axis_name: Optional[str] = "data",
     expert_mapping: Optional[ParallelMapping] = None,
     expert_axis: Optional[str] = None,
+    grad_comm: str = "fp32",
 ) -> Any:
     """pmean the grad pytree over the data axis. Params matched as
     ``expert`` by ``expert_mapping`` are averaged over ``expert_axis``
     instead (the reference's is_expert -> EXPERT_DATA routing,
-    data_parallel.py:35-43); ``expert_axis=None`` leaves them local."""
+    data_parallel.py:35-43); ``expert_axis=None`` leaves them local.
+
+    ``grad_comm``: wire precision of the data-axis mean — "fp32" (the
+    plain pmean), "bf16", or "int8" (EQuARX-style compressed all-reduce,
+    distributed/compressed.py; docs/comm.md). Expert grads always sync
+    in fp32 (they are few and routing-sensitive)."""
     if axis_name is None:
         return grads
+
+    from pipegoose_tpu.distributed.compressed import (
+        check_grad_comm,
+        compressed_all_reduce_mean,
+    )
+
+    mode = check_grad_comm(grad_comm)
 
     def avg(path, g):
         if expert_mapping is not None and expert_mapping.is_expert(path_str(path)):
             if expert_axis is None:
                 return g
             return lax.pmean(g, expert_axis)
-        return lax.pmean(g, axis_name)
+        if mode == "fp32":
+            return lax.pmean(g, axis_name)
+        return compressed_all_reduce_mean(g, axis_name, mode)[0]
 
     return tree_map_with_path(avg, grads)
 
@@ -77,4 +92,6 @@ class DataParallel(Parallel):
         return P(self.axis_name)
 
     def average_gradients(self, grads: Any, **kw) -> Any:
+        """Supports the same ``grad_comm=`` wire-precision selection as
+        the module-level function (docs/comm.md)."""
         return average_gradients(grads, self.axis_name, **kw)

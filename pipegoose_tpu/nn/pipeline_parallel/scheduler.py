@@ -191,6 +191,33 @@ class OneFOneBScheduler(GPipeScheduler):
     would follow; pipeline.py's gpipe keeps the plain GPipe schedule
     (remat bounds its activation memory instead)."""
 
+    def tables(self):
+        """Cached ``one_f_one_b_tables`` result — the (fwd, bwd,
+        n_slots, n_clock) global clock timetable the compiled runtime
+        executes."""
+        if getattr(self, "_tables", None) is None:
+            self._tables = one_f_one_b_tables(
+                self.n_microbatches, self.n_partitions
+            )
+        return self._tables
+
+    @property
+    def n_clock(self) -> int:
+        return int(self.tables()[3])
+
+    @property
+    def bubble_fraction(self) -> float:
+        """Idle share of the ACTUAL compiled 1F1B timetable (not the
+        inherited GPipe formula): each stage executes 2M instructions
+        (one F and one B per microbatch) over ``n_clock`` clocks, so
+        the per-stage-averaged idle share is ``1 - 2M/n_clock``. Equals
+        GPipe's (P-1)/(M+P-1) whenever the greedy timetable achieves
+        the PipeDream-flush bound of 2(M+P-1) clocks, and reports the
+        TRUE number when list-scheduling needs extra clocks — so the
+        ``pipeline.bubble_*`` gauges and the Perfetto timeline
+        (telemetry/chrometrace.py) are no longer GPipe-only."""
+        return 1.0 - (2.0 * self.n_microbatches) / self.n_clock
+
     def timeline(self, partition_idx: int) -> List[Task]:
         """Per-stage instruction stream: warmup forwards, steady 1F1B
         pairs, cooldown backwards."""

@@ -5,11 +5,23 @@ from pipegoose_tpu.nn.tensor_parallel.layers import (
     vocab_parallel_cross_entropy,
     vocab_parallel_embedding,
 )
+from pipegoose_tpu.nn.tensor_parallel.overlap import (
+    column_parallel_linear_overlap,
+    replicated_for_overlap,
+    ring_all_gather_matmul,
+    ring_matmul_reduce_scatter,
+    row_parallel_linear_overlap,
+)
 from pipegoose_tpu.nn.tensor_parallel.tensor_parallel import TensorParallel, pad_vocab
 
 __all__ = [
     "column_parallel_linear",
     "row_parallel_linear",
+    "column_parallel_linear_overlap",
+    "row_parallel_linear_overlap",
+    "ring_all_gather_matmul",
+    "ring_matmul_reduce_scatter",
+    "replicated_for_overlap",
     "layer_norm",
     "vocab_parallel_embedding",
     "vocab_parallel_cross_entropy",

@@ -34,13 +34,33 @@ def column_parallel_linear(
     x: jax.Array,
     axis_name: Optional[str],
     gather_output: bool = False,
+    overlap: bool = False,
 ) -> jax.Array:
     """Y = X @ W[:, shard] (+ b[shard]).
 
     Reference ColumnParallelLinear.forward (linear.py:40-50): broadcast
     input (f-operator) -> local matmul -> optional all-gather of the
     output's last dim.
+
+    ``overlap=True``: ``x`` is this rank's TOKEN CHUNK of the sequence
+    (dim -2 sharded over ``axis_name``) and the gather back to full
+    tokens is decomposed into ring ppermute steps interleaved with
+    partial matmuls (nn/tensor_parallel/overlap.py) — comm hides behind
+    compute, forward and backward. Output is full-token, OUT-sharded,
+    numerically equal (fp32 allclose) to the monolithic path on the
+    gathered input.
     """
+    if overlap:
+        if gather_output:
+            raise ValueError(
+                "column_parallel_linear(overlap=True) keeps the output "
+                "OUT-sharded; gather_output is not supported"
+            )
+        from pipegoose_tpu.nn.tensor_parallel.overlap import (
+            column_parallel_linear_overlap,
+        )
+
+        return column_parallel_linear_overlap(params, x, axis_name)
     x = copy_to_tensor_group(x, axis_name) if axis_name else x
     y = jnp.dot(x, params["kernel"], preferred_element_type=jnp.float32)
     y = y.astype(x.dtype)
@@ -56,12 +76,31 @@ def row_parallel_linear(
     x: jax.Array,
     axis_name: Optional[str],
     input_is_parallel: bool = True,
+    overlap: bool = False,
 ) -> jax.Array:
     """Y = psum_over_shards(X[shard] @ W[shard, :]) + b.
 
     Reference RowParallelLinear.forward (linear.py:74-82): scatter input
     last dim -> local matmul -> all-reduce (g-operator) -> add full bias.
+
+    ``overlap=True``: the output reduce is decomposed into a ring
+    matmul-reduce-scatter (nn/tensor_parallel/overlap.py) — each rank
+    returns its TOKEN CHUNK (dim -2) of the fully reduced output, the
+    reduce's transfers hidden behind the partial matmuls, forward and
+    backward. Equal (fp32 allclose) to the monolithic psum path's rows
+    for this chunk.
     """
+    if overlap:
+        if not input_is_parallel:
+            raise ValueError(
+                "row_parallel_linear(overlap=True) requires the input "
+                "already feature-sharded (input_is_parallel=True)"
+            )
+        from pipegoose_tpu.nn.tensor_parallel.overlap import (
+            row_parallel_linear_overlap,
+        )
+
+        return row_parallel_linear_overlap(params, x, axis_name)
     if axis_name and not input_is_parallel:
         x = scatter_to_tensor_group(x, axis_name, dim=-1)
     y = jnp.dot(x, params["kernel"], preferred_element_type=jnp.float32)

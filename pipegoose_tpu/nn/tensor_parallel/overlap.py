@@ -1,0 +1,276 @@
+"""Ring collective-matmul: TP collectives hidden behind partial matmuls.
+
+"On Optimizing the Communication of Model Parallelism"
+(arxiv 2211.05322) observes that the collectives of Megatron-style
+tensor parallelism need not run as monolithic ops serialized against
+the matmuls they feed: an all-gather followed by a matmul can be
+decomposed into ``tp`` partial matmuls interleaved with ``tp - 1``
+``ppermute`` ring steps (and symmetrically a matmul followed by a
+reduce becomes a ring matmul-reduce-scatter), so the per-hop transfer
+overlaps the next partial matmul and the collective's latency hides
+behind compute the program had to do anyway.
+
+This module is that decomposition for the repo's TP layers
+(nn/tensor_parallel/layers.py), under ``shard_map`` over a named mesh
+axis, with hand-written VJPs so the BACKWARD pass rings too:
+
+- :func:`ring_all_gather_matmul` — ``concat_c(x_c) @ w`` where rank r
+  holds sequence chunk ``x_r``: the ColumnParallel input all-gather,
+  decomposed. Its backward is a ring matmul-reduce-scatter for ``dx``
+  plus a second ring accumulating ``dw``.
+- :func:`ring_matmul_reduce_scatter` — ``sum_r(x^{(r)} @ w^{(r)})``
+  scattered so rank r keeps sequence chunk r: the RowParallel output
+  reduce, decomposed (all-reduce = reduce-scatter + all-gather; the
+  reduce-scatter half — the half that must wait on the matmul — is
+  what rings here). Its backward is one ring of ``dy`` chunks feeding
+  both ``dx`` and ``dw`` partial matmuls.
+
+The layer entry points :func:`column_parallel_linear_overlap` /
+:func:`row_parallel_linear_overlap` compose to the Megatron
+sequence-parallel dataflow: activations between layers live SHARDED on
+the token dim over the tensor axis (1/tp the activation memory of the
+replicated-stream path), the column layer gathers tokens while it
+projects, the row layer reduces while it projects. Numerics match the
+monolithic path to fp32 allclose (the only difference is fp32
+summation order in the reduce); gradients are exact per rank — no
+extra grad sync over the tensor axis is needed (replicated params used
+on token shards are routed through :func:`replicated_for_overlap`'s
+f-operator so their cotangents psum inside the backward).
+
+Everything here requires a STATIC axis size (``lax.axis_size`` under
+``shard_map``); the ring loops are Python-unrolled so XLA sees
+``tp - 1`` independent collective-permutes it can schedule
+asynchronously against the partial matmuls.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from pipegoose_tpu.distributed.functional import copy_to_tensor_group
+
+
+def _ring_perm(n: int):
+    """Send rank i -> i+1: after k hops rank r holds rank (r-k)'s value."""
+    return [(i, (i + 1) % n) for i in range(n)]
+
+
+def _chunk_dot(x, w):
+    """Partial matmul in fp32 accumulation (the layers' convention)."""
+    return jnp.dot(x, w, preferred_element_type=jnp.float32)
+
+
+def ring_all_gather_matmul(x_local: jax.Array, w: jax.Array, axis_name: str):
+    """``concat_over_ranks(x) @ w`` with the gather decomposed.
+
+    ``x_local``: (..., m, K) — this rank's token chunk (chunk id = rank).
+    Returns (..., n*m, N) fp32 — identical on every rank up to fp32
+    rounding, chunk rows ordered by global chunk id. ``n - 1`` ppermute
+    steps, each overlapping the next chunk's matmul.
+    """
+    n = lax.axis_size(axis_name)
+    if n == 1:
+        return _chunk_dot(x_local, w)
+    r = lax.axis_index(axis_name)
+    m = x_local.shape[-2]
+    out = jnp.zeros(
+        x_local.shape[:-2] + (n * m, w.shape[-1]), jnp.float32
+    )
+    perm = _ring_perm(n)
+    cur = x_local
+    for step in range(n):
+        c = (r - step) % n  # chunk id currently held
+        y_c = _chunk_dot(cur, w)
+        out = lax.dynamic_update_slice_in_dim(out, y_c, c * m, axis=-2)
+        if step < n - 1:
+            cur = lax.ppermute(cur, axis_name, perm=perm)
+    return out
+
+
+def ring_matmul_reduce_scatter(x_full: jax.Array, w: jax.Array, axis_name: str):
+    """``sum_over_ranks(x @ w)``, rank r keeping token chunk r.
+
+    ``x_full``: (..., n*m, K) — full token dim, feature-sharded ``w``.
+    Returns (..., m, N) fp32 — this rank's chunk of the summed output.
+    The accumulator for chunk c starts at rank c+1 and rides the ring
+    for ``n - 1`` hops, each hop's transfer overlapping the next
+    chunk's partial matmul.
+    """
+    n = lax.axis_size(axis_name)
+    if n == 1:
+        return _chunk_dot(x_full, w)
+    r = lax.axis_index(axis_name)
+    m = x_full.shape[-2] // n
+    perm = _ring_perm(n)
+    acc = None
+    for step in range(n):
+        c = (r - 1 - step) % n  # chunk this rank contributes to now
+        x_c = lax.dynamic_slice_in_dim(x_full, c * m, m, axis=-2)
+        part = _chunk_dot(x_c, w)
+        acc = part if acc is None else lax.ppermute(acc, axis_name, perm=perm) + part
+    return acc  # after n steps: chunk (r - n) % n == r, fully summed
+
+
+def _ring_accumulate_dw(x_local, dy_full, axis_name: str):
+    """``dw = sum_c x_c^T @ dy[chunk c]`` with the x chunks ringed —
+    the column backward's weight cotangent, comm overlapped exactly
+    like the forward gather."""
+    n = lax.axis_size(axis_name)
+    r = lax.axis_index(axis_name) if n > 1 else 0
+    m = x_local.shape[-2]
+    perm = _ring_perm(n)
+    cur = x_local
+    dw = jnp.zeros((x_local.shape[-1], dy_full.shape[-1]), jnp.float32)
+    for step in range(n):
+        c = (r - step) % n
+        dy_c = lax.dynamic_slice_in_dim(dy_full, c * m, m, axis=-2)
+        # sum all leading (batch) dims into the (K, N) cotangent
+        dw = dw + jnp.einsum(
+            "...mk,...mn->kn", cur, dy_c, preferred_element_type=jnp.float32
+        )
+        if step < n - 1:
+            cur = lax.ppermute(cur, axis_name, perm=perm)
+    return dw
+
+
+# --------------------------------------------------------------------------
+# Column parallel: gather tokens while projecting
+# --------------------------------------------------------------------------
+
+@partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _column_overlap(kernel, x_local, axis_name):
+    return _column_overlap_fwd(kernel, x_local, axis_name)[0]
+
+
+def _column_overlap_fwd(kernel, x_local, axis_name):
+    y = ring_all_gather_matmul(x_local, kernel, axis_name)
+    return y, (kernel, x_local)
+
+
+def _column_overlap_bwd(axis_name, res, dy):
+    kernel, x_local = res
+    # dx_r = sum_q dy^{(q)}[chunk r] @ W_q^T — exactly a ring
+    # matmul-reduce-scatter of the dy chunks over the OUT-sharded
+    # kernels (one schedule, defined once above)
+    dx = ring_matmul_reduce_scatter(dy, kernel.T, axis_name)
+    dx = dx.astype(x_local.dtype)
+    dw = _ring_accumulate_dw(x_local, dy, axis_name).astype(kernel.dtype)
+    return dw, dx
+
+
+_column_overlap.defvjp(_column_overlap_fwd, _column_overlap_bwd)
+
+
+def column_parallel_linear_overlap(
+    params: dict, x_local: jax.Array, axis_name: Optional[str]
+) -> jax.Array:
+    """ColumnParallel with the input token gather decomposed into the
+    ring. ``x_local``: (..., m, K) token chunk; returns (..., n*m, O/n)
+    full-token, OUT-sharded — exactly what the monolithic
+    ``column_parallel_linear`` produces from the gathered input, to
+    fp32 allclose. ``axis_name=None`` degrades to the plain matmul."""
+    if not axis_name:
+        y = _chunk_dot(x_local, params["kernel"]).astype(x_local.dtype)
+    else:
+        y = _column_overlap(params["kernel"], x_local, axis_name)
+        y = y.astype(x_local.dtype)
+    if "bias" in params and params["bias"] is not None:
+        y = y + params["bias"]
+    return y
+
+
+# --------------------------------------------------------------------------
+# Row parallel: reduce tokens while projecting
+# --------------------------------------------------------------------------
+
+@partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _row_overlap(kernel, x_full, axis_name):
+    return _row_overlap_fwd(kernel, x_full, axis_name)[0]
+
+
+def _row_overlap_fwd(kernel, x_full, axis_name):
+    y = ring_matmul_reduce_scatter(x_full, kernel, axis_name)
+    return y, (kernel, x_full)
+
+
+def _row_overlap_bwd(axis_name, res, dy_own):
+    kernel, x_full = res
+    n = lax.axis_size(axis_name)
+    if n == 1:
+        dx = jnp.einsum(
+            "...mn,kn->...mk", dy_own, kernel, preferred_element_type=jnp.float32
+        ).astype(x_full.dtype)
+        dw = jnp.einsum(
+            "...mk,...mn->kn", x_full, dy_own, preferred_element_type=jnp.float32
+        ).astype(kernel.dtype)
+        return dw, dx
+    r = lax.axis_index(axis_name)
+    m = dy_own.shape[-2]
+    perm = _ring_perm(n)
+    # ONE ring of the dy chunks feeds both cotangents: dx rows for chunk
+    # c are dy_c @ W^T, dw accumulates x_c^T @ dy_c
+    dx = jnp.zeros(x_full.shape, jnp.float32)
+    dw = jnp.zeros(kernel.shape, jnp.float32)
+    cur = dy_own
+    for step in range(n):
+        c = (r - step) % n  # dy chunk currently held
+        dx_c = jnp.einsum(
+            "...mn,kn->...mk", cur, kernel, preferred_element_type=jnp.float32
+        )
+        dx = lax.dynamic_update_slice_in_dim(dx, dx_c, c * m, axis=-2)
+        x_c = lax.dynamic_slice_in_dim(x_full, c * m, m, axis=-2)
+        dw = dw + jnp.einsum(
+            "...mk,...mn->kn", x_c, cur, preferred_element_type=jnp.float32
+        )
+        if step < n - 1:
+            cur = lax.ppermute(cur, axis_name, perm=perm)
+    return dw.astype(kernel.dtype), dx.astype(x_full.dtype)
+
+
+_row_overlap.defvjp(_row_overlap_fwd, _row_overlap_bwd)
+
+
+def row_parallel_linear_overlap(
+    params: dict, x_full: jax.Array, axis_name: Optional[str]
+) -> jax.Array:
+    """RowParallel with the output reduce decomposed into the ring.
+    ``x_full``: (..., n*m, I/n) full-token, IN-sharded; returns
+    (..., m, O) — this rank's token chunk of the fully reduced output
+    (the reduce-scatter half of the monolithic all-reduce; the
+    all-gather half belongs to whichever later op needs full tokens
+    again). The replicated bias is added on the local chunk through the
+    f-operator so its cotangent psums to the full-token sum."""
+    if not axis_name:
+        y = _chunk_dot(x_full, params["kernel"]).astype(x_full.dtype)
+    else:
+        y = _row_overlap(params["kernel"], x_full, axis_name)
+        y = y.astype(x_full.dtype)
+    if "bias" in params and params["bias"] is not None:
+        bias = params["bias"]
+        if axis_name:
+            bias = copy_to_tensor_group(bias, axis_name)
+        y = y + bias
+    return y
+
+
+# --------------------------------------------------------------------------
+# Replicated-param use on token shards
+# --------------------------------------------------------------------------
+
+def replicated_for_overlap(params, axis_name: Optional[str]):
+    """Route a replicated param (sub)tree through the f-operator before
+    using it on a TOKEN SHARD of the sequence: forward identity,
+    backward psums the cotangent over the tensor axis — so e.g. a
+    LayerNorm applied to 1/tp of the tokens still produces the exact
+    full-sequence parameter gradient on every rank, and the hybrid
+    step's grad contract is unchanged between the overlap and
+    monolithic paths."""
+    if not axis_name:
+        return params
+    return jax.tree_util.tree_map(
+        lambda p: copy_to_tensor_group(p, axis_name), params
+    )

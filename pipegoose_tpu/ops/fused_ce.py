@@ -46,13 +46,18 @@ def _resolve_interpret(interpret):
     return interpret
 
 
-def _pick_block(n: int, target: int) -> int:
+def _pick_block(n: int, target: int):
+    """Largest halving of ``target`` (>= 8) dividing ``n``. Returns
+    ``(block, exact)`` — ``exact=False`` means NO such divisor exists
+    and the fallback is the whole dim as one tile, which callers must
+    treat as infeasible for compiled TPU runs (a non-8-aligned or
+    whole-vocab tile dies in Mosaic; ADVICE r5)."""
     b = target
     while b >= 8:
         if n % b == 0:
-            return b
+            return b, True
         b //= 2
-    return n
+    return n, False
 
 
 def _fwd_pallas(h, w, targets, offset, valid, block_t, block_v, interpret,
@@ -380,21 +385,24 @@ def fused_ce_sums(
     block_t = min(pow2, block_t)
     v_loc = weight.shape[0] if vh else weight.shape[1]
     requested_v = block_v
-    block_v = _pick_block(v_loc, block_v)
+    block_v, exact_v = _pick_block(v_loc, block_v)
     interpret = _resolve_interpret(interpret)
-    if block_v > requested_v and not interpret:
-        # _pick_block's fallback is the WHOLE vocab dim as one tile; a
-        # (V_local, H) fp32 tile cannot fit VMEM on hardware, so the
-        # compiled run would die with an opaque Mosaic error that
-        # interpret-mode tests never see (ADVICE r5) — fail loudly here,
-        # but only for compiled runs: the interpreter has no VMEM limit
-        # and the whole-vocab tile is valid there.
+    if not exact_v and not interpret:
+        # _pick_block's fallback is the WHOLE vocab dim as one tile.
+        # Whether V_local is larger than the requested block (a
+        # (V_local, H) fp32 tile cannot fit VMEM) or merely smaller but
+        # not 8-aligned (Mosaic rejects the ragged tile), the compiled
+        # run would die with an opaque Mosaic error that interpret-mode
+        # tests never see (ADVICE r5) — fail loudly here, but only for
+        # compiled runs: the interpreter has no VMEM limit or tile
+        # alignment and the whole-vocab tile is valid there.
         raise ValueError(
             f"fused CE: no block size >= 8 among halvings of "
             f"{requested_v} divides V_local={v_loc}, and a single "
-            f"(V_local={v_loc}, H) tile is VMEM-infeasible on hardware. "
-            f"Pad the vocab shard to a power-of-two-friendly size "
-            f"(pad_for_tp / pad_vocab) or pass a block_v dividing it."
+            f"(V_local={v_loc}, H) whole-vocab tile is VMEM-infeasible "
+            f"(or not 8-aligned) on hardware. Pad the vocab shard to a "
+            f"power-of-two-friendly size (pad_for_tp / pad_vocab) or "
+            f"pass a block_v dividing it."
         )
     if t % block_t:
         pad = block_t - t % block_t

@@ -58,18 +58,69 @@ def _unshard(shard: jax.Array, orig_shape, axis_name: str) -> jax.Array:
 
 
 class ZeroState(NamedTuple):
-    inner: Any  # inner optax state over param SHARDS
+    inner: Any      # inner optax state over param SHARDS
+    # error-feedback residuals for the compressed gradient reduction
+    # (distributed/compressed.py): one fp32 leaf of shape
+    # (1, *padded_local_grad_shape) per param — the leading length-1 dim
+    # carries the data-axis PartitionSpec (each rank's residual is its
+    # OWN, the global array stacks them). None unless the optimizer was
+    # built with grad_comm != "fp32" and error_feedback=True.
+    ef: Any = None
 
 
 class DistributedOptimizer:
     """ZeRO-1 wrapper over an optax transform (reference optim.py:14-75
-    wraps a torch optimizer class the same way)."""
+    wraps a torch optimizer class the same way).
 
-    def __init__(self, inner: optax.GradientTransformation, axis_name: Optional[str] = "data"):
+    ``grad_comm``: wire precision of the gradient reduce-scatter —
+    ``"fp32"`` (default, the plain ``psum_scatter``), ``"bf16"``, or
+    ``"int8"`` (EQuARX-style per-chunk-scaled quantization,
+    distributed/compressed.py). ``error_feedback=True`` carries the
+    local quantization residual across steps in ``ZeroState.ef`` and
+    adds it back before the next quantize.
+    """
+
+    def __init__(
+        self,
+        inner: optax.GradientTransformation,
+        axis_name: Optional[str] = "data",
+        grad_comm: str = "fp32",
+        error_feedback: bool = False,
+    ):
+        from pipegoose_tpu.distributed.compressed import check_grad_comm
+
         self.inner = inner
         self.axis_name = axis_name
+        self.grad_comm = check_grad_comm(grad_comm)
+        if error_feedback and self.grad_comm == "fp32":
+            raise ValueError("error_feedback requires grad_comm bf16/int8")
+        if error_feedback and axis_name is None:
+            # the residual lives in ZeroState.ef, which only exists on
+            # the sharded path — silently running compressed comm
+            # WITHOUT the requested feedback would be worse than failing
+            raise ValueError(
+                "error_feedback requires a ZeRO axis_name (the plain-DP "
+                "grad_comm path is stateless)"
+            )
+        self.error_feedback = bool(error_feedback)
+
+    def replace(self, **kw) -> "DistributedOptimizer":
+        """Copy with fields overridden (make_hybrid_train_step threads
+        its ``grad_comm=`` through here without mutating the caller's
+        optimizer)."""
+        cfg = dict(
+            inner=self.inner, axis_name=self.axis_name,
+            grad_comm=self.grad_comm, error_feedback=self.error_feedback,
+        )
+        cfg.update(kw)
+        return DistributedOptimizer(**cfg)
 
     # -- lifecycle ---------------------------------------------------------
+
+    def _ef_zero(self, p: jax.Array, n: int) -> jax.Array:
+        shape = tuple(p.shape) if p.ndim else (1,)
+        d0 = -(-shape[0] // n) * n
+        return jnp.zeros((1, d0) + shape[1:], jnp.float32)
 
     def init(self, params: Any) -> ZeroState:
         """Optimizer state exists only for this rank's shard — the memory
@@ -80,37 +131,89 @@ class DistributedOptimizer:
         shards = jax.tree_util.tree_map(
             partial(_local_shard, axis_name=self.axis_name), params
         )
-        return ZeroState(self.inner.init(shards))
+        ef = None
+        if self.error_feedback:
+            n = lax.axis_size(self.axis_name)
+            ef = jax.tree_util.tree_map(lambda p: self._ef_zero(p, n), params)
+        return ZeroState(self.inner.init(shards), ef)
 
     def step(self, grads: Any, state: ZeroState, params: Any):
         """One ZeRO-1 step. ``grads`` are this device's LOCAL (unreduced)
         grads from its batch shard; the reduce_scatter both averages over
         the data axis and hands each rank its shard in one collective
         (the upgrade SURVEY.md §2.2 calls out over the reference's
-        broadcast loop, optim.py:57-66)."""
+        broadcast loop, optim.py:57-66) — at ``grad_comm`` wire
+        precision when compressed."""
         ax = self.axis_name
         if ax is None:
             updates, inner = self.inner.update(grads, state.inner, params)
             return optax.apply_updates(params, updates), ZeroState(inner)
 
-        def grad_shard(g):
-            n = lax.axis_size(ax)
-            return reduce_scatter(_pad_to(g, n), ax, dim=0) / n
+        n = lax.axis_size(ax)
+        ef = getattr(state, "ef", None)
+        if self.grad_comm == "fp32" and ef is None:
+            g_shards = jax.tree_util.tree_map(
+                lambda g: reduce_scatter(_pad_to(g, n), ax, dim=0) / n, grads
+            )
+            new_ef = None
+        else:
+            from pipegoose_tpu.distributed.compressed import (
+                compressed_reduce_scatter_mean,
+            )
 
-        g_shards = jax.tree_util.tree_map(grad_shard, grads)
+            def shard_one(g, e):
+                out, new_e = compressed_reduce_scatter_mean(
+                    _pad_to(g, n), ax, self.grad_comm,
+                    residual=None if e is None else e[0],
+                )
+                # keep the inner transform's grad dtype identical to the
+                # fp32 wire path (state dtypes must not drift per step)
+                return out.astype(g.dtype), (
+                    None if new_e is None else new_e[None]
+                )
+
+            # flatten explicitly: shard_one returns 2-tuples, and a
+            # tree_map + is_leaf=tuple would misfire on grads pytrees
+            # that themselves contain tuples/NamedTuples
+            g_leaves, treedef = jax.tree_util.tree_flatten(grads)
+            e_leaves = (
+                jax.tree_util.tree_leaves(ef)
+                if ef is not None else [None] * len(g_leaves)
+            )
+            outs = [shard_one(g, e) for g, e in zip(g_leaves, e_leaves)]
+            g_shards = jax.tree_util.tree_unflatten(
+                treedef, [o[0] for o in outs]
+            )
+            new_ef = (
+                jax.tree_util.tree_unflatten(
+                    treedef, [o[1] for o in outs]
+                )
+                if ef is not None
+                else None
+            )
         p_shards = jax.tree_util.tree_map(partial(_local_shard, axis_name=ax), params)
         updates, inner = self.inner.update(g_shards, state.inner, p_shards)
         new_p_shards = optax.apply_updates(p_shards, updates)
         new_params = jax.tree_util.tree_map(
             lambda s, p: _unshard(s, p.shape, ax).astype(p.dtype), new_p_shards, params
         )
-        return new_params, ZeroState(inner)
+        return new_params, ZeroState(inner, new_ef)
 
-    # reference API parity: state_dict passthrough (optim.py:48-55)
+    # reference API parity: state_dict passthrough (optim.py:48-55).
+    # With error feedback the residuals are part of the training state
+    # (dropping them would both lose the accumulated error AND hand the
+    # jitted step a pytree that no longer matches its in_specs) — they
+    # ride along under an explicit envelope; plain states keep the
+    # legacy bare-inner form so old checkpoints restore unchanged.
     def state_dict(self, state: ZeroState) -> Any:
-        return state.inner
+        ef = getattr(state, "ef", None)
+        if ef is None:
+            return state.inner
+        return {"inner": state.inner, "ef": ef}
 
     def load_state_dict(self, inner_state: Any) -> ZeroState:
+        if isinstance(inner_state, dict) and set(inner_state) == {"inner", "ef"}:
+            return ZeroState(inner_state["inner"], inner_state["ef"])
         return ZeroState(inner_state)
 
 
@@ -136,6 +239,44 @@ def zero_param_spec(param_spec, param_ndim: int, axis_name: str = "data"):
     rest = tuple(param_spec[1:]) if len(param_spec) > 1 else ()
     rest = rest + (None,) * (param_ndim - 1 - len(rest))
     return P(new0, *rest)
+
+
+def ef_param_spec(param_spec, param_ndim: int, axis_name: str = "data"):
+    """Spec of an error-feedback residual leaf: local shape is
+    ``(1, *padded_local_grad_shape)`` and every data rank holds its OWN
+    residual, so the leading dim is sharded over the data axis and the
+    remaining dims follow the param's spec (the padding never changes a
+    dim's sharding)."""
+    from jax.sharding import PartitionSpec as P
+
+    if param_ndim == 0:
+        return P(axis_name, None)
+    rest = tuple(param_spec[:param_ndim])
+    rest = rest + (None,) * (param_ndim - len(rest))
+    for entry in rest:
+        entries = entry if isinstance(entry, (tuple, list)) else (entry,)
+        if axis_name in entries:
+            raise ValueError(
+                f"error feedback needs params unsharded over the "
+                f"{axis_name!r} axis, got spec {param_spec}"
+            )
+    return P(axis_name, *rest)
+
+
+def ef_state_specs(params, param_specs, axis_name: str = "data"):
+    """PartitionSpec pytree for ``ZeroState.ef`` (None-free params
+    tree -> per-leaf ``ef_param_spec``)."""
+    from jax.sharding import PartitionSpec as P
+
+    spec_leaves = jax.tree_util.tree_leaves(
+        param_specs, is_leaf=lambda x: isinstance(x, P)
+    )
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    mapped = [
+        ef_param_spec(s, getattr(p, "ndim", 0), axis_name)
+        for s, p in zip(spec_leaves, leaves)
+    ]
+    return jax.tree_util.tree_unflatten(treedef, mapped)
 
 
 def state_specs(state_tree, params, param_specs, axis_name: str = "data",
@@ -164,6 +305,8 @@ def state_specs(state_tree, params, param_specs, axis_name: str = "data",
             return False
 
     def rec(node):
+        if node is None:  # empty subtree (e.g. ZeroState.ef off)
+            return None
         if is_params_like(node):
             leaves, treedef = jax.tree_util.tree_flatten(node)
             mapped = [fn(s, nd) for s, nd in zip(spec_leaves, ndim_leaves)]

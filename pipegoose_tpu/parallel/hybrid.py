@@ -22,6 +22,7 @@ from pipegoose_tpu.distributed.parallel_context import ParallelContext
 from pipegoose_tpu.optim.zero import (
     DistributedOptimizer,
     ZeroState,
+    ef_state_specs,
     shard_shapes,
     state_specs,
 )
@@ -81,7 +82,10 @@ def zero_state_spec(
     dp = optimizer.axis_name and mesh.shape.get(optimizer.axis_name, 1) or 1
     shapes = jax.eval_shape(optimizer.inner.init, shard_shapes(params, dp))
     inner_spec = state_specs(shapes, params, param_specs, optimizer.axis_name or "data")
-    return ZeroState(inner_spec)
+    ef_spec = None
+    if getattr(optimizer, "error_feedback", False) and optimizer.axis_name:
+        ef_spec = ef_state_specs(params, param_specs, optimizer.axis_name)
+    return ZeroState(inner_spec, ef_spec)
 
 
 def train_step_intended_specs(
@@ -106,6 +110,41 @@ def train_step_intended_specs(
     return specs + ((P(),) if with_rng else ())
 
 
+def _set_comm_gauges(params, mesh, optimizer, comm_mode: str,
+                     overlap_tp: bool, dp_axis: str) -> None:
+    """Export the communication-engine config/savings next to the MFU
+    gauges: ``comm.overlap_enabled`` (0/1) and, for a compressed
+    gradient reduction, the analytic per-step ``comm.bytes_saved``
+    (distributed/compressed.py). One registry branch when telemetry is
+    disabled — the library-instrumentation contract."""
+    from pipegoose_tpu.telemetry.registry import get_registry
+
+    reg = get_registry()
+    if not reg.enabled:
+        return
+    reg.gauge(
+        "comm.overlap_enabled",
+        help="1 when the TP ring collective-matmul overlap path is on",
+    ).set(1.0 if overlap_tp else 0.0)
+    ax = getattr(optimizer, "axis_name", None) or dp_axis
+    n = mesh.shape.get(ax, 1)
+    # always write all three (last-build-wins): an fp32 build after a
+    # quantized one must not leave stale savings on the exporters
+    saved = 0.0
+    if comm_mode != "fp32" and n > 1:
+        from pipegoose_tpu.distributed.compressed import grad_comm_bytes_saved
+
+        saved = float(grad_comm_bytes_saved(params, n, comm_mode))
+    reg.gauge(
+        "comm.bytes_saved",
+        help="analytic per-step gradient-reduction wire bytes saved "
+             "vs fp32 by grad_comm compression",
+    ).set(saved)
+    reg.gauge("comm.grad_wire_bits").set(
+        {"fp32": 32.0, "bf16": 16.0, "int8": 8.0}[comm_mode]
+    )
+
+
 def make_hybrid_train_step(
     loss_fn: Callable[[Any, Any], jax.Array],
     param_specs: Any,
@@ -117,6 +156,8 @@ def make_hybrid_train_step(
     with_rng: bool = False,
     n_accum: int = 1,
     with_health: bool = False,
+    grad_comm: Optional[str] = None,
+    overlap_tp: bool = False,
 ):
     """Build (init_fn, step_fn), both jitted over the context's mesh.
 
@@ -151,11 +192,39 @@ def make_hybrid_train_step(
     byte-identical program (zero recompiles, zero per-step cost —
     pinned by tests/telemetry/test_health.py); on, it costs one grad
     all-reduce tree plus two scalar-vector collectives.
+
+    ``grad_comm``: wire precision of the DP/ZeRO gradient reduction —
+    "fp32" | "bf16" | "int8" (distributed/compressed.py). None (the
+    default) inherits the optimizer's own setting. With a ZeRO
+    ``axis_name`` the compressed reduce-scatter replaces the fp32
+    ``psum_scatter`` inside the optimizer; with ``axis_name=None``
+    (plain unsharded optimizer, i.e. plain DP) a compressed mean
+    all-reduce runs on the grads before the optimizer step, over every
+    loss axis, for params not sharded over that axis (the compressed
+    analog of ``grad_sync_axes=((ax, "mean"), ...)`` — combining both
+    for the same axis raises). Docs: docs/comm.md.
+
+    ``overlap_tp``: declare that ``loss_fn`` runs the ring
+    collective-matmul path (``config.overlap_tp`` on the model) — the
+    flag only drives telemetry (``comm.overlap_enabled``) and the
+    doctor's expectations; the overlap path's gradients are exact by
+    construction, so no grad-sync change is needed.
     """
     ctx = parallel_context or ParallelContext.get_context()
     if ctx is None:
         raise ValueError("no ParallelContext; construct one first")
     mesh = ctx.mesh
+
+    from pipegoose_tpu.distributed.compressed import check_grad_comm
+
+    if grad_comm is not None and grad_comm != getattr(
+        optimizer, "grad_comm", "fp32"
+    ):
+        optimizer = optimizer.replace(grad_comm=check_grad_comm(grad_comm))
+    comm_mode = check_grad_comm(getattr(optimizer, "grad_comm", "fp32"))
+    # plain-DP path: no ZeRO axis to fold the compression into — the
+    # compressed mean all-reduce runs on the whole grad tree instead
+    plain_dp_comm = comm_mode != "fp32" and optimizer.axis_name is None
 
     if n_accum > 1:
         from pipegoose_tpu.core.accumulation import make_accumulating_loss
@@ -177,6 +246,18 @@ def make_hybrid_train_step(
         return jax.jit(f)(params)
 
     loss_axes = loss_axis if isinstance(loss_axis, tuple) else (loss_axis,)
+    if plain_dp_comm:
+        # the compressed path below already mean-syncs over every loss
+        # axis (for params not sharded over it) — a ("data", "mean")
+        # grad_sync entry on top would average twice
+        for entry in grad_sync_axes:
+            ax, op = entry if isinstance(entry, tuple) else (entry, "sum")
+            if ax in loss_axes and op == "mean":
+                raise ValueError(
+                    f"grad_comm={comm_mode!r} with an unsharded optimizer "
+                    f"already mean-syncs grads over {loss_axes}; drop "
+                    f"({ax!r}, 'mean') from grad_sync_axes"
+                )
     if with_health:
         from pipegoose_tpu.telemetry.health import health_stats
 
@@ -190,6 +271,25 @@ def make_hybrid_train_step(
         loss, grads = jax.value_and_grad(loss_fn)(params, batch, *rng)
         if grad_sync_axes:
             grads = sync_replicated_grads(grads, param_specs, grad_sync_axes)
+        if plain_dp_comm:
+            from pipegoose_tpu.distributed.compressed import (
+                compressed_all_reduce_mean,
+            )
+
+            # the compressed analog of sync_replicated_grads with
+            # (axis, "mean") for every loss axis: params SHARDED over
+            # an axis hold genuinely different grads there (e.g.
+            # expert weights on an expert axis) and must not be mixed
+            def comp_sync(g, spec):
+                for ax in loss_axes:
+                    if not _spec_mentions(spec, ax):
+                        g = compressed_all_reduce_mean(g, ax, comm_mode)[0]
+                return g
+
+            grads = jax.tree_util.tree_map(
+                comp_sync, grads, param_specs,
+                is_leaf=lambda x: isinstance(x, P),
+            )
         new_params, new_state = optimizer.step(grads, opt_state, params)
         for ax in loss_axes:
             loss = lax.pmean(loss, ax)
@@ -202,6 +302,8 @@ def make_hybrid_train_step(
         return new_params, new_state, loss, health
 
     def make_step(params):
+        _set_comm_gauges(params, mesh, optimizer, comm_mode, overlap_tp,
+                         loss_axes[0])
         spec = _state_spec_for(params)
         in_specs = (param_specs, spec, batch_spec) + ((P(),) if with_rng else ())
         # the health tree is all replicated scalars: one P() prefix spec

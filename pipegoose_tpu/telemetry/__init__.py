@@ -58,7 +58,9 @@ from pipegoose_tpu.telemetry.doctor import (
     assert_matches_intended,
     assert_no_resharding,
     diagnose,
+    estimated_wire_bytes,
     set_doctor_gauges,
+    wire_bytes_by_op,
 )
 from pipegoose_tpu.telemetry.exporters import (
     JSONLExporter,
@@ -112,6 +114,8 @@ __all__ = [
     "pipeline_trace_events",
     "register_pipeline_gauges",
     "set_doctor_gauges",
+    "estimated_wire_bytes",
+    "wire_bytes_by_op",
     "span",
     "span_events_to_trace",
     "step_flops",

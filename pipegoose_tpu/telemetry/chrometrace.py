@@ -93,7 +93,12 @@ def pipeline_trace_events(
     at clock ``m + p`` on stage p's row, backwards follow as ``B{m}``
     after the forward clocks — the microbatch/clock diagram torchgpipe
     §3.2.1 draws, loadable next to the measured spans. ``clock_s`` is
-    the nominal seconds per clock (pure visualization scale)."""
+    the nominal seconds per clock (pure visualization scale).
+
+    A ``OneFOneBScheduler`` renders from its ACTUAL interleaved
+    timetable (``tables()``): F and B slices of different microbatches
+    share the steady-state clocks instead of the GPipe two-phase
+    layout."""
     events: List[dict] = [
         {
             "name": "process_name", "ph": "M", "pid": pid,
@@ -105,6 +110,30 @@ def pipeline_trace_events(
             "name": "thread_name", "ph": "M", "pid": pid, "tid": p,
             "args": {"name": f"stage {p}"},
         })
+
+    def emit_slice(label, m, p, clock):
+        events.append({
+            "name": f"{label}{m}",
+            "cat": f"pipeline.{'forward' if label == 'F' else 'backward'}",
+            "ph": "X",
+            "ts": (t0_s + clock * clock_s) * 1e6,
+            "dur": clock_s * 1e6,
+            "pid": pid,
+            "tid": p,
+            "args": {"microbatch": m, "stage": p, "clock": clock},
+        })
+
+    from pipegoose_tpu.nn.pipeline_parallel.scheduler import OneFOneBScheduler
+
+    if isinstance(scheduler, OneFOneBScheduler):
+        fwd, bwd, _, n_clock = scheduler.tables()
+        for c in range(n_clock):
+            for p in range(scheduler.n_partitions):
+                if fwd[c][p] >= 0:
+                    emit_slice("F", int(fwd[c][p]), p, c)
+                if include_backward and bwd[c][p] >= 0:
+                    emit_slice("B", int(bwd[c][p]), p, c)
+        return events
 
     def emit(tasks_by_clock, label, clock_offset):
         for c, tasks in enumerate(tasks_by_clock):
@@ -139,15 +168,17 @@ def register_pipeline_gauges(
     step_seconds: Optional[float] = None,
 ) -> float:
     """Set ``pipeline.bubble_fraction`` (theoretical idle share of the
-    clock timeline, ``(P-1)/(M+P-1)``) alongside the PR-2 ``train.mfu``
-    gauge; with a measured step time (e.g. the
+    scheduler's own clock timeline — ``(P-1)/(M+P-1)`` for GPipe, the
+    measured-timetable share for ``OneFOneBScheduler``) alongside the
+    PR-2 ``train.mfu`` gauge; with a measured step time (e.g. the
     ``span.train.step.seconds`` p50) also ``pipeline.bubble_seconds`` —
     the wall-clock that fraction costs per step. Returns the fraction."""
     reg = registry if registry is not None else get_registry()
     frac = scheduler.bubble_fraction
     reg.gauge(
         "pipeline.bubble_fraction",
-        help="theoretical pipeline idle fraction (P-1)/(M+P-1)",
+        help="theoretical pipeline idle fraction of the scheduler's "
+             "clock timetable",
     ).set(frac)
     reg.gauge("pipeline.n_microbatches").set(float(scheduler.n_microbatches))
     reg.gauge("pipeline.n_partitions").set(float(scheduler.n_partitions))

@@ -737,6 +737,66 @@ def diagnose(
     return DoctorReport(sharding=sharding_report, memory=memory_report)
 
 
+# -- wire-byte estimation --------------------------------------------------
+
+
+def estimated_wire_bytes(
+    collective: CollectiveInfo, mesh_axes: Dict[str, int]
+) -> int:
+    """Per-device TRANSMITTED bytes of one collective, normalized across
+    the ops' differing payload conventions (``CollectiveInfo.bytes`` is
+    the instruction's OUTPUT bytes: a reduce-scatter reports its shard
+    while an all-to-all reports the full array, so raw payloads cannot
+    be compared across op kinds). Ring-algorithm estimates over the
+    group size ``g`` spanned by the collective's mesh axes:
+
+    - ``all-gather``: output is the full array; each device sends its
+      shard ``g-1`` times interleaved -> ``(g-1)/g x bytes``.
+    - ``reduce-scatter``: output is the shard; each device forwards a
+      shard per hop for ``g-1`` hops -> ``(g-1) x bytes``.
+    - ``all-reduce``: reduce-scatter + all-gather ->
+      ``2(g-1)/g x bytes`` of the full-array output.
+    - ``all-to-all``: each device keeps 1/g of the (full-array) output
+      -> ``(g-1)/g x bytes``.
+    - ``collective-permute``: one hop, ``bytes``.
+
+    The comm-engine tests use this to compare the fp32 reduce-scatter
+    gradient phase against its quantized all-to-all replacement on
+    equal footing (docs/comm.md)."""
+    g = 1
+    for ax in collective.mesh_axes or ():
+        g *= int(mesh_axes.get(ax, 1))
+    if g <= 1:
+        return 0
+    b = collective.bytes
+    op = collective.op
+    if op == "all-gather":
+        return b * (g - 1) // g
+    if op == "reduce-scatter":
+        return b * (g - 1)
+    if op == "all-reduce":
+        return 2 * b * (g - 1) // g
+    if op == "all-to-all":
+        return b * (g - 1) // g
+    return b  # collective-permute and friends: one hop of the payload
+
+
+def wire_bytes_by_op(
+    report: Any, axes: Optional[Tuple[str, ...]] = None
+) -> Dict[str, int]:
+    """{op -> estimated per-device wire bytes} over a report's
+    collective schedule, optionally restricted to collectives spanning
+    exactly ``axes`` — e.g. ``wire_bytes_by_op(rep, ("data",))`` is the
+    gradient/optimizer traffic of a hybrid step."""
+    sr = _sharding_of(report)
+    out: Dict[str, int] = {}
+    for c in sr.collectives:
+        if axes is not None and c.mesh_axes != tuple(axes):
+            continue
+        out[c.op] = out.get(c.op, 0) + estimated_wire_bytes(c, sr.mesh_axes)
+    return out
+
+
 # -- telemetry gauges ------------------------------------------------------
 
 

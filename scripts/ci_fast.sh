@@ -32,6 +32,15 @@ echo "== sharding-regression guard (mesh doctor) =="
 python scripts/mesh_doctor.py --fake-devices 8 --tp 2 --dp 4 \
     --check --serving --quiet
 
+# The comm-engine variant of the same gate: the ring-overlap train step
+# must compile with ppermute collectives in place of the monolithic
+# layer gather AND still zero partitioner-inserted resharding
+# (docs/comm.md) — a regression that silently falls back to the
+# monolithic path fails here, not in a TPU bench.
+echo "== sharding-regression guard (mesh doctor, overlap variant) =="
+python scripts/mesh_doctor.py --fake-devices 8 --tp 2 --dp 4 \
+    --overlap --grad-comm int8 --check --expect-ppermute --quiet
+
 echo "== fast tier =="
 python -m pytest tests/ -q -m fast -p no:cacheprovider \
     --continue-on-collection-errors "$@"

@@ -42,12 +42,16 @@ def build_train_report(args, ctx, cfg, params, bloom):
     from pipegoose_tpu.telemetry import doctor
 
     specs = bloom.tp_specs(params)
-    opt = DistributedOptimizer(optax.adam(1e-3), axis_name="data")
+    opt = DistributedOptimizer(
+        optax.adam(1e-3), axis_name="data", grad_comm=args.grad_comm
+    )
 
     def loss_fn(p, ids):
         return bloom.loss_fn(p, ids, None, ids, cfg, tp_axis="tensor")
 
-    init_fn, make_step = make_hybrid_train_step(loss_fn, specs, opt, ctx)
+    init_fn, make_step = make_hybrid_train_step(
+        loss_fn, specs, opt, ctx, overlap_tp=args.overlap
+    )
     opt_sds = jax.eval_shape(init_fn, params)  # shapes only, no init run
     step = make_step(params)
     batch = jax.ShapeDtypeStruct((args.batch, args.seq), jnp.int32)
@@ -105,6 +109,17 @@ def main() -> int:
                          "that pins an accelerator platform)")
     ap.add_argument("--serving", action="store_true",
                     help="also doctor the paged decode step")
+    ap.add_argument("--overlap", action="store_true",
+                    help="build the ring collective-matmul train step "
+                         "(config.overlap_tp — docs/comm.md)")
+    ap.add_argument("--grad-comm", default="fp32",
+                    choices=("fp32", "bf16", "int8"),
+                    help="gradient-reduction wire precision for the "
+                         "train step (distributed/compressed.py)")
+    ap.add_argument("--expect-ppermute", action="store_true",
+                    help="guard: fail (exit 2) unless the train step's "
+                         "compiled schedule contains ppermute ring "
+                         "collectives (the overlap gate in ci_fast.sh)")
     ap.add_argument("--check", action="store_true",
                     help="run the regression guards; exit 2 on violation")
     ap.add_argument("--allow", action="append", default=[],
@@ -137,6 +152,7 @@ def main() -> int:
     cfg = bloom.BloomConfig(
         vocab_size=args.vocab, hidden_size=args.hidden,
         n_layer=args.layers, n_head=args.heads,
+        overlap_tp=args.overlap,
     )
     params = bloom.init_params(cfg, jax.random.PRNGKey(0))
     ctx = ParallelContext(tensor_parallel_size=args.tp,
@@ -157,6 +173,20 @@ def main() -> int:
             blobs[name] = report.to_json()
             if args.check:
                 rc = max(rc, run_guards(name, report, args))
+            if args.expect_ppermute and name == "train_step":
+                perms = [
+                    c for c in report.sharding.collectives
+                    if c.op == "collective-permute"
+                    and c.source == "ppermute"
+                ]
+                if not perms:
+                    print(
+                        f"\n[{name}] GUARD VIOLATION (expect-ppermute): "
+                        "no ppermute ring collectives in the compiled "
+                        "schedule — the overlap path did not engage",
+                        file=sys.stderr,
+                    )
+                    rc = 2
     finally:
         ctx.destroy()
     if args.json:

@@ -4,7 +4,7 @@ config levers (remat, flash on/off) for the bloom-560m bench shape.
 Timing recipe per bench.py: loop inside jit (lax.scan), scalar fetch,
 RTT subtracted. One attach per run (tunnel is single-client).
 
-    python scripts/sweep_tpu_perf.py [kernel|model]
+    python scripts/sweep_tpu_perf.py [kernel|model|fusedce|serving|comm]
 """
 from __future__ import annotations
 
@@ -227,6 +227,94 @@ def fusedce_sweep():
     print(json.dumps(results))
 
 
+def comm_sweep():
+    """Communication-engine A/B on the attached device mesh: the ring
+    collective-matmul overlap vs the monolithic TP path, and the
+    int8/bf16-quantized gradient reduction vs fp32, at the bloom-560m
+    bench shape (docs/comm.md). Needs >= 2 devices — a single chip
+    prints a skip record (the CPU smoke coverage lives in bench.py and
+    tests/test_comm_hybrid.py)."""
+    import optax
+
+    from pipegoose_tpu.distributed import ParallelContext
+    from pipegoose_tpu.models import bloom
+    from pipegoose_tpu.optim.zero import DistributedOptimizer
+    from pipegoose_tpu.parallel import make_hybrid_train_step
+
+    ndev = len(jax.devices())
+    if ndev < 2:
+        print(json.dumps({"skipped": f"comm sweep needs >= 2 devices, "
+                                     f"have {ndev}"}))
+        return
+    batch, seq, steps = 8, 1024, 8
+    tp = 2 if ndev % 2 == 0 else 1
+    variants = {
+        "flash": dict(overlap=False, grad_comm="fp32"),
+        "flash+overlap": dict(overlap=True, grad_comm="fp32"),
+        "flash+int8ar": dict(overlap=False, grad_comm="int8"),
+        "flash+bf16ar": dict(overlap=False, grad_comm="bf16"),
+        "flash+overlap+int8ar": dict(overlap=True, grad_comm="int8"),
+    }
+    results = {}
+    for name, kw in variants.items():
+        b = batch
+        while True:
+            try:
+                cfg = bloom.BloomConfig.bloom_560m(
+                    dtype=jnp.bfloat16, remat=True, use_flash=True,
+                    overlap_tp=kw["overlap"],
+                )
+                params = bloom.init_params(cfg, jax.random.PRNGKey(0))
+                params, cfg = bloom.pad_for_tp(params, cfg, tp)
+                ctx = ParallelContext(
+                    tensor_parallel_size=tp, data_parallel_size=ndev // tp
+                )
+                try:
+                    specs = bloom.tp_specs(params)
+                    opt = DistributedOptimizer(
+                        optax.adam(1e-4), axis_name="data",
+                        grad_comm=kw["grad_comm"],
+                    )
+
+                    def loss_fn(p, ids, cfg=cfg):
+                        return bloom.loss_fn(
+                            p, ids, None, ids, cfg, tp_axis="tensor"
+                        )
+
+                    init_fn, make_step = make_hybrid_train_step(
+                        loss_fn, specs, opt, ctx, overlap_tp=kw["overlap"]
+                    )
+                    opt_state = init_fn(params)
+                    step = make_step(params)
+                    ids = jnp.asarray(np.random.RandomState(0).randint(
+                        0, cfg.valid_vocab_size or cfg.vocab_size, (b, seq)
+                    ))
+                    p = params
+                    p, opt_state, loss = step(p, opt_state, ids)
+                    float(loss)  # compile + warm
+                    rtt = measure_rtt()
+                    t0 = time.perf_counter()
+                    for _ in range(steps):
+                        p, opt_state, loss = step(p, opt_state, ids)
+                    float(loss)
+                    dt = max(time.perf_counter() - t0 - rtt, 1e-9)
+                finally:
+                    ctx.destroy()
+                results[name] = {
+                    "tokens_per_sec": round(b * seq * steps / dt, 1),
+                    "batch": b, "mesh": f"tp{tp}xdp{ndev // tp}",
+                }
+                break
+            except Exception as e:  # noqa: BLE001
+                if "RESOURCE_EXHAUSTED" in str(e) and b > 1:
+                    b //= 2
+                    continue
+                results[name] = {"error": f"{type(e).__name__}: {e}"[:300]}
+                break
+        print(name, json.dumps(results[name]), flush=True)
+    print(json.dumps(results))
+
+
 def serving_sweep():
     """Continuous-batching vs naive padded serving (serving/engine.py)
     across slot counts on the real chip: the decode-step savings grow
@@ -275,7 +363,8 @@ if __name__ == "__main__":
 
     mode = sys.argv[1] if len(sys.argv) > 1 else "kernel"
     modes = {"kernel": kernel_sweep, "model": model_sweep,
-             "fusedce": fusedce_sweep, "serving": serving_sweep}
+             "fusedce": fusedce_sweep, "serving": serving_sweep,
+             "comm": comm_sweep}
     if mode not in modes:
         raise SystemExit(f"unknown mode {mode!r}; pick one of {sorted(modes)}")
     # telemetry JSONL artifact (the serving sweep's engines emit their

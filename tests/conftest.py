@@ -120,6 +120,11 @@ FAST_TESTS = {
     # telemetry: engine instrumentation vs legacy dict + compiled comms
     "tests/serving/test_engine.py::test_engine_telemetry_agrees_with_legacy_metrics",
     "tests/telemetry/test_derived.py::test_compiled_step_stats_reports_flops_and_comms",
+    # comm engine: overlap layer parity + int8 round-trip + the
+    # compiled ppermute/zero-resharding pin (ISSUE 5)
+    "tests/nn/tensor_parallel/test_overlap.py::test_column_row_overlap_forward_and_backward_parity[2]",
+    "tests/distributed/test_compressed.py::test_int8_quantize_dequantize_round_trip",
+    "tests/test_comm_hybrid.py::test_overlap_doctor_shows_ppermute_and_zero_resharding",
     # mesh doctor: pure-parsing nodes + the hybrid sharding-plan pin
     "tests/telemetry/test_doctor.py::test_norm_spec_and_spec_str",
     "tests/telemetry/test_doctor.py::test_parse_groups_explicit",
@@ -222,6 +227,18 @@ SLOW_TESTS = {
     "tests/optim/test_diloco_4d.py::test_mixtral_diloco_tp_ep",
     "tests/optim/test_diloco_4d.py::test_sync_step_matches_manual_outer_update",
     "tests/test_4d_parallel.py::test_pp_m4_aux_matches_microbatched_dense_reference",
+    # comm engine: the multi-step quantized full runs keep the 5-step
+    # sibling (test_int8_grad_comm_short_run_tracks_fp32) in tier-1,
+    # and the heavier non-pinned nodes keep tier-1 siblings — the
+    # acceptance pins (layer parity [2]+[4], doctor ppermute pin, int8
+    # short-run + byte accounting) all stay in tier-1
+    "tests/test_comm_hybrid.py::test_quantized_full_run_loss_parity[int8]",
+    "tests/test_comm_hybrid.py::test_quantized_full_run_loss_parity[bf16]",
+    "tests/test_comm_hybrid.py::test_plain_dp_grad_comm_matches_zero_path",
+    "tests/nn/tensor_parallel/test_overlap.py::test_ring_all_gather_matmul_matches_dense[4]",
+    "tests/nn/tensor_parallel/test_overlap.py::test_ring_matmul_reduce_scatter_matches_psum[4]",
+    "tests/distributed/test_compressed.py::test_compressed_all_reduce_mean_shapes_and_values",
+    "tests/test_examples.py::test_example_runs[comm_overlap_demo.py]",
 }
 
 

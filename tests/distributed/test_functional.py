@@ -105,6 +105,131 @@ def test_noop_axis(ctx):
     np.testing.assert_allclose(F.reduce_scatter(x, None), x)
 
 
+# -- wrappers vs raw jax.lax on random values (ISSUE 5 satellite) ----------
+#
+# The ZeRO fp32 path and the f/g operators build on these wrappers (the
+# compressed collectives and the overlap rings use the same lax
+# primitives directly); pin each wrapper against the raw jax.lax
+# primitive it claims to be, on random values, over both mesh axes.
+
+def _rand(seed, shape):
+    return jax.random.normal(jax.random.PRNGKey(seed), shape, jnp.float32)
+
+
+@pytest.mark.parametrize("axis", ["tensor", "data"])
+@pytest.mark.parametrize("dim", [0, -1])
+def test_reduce_scatter_matches_raw_psum_scatter(ctx, axis, dim):
+    """The formerly-reference-stubbed reduce_scatter == raw
+    lax.psum_scatter (tiled) on random values, dims 0 and -1, both
+    axes."""
+    x = _rand(0, (8, 8))
+
+    def wrapped(v):
+        return F.reduce_scatter(v, axis, dim=dim)
+
+    def raw(v):
+        return jax.lax.psum_scatter(
+            v, axis, scatter_dimension=dim % v.ndim, tiled=True
+        )
+
+    out_spec = P(axis) if dim == 0 else P(None, axis)
+    a = _smap(ctx, wrapped, P(), out_spec)(x)
+    b = _smap(ctx, raw, P(), out_spec)(x)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+    # and the values are the actual cross-rank sum: replicated input ->
+    # every chunk is axis_size * x's chunk
+    n = dict(ctx.mesh.shape)[axis]
+    np.testing.assert_allclose(np.asarray(a), n * np.asarray(x))
+
+
+@pytest.mark.parametrize("axis", ["tensor", "data"])
+def test_all_gather_matches_raw(ctx, axis):
+    x = _rand(1, (8, 4))
+    a = _smap(
+        ctx, lambda v: F.all_gather(v, axis, dim=0), P(axis), P(axis)
+    )(x)
+    b = _smap(
+        ctx,
+        lambda v: jax.lax.all_gather(v, axis, axis=0, tiled=True),
+        P(axis), P(axis),
+    )(x)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+
+
+def test_all_reduce_mean_min_match_raw(ctx):
+    x = _rand(2, (4, 3))
+    for op, raw in (("mean", jax.lax.pmean), ("min", jax.lax.pmin)):
+        a = _smap(
+            ctx, lambda v: F.all_reduce(v, "tensor", op=op), P("tensor"),
+            P("tensor"),
+        )(x)
+        b = _smap(
+            ctx, lambda v: raw(v, "tensor"), P("tensor"), P("tensor")
+        )(x)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), err_msg=op)
+
+
+def test_all_to_all_matches_raw(ctx):
+    x = _rand(3, (4, 8))
+    a = _smap(
+        ctx,
+        lambda v: F.all_to_all(v, "tensor", split_dim=1, concat_dim=0),
+        P("tensor", None), P(None, "tensor"),
+    )(x)
+    b = _smap(
+        ctx,
+        lambda v: jax.lax.all_to_all(
+            v, "tensor", split_axis=1, concat_axis=0, tiled=True
+        ),
+        P("tensor", None), P(None, "tensor"),
+    )(x)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+
+
+def test_ppermute_and_shift_left_match_raw(ctx):
+    x = jnp.arange(4.0)
+    perm = [(i, (i + 2) % 4) for i in range(4)]
+    a = _smap(
+        ctx, lambda v: F.ppermute(v, "tensor", perm), P("tensor"), P("tensor")
+    )(x)
+    b = _smap(
+        ctx, lambda v: jax.lax.ppermute(v, "tensor", perm=perm),
+        P("tensor"), P("tensor"),
+    )(x)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+    np.testing.assert_allclose(np.asarray(a), [2, 3, 0, 1])
+    left = _smap(
+        ctx, lambda v: F.shift_left(v, "tensor"), P("tensor"), P("tensor")
+    )(x)
+    np.testing.assert_allclose(np.asarray(left), [1, 2, 3, 0])
+
+
+def test_broadcast_preserves_bool_dtype(ctx):
+    x = jnp.asarray([False, True, False, False])
+    out = _smap(
+        ctx, lambda v: F.broadcast(v, "tensor", src=1), P("tensor"),
+        P("tensor"),
+    )(x)
+    assert out.dtype == jnp.bool_
+    np.testing.assert_array_equal(np.asarray(out), [True] * 4)
+
+
+def test_scatter_indivisible_raises(ctx):
+    with pytest.raises(ValueError, match="not divisible"):
+        _smap(
+            ctx, lambda v: F.scatter(v, "tensor", dim=0), P(), P("tensor")
+        )(jnp.arange(6.0))
+
+
+def test_reduce_max_to_dst(ctx):
+    x = jnp.arange(4.0)
+    out = _smap(
+        ctx, lambda v: F.reduce(v, "tensor", dst=0, op="max"), P("tensor"),
+        P("tensor"),
+    )(x)
+    np.testing.assert_allclose(np.asarray(out), [3, 0, 0, 0])
+
+
 # -- Megatron f/g custom-vjp pairs (reference _functional.py tests) --------
 
 def test_copy_to_tensor_group_grad(ctx):

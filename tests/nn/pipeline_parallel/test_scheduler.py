@@ -40,6 +40,22 @@ def test_backward_is_reversed_forward():
         assert all(t.job_type == JobType.BACKWARD for t in bc)
 
 
+def test_1f1b_bubble_fraction_is_timetable_derived():
+    """The 1F1B bubble comes from its compiled timetable, not the
+    inherited GPipe formula — they agree exactly when the greedy
+    timetable achieves the PipeDream-flush bound 2(M+P-1)."""
+    for m, p in [(4, 4), (8, 2), (1, 4), (3, 5)]:
+        s = OneFOneBScheduler(m, p)
+        assert s.bubble_fraction == 1.0 - (2.0 * m) / s.n_clock
+        if s.n_clock == 2 * (m + p - 1):
+            assert abs(
+                s.bubble_fraction - GPipeScheduler(m, p).bubble_fraction
+            ) < 1e-12
+    # cached: the tables are built once
+    s = OneFOneBScheduler(4, 4)
+    assert s.tables() is s.tables()
+
+
 def test_1f1b_per_stage_stream():
     s = OneFOneBScheduler(n_microbatches=4, n_partitions=2)
     # last stage: no warmup, strict F,B,F,B,...

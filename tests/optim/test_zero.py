@@ -124,3 +124,36 @@ def test_axis_none_is_plain_optax():
     ref = jax.tree_util.tree_map(lambda p, g: p - 0.1 * g, params, grads)
     for key in params:
         np.testing.assert_allclose(np.asarray(new_params[key]), np.asarray(ref[key]), rtol=1e-6)
+
+
+def test_state_dict_round_trips_error_feedback():
+    """ZeroState.ef must survive state_dict/load_state_dict: dropping
+    the residuals would both lose the accumulated quantization error
+    and hand the jitted step a pytree that no longer matches its
+    in_specs. Plain states keep the legacy bare-inner form."""
+    opt = DistributedOptimizer(
+        optax.sgd(0.1), axis_name="data", grad_comm="int8",
+        error_feedback=True,
+    )
+    inner = {"momentum": jnp.ones((2, 3))}
+    ef = {"w": jnp.full((1, 4, 3), 0.5)}
+    state = ZeroState(inner, ef)
+    restored = opt.load_state_dict(opt.state_dict(state))
+    assert isinstance(restored, ZeroState)
+    np.testing.assert_array_equal(
+        np.asarray(restored.ef["w"]), np.asarray(ef["w"])
+    )
+    np.testing.assert_array_equal(
+        np.asarray(restored.inner["momentum"]), np.asarray(inner["momentum"])
+    )
+    # legacy (no-EF) form unchanged: bare inner in, ef=None out
+    plain = DistributedOptimizer(optax.sgd(0.1), axis_name="data")
+    st = ZeroState(inner)
+    assert plain.state_dict(st) is inner
+    assert plain.load_state_dict(inner).ef is None
+    # EF needs the sharded path — silently dropping it would be worse
+    with pytest.raises(ValueError, match="axis_name"):
+        DistributedOptimizer(
+            optax.sgd(0.1), axis_name=None, grad_comm="int8",
+            error_feedback=True,
+        )

@@ -96,6 +96,48 @@ def test_bubble_fraction_and_gauges():
     assert reg.gauge("pipeline.n_microbatches").value == 8.0
 
 
+def test_pipeline_trace_events_1f1b_interleaved_timetable():
+    """A OneFOneBScheduler renders from its ACTUAL timetable: every
+    (microbatch, stage) pair appears once per direction, at most one
+    slice per (stage, clock), the span covers exactly n_clock clocks,
+    and the steady state interleaves B between Fs (not the GPipe
+    two-phase layout)."""
+    M, P = 4, 2
+    sched = OneFOneBScheduler(M, P)
+    events = pipeline_trace_events(sched, clock_s=1e-3)
+    slices = [e for e in events if e["ph"] == "X"]
+    fwd = [e for e in slices if e["cat"] == "pipeline.forward"]
+    bwd = [e for e in slices if e["cat"] == "pipeline.backward"]
+    assert len(fwd) == M * P and len(bwd) == M * P
+    seen = set()
+    for e in slices:
+        key = (e["tid"], e["args"]["clock"])
+        assert key not in seen, f"two slices on one stage-clock: {key}"
+        seen.add(key)
+    assert max(e["args"]["clock"] for e in slices) == sched.n_clock - 1
+    # steady state on the last stage: some BACKWARD lands BEFORE the
+    # last forward clock — impossible in the GPipe two-phase rendering
+    last_fwd_clock = max(e["args"]["clock"] for e in fwd)
+    assert any(e["args"]["clock"] < last_fwd_clock for e in bwd)
+    _assert_valid_trace({"traceEvents": events, "displayTimeUnit": "ms"})
+
+
+def test_1f1b_bubble_fraction_from_timetable_and_gauges():
+    """OneFOneBScheduler.bubble_fraction comes from its own timetable
+    (1 - 2M/n_clock) and feeds register_pipeline_gauges like GPipe's."""
+    s = OneFOneBScheduler(4, 2)
+    assert s.bubble_fraction == pytest.approx(1.0 - 8.0 / s.n_clock)
+    # flush bound achieved here: matches the GPipe closed form
+    assert s.n_clock == 2 * (4 + 2 - 1)
+    reg = MetricsRegistry(enabled=True)
+    frac = register_pipeline_gauges(s, registry=reg, step_seconds=0.1)
+    assert frac == pytest.approx(s.bubble_fraction)
+    assert reg.gauge("pipeline.bubble_fraction").value == pytest.approx(frac)
+    assert reg.gauge("pipeline.bubble_seconds").value == (
+        pytest.approx(0.1 * frac)
+    )
+
+
 def test_exporter_collects_spans_and_writes_atomically(tmp_path):
     reg = MetricsRegistry(enabled=True)
     path = str(tmp_path / "trace.json")

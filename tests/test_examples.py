@@ -34,6 +34,8 @@ CASES = [
                                  "/tmp/pipegoose_flightrec_demo_test"]),
     ("mesh_doctor_demo.py", ["--fake-devices", "8", "--tp", "2",
                              "--dp", "4"]),
+    ("comm_overlap_demo.py", ["--fake-devices", "8", "--tp", "2",
+                              "--dp", "4"]),
 ]
 
 
