@@ -475,10 +475,18 @@ def run_bench(force_cpu: bool) -> None:
         would otherwise pay a JSONL write+flush per decode step that
         the padded arm doesn't, skewing the reported speedup — and the
         per-step time series is captured by ONE extra instrumented run
-        afterwards, outside the measurement."""
+        afterwards, outside the measurement.
+
+        The block also replays a Zipf-skewed shared-prefix workload
+        (ISSUE 6) through four engine arms — monolithic baseline,
+        chunked prefill, chunked + prefix cache, + self-speculative —
+        reporting tokens/s, TTFT p50/p99, the prefill-token (FLOP)
+        reduction at the measured hit rate, and the max decode-step gap
+        chunking bounds."""
         from pipegoose_tpu.serving import (
             Request,
             ServingEngine,
+            prefix_replay_benchmark,
             serving_ab_benchmark,
         )
 
@@ -488,6 +496,10 @@ def run_bench(force_cpu: bool) -> None:
                      (28, 25), (12, 8), (25, 45), (8, 22)]
             kw = dict(num_slots=4, num_pages=33, page_size=32,
                       max_context=128)
+            replay_kw = dict(n_requests=16, n_prefixes=3, prefix_len=96,
+                             suffix_lens=(8, 16, 24), max_new=16,
+                             num_slots=4, num_pages=65, page_size=32,
+                             max_context=256, prefill_chunk=64)
         else:
             scfg = bloom.BloomConfig(
                 vocab_size=512, hidden_size=128, n_layer=2, n_head=4,
@@ -496,11 +508,19 @@ def run_bench(force_cpu: bool) -> None:
             specs = [(6, 10), (3, 4), (7, 13), (2, 6)]
             kw = dict(num_slots=2, num_pages=13, page_size=8,
                       max_context=32)
+            replay_kw = dict(n_requests=10, n_prefixes=3, prefix_len=48,
+                             suffix_lens=(2, 4, 6), max_new=4,
+                             num_slots=2, num_pages=33, page_size=8,
+                             max_context=64, prefill_chunk=16)
         sparams = bloom.init_params(scfg, jax.random.PRNGKey(1))
         was_enabled = reg.enabled
         reg.disable()
         try:
             res = serving_ab_benchmark(sparams, scfg, specs, **kw)
+            res["prefix_replay"] = prefix_replay_benchmark(
+                sparams, scfg, seed=0, include_speculative=True,
+                **replay_kw,
+            )
         finally:
             if was_enabled:
                 reg.enable()

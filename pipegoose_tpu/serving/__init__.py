@@ -1,35 +1,49 @@
 """Continuous-batching inference serving over a paged KV-cache pool.
 
 The layer above the model stack that the per-call ``generate()`` /
-``generate_tp()`` paths cannot provide: request multiplexing. See
-docs/serving.md for the request lifecycle and page-table layout.
+``generate_tp()`` paths cannot provide: request multiplexing, plus the
+opt-in serving-perf modes — content-addressed copy-on-write prefix
+caching, chunked prefill, and self-speculative decoding. See
+docs/serving.md for the request lifecycle, page-table layout, and the
+prefix-cache / COW / eviction semantics.
 """
 from pipegoose_tpu.serving.engine import (
     RequestOutput,
     ServingEngine,
+    make_skewed_replay,
+    prefix_replay_benchmark,
     serving_ab_benchmark,
 )
 from pipegoose_tpu.serving.kv_pool import (
     NULL_PAGE,
     PagePool,
+    copy_page,
     gather_pages,
     init_pages,
     paged_decode_step,
+    paged_prefill_chunk,
     write_prompt_pages,
 )
+from pipegoose_tpu.serving.prefix_cache import PrefixCache, PrefixHit
 from pipegoose_tpu.serving.scheduler import Request, Scheduler, Status
 
 __all__ = [
     "NULL_PAGE",
     "PagePool",
+    "PrefixCache",
+    "PrefixHit",
     "Request",
     "RequestOutput",
     "Scheduler",
     "ServingEngine",
     "Status",
+    "copy_page",
     "gather_pages",
     "init_pages",
+    "make_skewed_replay",
     "paged_decode_step",
+    "paged_prefill_chunk",
+    "prefix_replay_benchmark",
     "serving_ab_benchmark",
     "write_prompt_pages",
 ]

@@ -14,7 +14,14 @@ up by an integer page table. Three pieces live here:
 - :class:`PagePool` — the HOST-side free-list allocator. Allocation is a
   LIFO stack pop, so placement is deterministic given the request/evict
   order (testable invariant); page 0 is reserved as the NULL page that
-  absorbs writes from padded slots and pad positions.
+  absorbs writes from padded slots and pad positions. Pages are
+  REFCOUNTED (alloc/share/release) so the prefix cache
+  (serving/prefix_cache.py) can point many requests at one physical
+  page; :func:`copy_page` is the copy-on-write escape hatch when a
+  shared page's tail must be written.
+- :func:`paged_prefill_chunk` — forward a C-token chunk per row through
+  the page tables (chunked prefill and self-speculative verification
+  share this one program shape).
 - :func:`paged_decode_step` — one decode step over the ragged active
   batch: each slot's pending token is scatter-written through its page
   table, attention reads the gathered page view, and invalid key
@@ -34,7 +41,7 @@ like models/_decode.py's sharded driver.
 from __future__ import annotations
 
 from collections import deque
-from typing import Deque, List, Tuple
+from typing import Deque, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -52,16 +59,29 @@ NULL_PAGE = 0
 
 
 class PagePool:
-    """Free-list allocator over ``num_pages`` fixed-size KV pages.
+    """Refcounted free-list allocator over ``num_pages`` fixed-size KV pages.
 
     Page 0 is the NULL page — never handed out; padded slots and the pad
     positions of a bucketed prefill scatter their garbage there. The
     free list is a LIFO stack, so the physical placement of any workload
     is a pure function of the submit/evict order (the determinism
-    invariant tests/serving/test_kv_pool.py pins down). ``history``
-    keeps the most recent (event, pages) pairs for those tests and for
-    debugging fragmentation — bounded so a long-lived engine never
-    accumulates host memory per request."""
+    invariant tests/serving/test_kv_pool.py pins down).
+
+    Pages carry a **refcount** so the prefix cache (serving/
+    prefix_cache.py) can share one physical page between many readers:
+    ``alloc`` hands out pages at refcount 1, ``share`` adds a reader,
+    ``release`` drops one — a page returns to the free list only when
+    its last reference is released. ``free`` is an alias for ``release``
+    (the pre-sharing API). A shared page is READ-ONLY for everyone but
+    its writer-by-construction: the scheduler guarantees write positions
+    never land in a page with refcount > 1 (copy-on-write duplicates the
+    page first).
+
+    ``history`` keeps the most recent (event, pages, refcount-delta)
+    triples for the determinism tests and for debugging fragmentation —
+    the delta makes sharing visible (a ``release`` that does NOT free is
+    a refcount decrement on a still-shared page). Bounded so a
+    long-lived engine never accumulates host memory per request."""
 
     def __init__(self, num_pages: int, page_size: int):
         if num_pages < 2:
@@ -71,8 +91,10 @@ class PagePool:
         self.num_pages = num_pages
         self.page_size = page_size
         self._free: List[int] = list(range(num_pages - 1, 0, -1))
-        self._owned: set = set()
-        self.history: Deque[Tuple[str, Tuple[int, ...]]] = deque(maxlen=1024)
+        self._ref: Dict[int, int] = {}   # page -> refcount (allocated only)
+        self.history: Deque[Tuple[str, Tuple[int, ...], int]] = deque(
+            maxlen=1024
+        )
 
     @property
     def free_count(self) -> int:
@@ -87,8 +109,31 @@ class PagePool:
         """Allocatable pages (the null page is not allocatable)."""
         return self.num_pages - 1
 
+    @property
+    def shared_count(self) -> int:
+        """Pages currently referenced more than once."""
+        return sum(1 for c in self._ref.values() if c > 1)
+
+    def refcount(self, page: int) -> int:
+        return self._ref.get(page, 0)
+
     def pages_for(self, n_tokens: int) -> int:
         return -(-n_tokens // self.page_size)
+
+    def fragmentation(self) -> float:
+        """1 - (largest contiguous free run / free pages): 0.0 when the
+        free space is one run (or empty). Page-table indirection makes
+        fragmentation harmless for correctness; the gauge exists because
+        a rising value under sharing means the LIFO stack is being
+        diced by mid-stream releases — a debugging signal, not a cost."""
+        if not self._free:
+            return 0.0
+        runs, best = 1, 1
+        ordered = sorted(self._free)
+        for a, b in zip(ordered, ordered[1:]):
+            runs = runs + 1 if b == a + 1 else 1
+            best = max(best, runs)
+        return 1.0 - best / len(self._free)
 
     def alloc(self, n: int) -> List[int]:
         if n > len(self._free):
@@ -98,20 +143,39 @@ class PagePool:
             )
         pages = [self._free.pop() for _ in range(n)]
         for p in pages:
-            if p == NULL_PAGE or p in self._owned:
+            if p == NULL_PAGE or p in self._ref:
                 raise RuntimeError(f"allocator invariant broken: page {p} "
                                    f"double-allocated or null")
-            self._owned.add(p)
-        self.history.append(("alloc", tuple(pages)))
+            self._ref[p] = 1
+        self.history.append(("alloc", tuple(pages), +1))
         return pages
 
-    def free(self, pages: List[int]) -> None:
+    def share(self, pages: List[int]) -> None:
+        """Add one reference to each (already allocated) page — the
+        prefix-cache hit path: a new reader of an existing page."""
         for p in pages:
-            if p not in self._owned:
+            if p not in self._ref:
+                raise RuntimeError(f"sharing page {p} that is not allocated")
+        for p in pages:
+            self._ref[p] += 1
+        self.history.append(("share", tuple(pages), +1))
+
+    def release(self, pages: List[int]) -> None:
+        """Drop one reference per page; pages reaching refcount 0 return
+        to the free list (LIFO — placement stays a pure function of the
+        event order even under sharing)."""
+        for p in pages:
+            if p not in self._ref:
                 raise RuntimeError(f"freeing page {p} that is not allocated")
-            self._owned.discard(p)
-            self._free.append(p)
-        self.history.append(("free", tuple(pages)))
+        for p in pages:
+            self._ref[p] -= 1
+            if self._ref[p] == 0:
+                del self._ref[p]
+                self._free.append(p)
+        self.history.append(("release", tuple(pages), -1))
+
+    # pre-sharing name: release IS free when nothing is shared
+    free = release
 
 
 def init_pages(config, num_pages: int, page_size: int, tp: int = 1):
@@ -157,6 +221,18 @@ def gather_pages(pages, page_table):
     return view.reshape(view.shape[:-4] + (w * ps,) + view.shape[-2:])
 
 
+def _local_slopes(config, tp_axis):
+    """This shard's ALiBi slope subset (all heads when unsharded)."""
+    tp = jax.lax.axis_size(tp_axis) if tp_axis else 1
+    nh = config.n_head // tp
+    slopes = jnp.asarray(alibi_slopes(config.n_head))
+    if tp_axis:
+        slopes = lax.dynamic_slice_in_dim(
+            slopes, jax.lax.axis_index(tp_axis) * nh, nh, 0
+        )
+    return slopes
+
+
 def _paged_bias(config, seq_lens, n_keys, tp_axis):
     """Additive attention bias for one paged decode step: ALiBi over the
     GLOBAL key position + a per-ROW keep mask ``key_pos <= seq_len``
@@ -165,13 +241,7 @@ def _paged_bias(config, seq_lens, n_keys, tp_axis):
     hold UNPADDED sequences, so plain global positions apply — the same
     bias _decode_bias builds for extras=None, generalized to a per-row
     ``start``. Returns (B, nh_local, 1, n_keys)."""
-    tp = jax.lax.axis_size(tp_axis) if tp_axis else 1
-    nh = config.n_head // tp
-    slopes = jnp.asarray(alibi_slopes(config.n_head))
-    if tp_axis:
-        slopes = lax.dynamic_slice_in_dim(
-            slopes, jax.lax.axis_index(tp_axis) * nh, nh, 0
-        )
+    slopes = _local_slopes(config, tp_axis)
     key_pos = jnp.arange(n_keys)
     keep = key_pos[None, :] <= seq_lens[:, None]  # (B, n_keys)
     bias = slopes[None, :, None, None] * key_pos[None, None, None, :].astype(jnp.float32)
@@ -179,7 +249,8 @@ def _paged_bias(config, seq_lens, n_keys, tp_axis):
 
 
 def paged_decode_step(params, tokens, k_pages, v_pages, page_table, seq_lens,
-                      config, tp_axis=None):
+                      config, tp_axis=None, write_ok=None,
+                      draft_layers: Optional[int] = None):
     """One decode step for every slot of the ragged active batch.
 
     ``tokens`` (B,) are the pending tokens (each slot's last emitted
@@ -190,6 +261,16 @@ def paged_decode_step(params, tokens, k_pages, v_pages, page_table, seq_lens,
     Padded slots must point every table entry at the NULL page (their
     writes and reads are garbage-in/garbage-out, masked by the bias and
     discarded by the scheduler).
+
+    ``write_ok`` (B,) bool routes a row's k/v write to the NULL page
+    when False — the self-speculative draft loop uses it to cap
+    per-slot draft depth inside one compiled program. ``draft_layers``
+    (static) runs only the FIRST k transformer blocks before the final
+    LN and lm head — the shallow-exit draft model that shares every
+    weight with the verifier; its k/v writes land in the pool's first k
+    layer planes (the verification pass later overwrites them with
+    byte-identical values, since layer i's k/v depend only on the token
+    sequence and layers < i).
 
     Returns (logits (B, V_local), k_pages, v_pages). Under ``tp_axis``
     the logits are the LOCAL vocab shard — pair with
@@ -207,6 +288,15 @@ def paged_decode_step(params, tokens, k_pages, v_pages, page_table, seq_lens,
     page_idx = seq_lens // ps
     off = seq_lens % ps
     phys = jnp.take_along_axis(page_table, page_idx[:, None], axis=1)[:, 0]
+    if write_ok is not None:
+        phys = jnp.where(write_ok, phys, NULL_PAGE)
+        off = jnp.where(write_ok, off, 0)
+
+    blocks = params["blocks"]
+    k_all, v_all = k_pages, v_pages
+    if draft_layers is not None:
+        blocks = jax.tree_util.tree_map(lambda a: a[:draft_layers], blocks)
+        k_pages, v_pages = k_pages[:draft_layers], v_pages[:draft_layers]
 
     def scan_fn(carry, blk_and_pages):
         h = carry
@@ -224,9 +314,96 @@ def paged_decode_step(params, tokens, k_pages, v_pages, page_table, seq_lens,
         h = h + row_parallel_linear(blk["mlp"]["down"], bloom_gelu(up), tp_axis)
         return h, (kp, vp)
 
+    x, (k_pages, v_pages) = lax.scan(scan_fn, x, (blocks, k_pages, v_pages))
+    if draft_layers is not None:
+        k_pages = k_all.at[:draft_layers].set(k_pages)
+        v_pages = v_all.at[:draft_layers].set(v_pages)
+    x = layer_norm(params["ln_f"], x, config.layer_norm_epsilon)
+    logits = logits_fn(params, x, tp_axis)[:, 0]  # (B, V_local)
+    return logits, k_pages, v_pages
+
+
+def copy_page(k_pages, v_pages, src, dst):
+    """Copy-on-write duplication: device-copy one physical page (every
+    layer's k and v planes) from ``src`` to ``dst``. The prefix cache
+    uses it when a request's unique tail begins MID-page of a shared
+    page — the new owner gets a private copy of the shared tokens' KV
+    and writes its tail there, while readers of ``src`` are untouched.
+    ``src``/``dst`` are runtime scalars: one compiled program covers
+    every copy."""
+    return (
+        k_pages.at[:, dst].set(jnp.take(k_pages, src, axis=1)),
+        v_pages.at[:, dst].set(jnp.take(v_pages, src, axis=1)),
+    )
+
+
+def paged_prefill_chunk(params, tokens, k_pages, v_pages, page_table, start,
+                        n_valid, config, tp_axis=None, all_logits=False):
+    """Forward one CHUNK of C tokens per row straight through the pool.
+
+    The prefill half of a chunked-prefill mixed step: ``tokens`` (B, C)
+    are each row's next prompt tokens, ``start`` (B,) the logical
+    position of the row's first chunk token (= tokens already cached,
+    whether written by earlier chunks or SHARED from the prefix cache),
+    ``n_valid`` (B,) how many of the C are real. Each valid token's k/v
+    is written through the row's page table; pad tails route writes to
+    the NULL page and get zero context. Attention is causal over the
+    global position — every cached position plus the chunk's own
+    earlier tokens — with the same ALiBi-over-global-position bias as
+    the decode step, so chunk boundaries are invisible in the math.
+
+    Returns (logits, k_pages, v_pages): logits at each row's LAST VALID
+    position, (B, V_local) — the next-token distribution chunked
+    prefill needs — or at EVERY chunk position, (B, C, V_local), with
+    ``all_logits=True`` (self-speculative verification scores the whole
+    draft bundle in one pass through this same paged path).
+    """
+    b, c = tokens.shape
+    ps = k_pages.shape[2]
+    n_keys = page_table.shape[1] * ps
+
+    x = vocab_parallel_embedding(params["embed"], tokens, tp_axis)
+    x = x.astype(config.dtype)
+    x = layer_norm(params["embed_ln"], x, config.layer_norm_epsilon)
+
+    pos = start[:, None] + jnp.arange(c)[None, :]             # (B, C)
+    valid = jnp.arange(c)[None, :] < n_valid[:, None]         # (B, C)
+    dest_page = jnp.where(
+        valid, jnp.take_along_axis(page_table, pos // ps, axis=1), NULL_PAGE
+    )
+    dest_off = jnp.where(valid, pos % ps, 0)
+
+    slopes = _local_slopes(config, tp_axis)
+    key_pos = jnp.arange(n_keys)
+    keep = key_pos[None, None, :] <= pos[:, :, None]          # (B, C, K)
+    bias = slopes[None, :, None, None] * key_pos[None, None, None, :].astype(
+        jnp.float32
+    )
+    bias = bias + jnp.where(keep[:, None, :, :], 0.0, NEG_INF)
+    qmask = valid
+
+    def scan_fn(carry, blk_and_pages):
+        h = carry
+        blk, kp, vp = blk_and_pages
+        ln1 = layer_norm(blk["ln_1"], h, config.layer_norm_epsilon)
+        q, k, v = _qkv_proj({"qkv": blk["attn"]["qkv"]}, ln1, config, tp_axis)
+        kp = kp.at[dest_page, dest_off].set(k.astype(kp.dtype))
+        vp = vp.at[dest_page, dest_off].set(v.astype(vp.dtype))
+        keys = gather_pages(kp, page_table)
+        vals = gather_pages(vp, page_table)
+        ctx = _attn_core(q, keys, vals, bias, qmask, h.dtype)
+        h = h + row_parallel_linear(blk["attn"]["out"], ctx, tp_axis)
+        ln2 = layer_norm(blk["ln_2"], h, config.layer_norm_epsilon)
+        up = column_parallel_linear(blk["mlp"]["up"], ln2, tp_axis)
+        h = h + row_parallel_linear(blk["mlp"]["down"], bloom_gelu(up), tp_axis)
+        return h, (kp, vp)
+
     x, (k_pages, v_pages) = lax.scan(
         scan_fn, x, (params["blocks"], k_pages, v_pages)
     )
     x = layer_norm(params["ln_f"], x, config.layer_norm_epsilon)
-    logits = logits_fn(params, x, tp_axis)[:, 0]  # (B, V_local)
+    if all_logits:
+        return logits_fn(params, x, tp_axis), k_pages, v_pages  # (B, C, V)
+    last = jnp.take_along_axis(x, (n_valid - 1)[:, None, None], axis=1)
+    logits = logits_fn(params, last, tp_axis)[:, 0]             # (B, V_local)
     return logits, k_pages, v_pages

@@ -1,0 +1,251 @@
+"""Content-addressed prefix cache over the paged KV pool.
+
+A fleet of requests sharing a system prompt / few-shot prefix
+re-computes and re-stores the same KV pages once per request through
+the plain engine — ROADMAP open item 1 names that the top serving
+bottleneck. This module is the HOST-side index that turns the refcounted
+:class:`~pipegoose_tpu.serving.kv_pool.PagePool` into a
+content-addressed store:
+
+- **Hash granularity = one page.** The trie is keyed by page-aligned
+  token BLOCKS (the exact ``page_size`` token ids that produced a page's
+  KV), chained parent→child, so a lookup walks the prompt page by page —
+  a radix tree over blocks, vLLM/SGLang-style. Keying on the full block
+  chain (not a rolling hash) makes false sharing impossible: equal chain
+  ⇒ equal token prefix ⇒ equal KV (the model is deterministic).
+- **Sharing = refcount.** A hit bumps each matched page's refcount
+  (``pool.share``); the cache itself holds one reference per cached
+  page, so pages survive their creator request. A request's release at
+  finish drops its reference — cached pages fall back to refcount 1
+  (cache-only) and become evictable, never dangling.
+- **COW for mid-page tails.** When the prompt diverges from (or ends
+  inside) a cached child block, the longest matching HEAD of that block
+  is still valid KV — ``lookup`` reports it as a copy-on-write
+  candidate and the engine duplicates the page
+  (:func:`~pipegoose_tpu.serving.kv_pool.copy_page`) before the new
+  request writes its own tail mid-page. The shared page is never
+  written by anyone but its creator-by-construction.
+- **Eviction = refcount-1 LRU leaves.** Only pages no live request
+  shares (refcount 1: the cache's own reference) can be evicted, and
+  only trie LEAVES (evicting an inner node would orphan its reachable
+  children) — least-recently-touched first, driven by a monotonic
+  clock so the order is deterministic. ``evictable_count`` feeds the
+  scheduler's admission ledger: reservation math counts
+  ``free + evictable`` as the true capacity, and pins (hit pages moving
+  refcount 1→2) are debited so an earlier request's worst-case
+  reservation can never be stranded by a later hit.
+
+The cache never touches device memory — it maps token content to page
+IDS; all KV bytes stay in the pool buffers.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from pipegoose_tpu.serving.kv_pool import PagePool
+
+
+class _Node:
+    """One cached page: the block of token ids it holds + trie links."""
+
+    __slots__ = ("block", "page", "parent", "children", "last_used")
+
+    def __init__(self, block: Tuple[int, ...], page: int,
+                 parent: Optional["_Node"]):
+        self.block = block
+        self.page = page
+        self.parent = parent
+        self.children: Dict[Tuple[int, ...], "_Node"] = {}
+        self.last_used = 0
+
+    def __repr__(self):  # debugging only
+        return f"_Node(page={self.page}, used={self.last_used})"
+
+
+@dataclass
+class PrefixHit:
+    """Result of a lookup: ``pages`` are fully matched shared pages
+    (``tokens = len(pages) * page_size`` prompt tokens whose KV needs no
+    prefill), ``cow_page``/``cow_tokens`` an optional partially matched
+    page whose first ``cow_tokens`` positions are valid after a
+    copy-on-write duplication. ``nodes`` is the matched trie chain (for
+    recency touching at acquire time — lookup itself is side-effect
+    free, so a failed admission leaves the LRU order untouched)."""
+
+    pages: List[int] = field(default_factory=list)
+    tokens: int = 0
+    cow_page: Optional[int] = None
+    cow_tokens: int = 0
+    nodes: List[_Node] = field(default_factory=list)
+
+    @property
+    def total_tokens(self) -> int:
+        return self.tokens + self.cow_tokens
+
+
+class PrefixCache:
+    """Radix index mapping page-aligned prompt prefixes to pool pages."""
+
+    def __init__(self, pool: PagePool):
+        self.pool = pool
+        self.page_size = pool.page_size
+        self._roots: Dict[Tuple[int, ...], _Node] = {}
+        # flat view for eviction scans, keyed by identity so removal is
+        # O(1) (a list's .remove would make pressure eviction O(N^2))
+        self._nodes: Dict[int, _Node] = {}
+        self._clock = 0                 # deterministic LRU ordering
+
+    # -- queries -----------------------------------------------------------
+
+    @property
+    def cached_pages(self) -> int:
+        return len(self._nodes)
+
+    def evictable_count(self) -> int:
+        """Pages leaf-first eviction can ACTUALLY recover right now: a
+        node counts only when its page has refcount 1 (cache-only) and
+        its entire subtree does too. The subtree condition is not
+        implied by refcounts alone — ``insert`` can hang a new
+        request's child under an existing node WITHOUT the inserter
+        referencing the parent chain (it only shares pages it adds), so
+        a refcount-1 inner node may sit above a pinned child and never
+        become a leaf while that child lives. The scheduler's admission
+        ledger treats this count as spendable capacity (its never-fail
+        reservation contract rests on it), so it must be exact, not an
+        upper bound."""
+        memo = {}
+
+        def recoverable(node: _Node) -> bool:
+            got = memo.get(id(node))
+            if got is None:
+                got = self.pool.refcount(node.page) == 1 and all(
+                    recoverable(c) for c in node.children.values()
+                )
+                memo[id(node)] = got
+            return got
+
+        return sum(1 for n in self._nodes.values() if recoverable(n))
+
+    def lookup(self, tokens: Sequence[int], max_tokens: Optional[int] = None
+               ) -> PrefixHit:
+        """Longest cached prefix of ``tokens``, capped at ``max_tokens``
+        (callers cap at ``len(tokens) - 1``: at least one token must be
+        forwarded to produce logits). Full-page matches come first; if
+        the walk stops mid-trie, the child block sharing the longest
+        HEAD with the remaining tokens becomes the COW candidate.
+        Side-effect free — pair with :meth:`acquire`."""
+        toks = [int(t) for t in np.asarray(tokens)]
+        cap = len(toks) if max_tokens is None else min(max_tokens, len(toks))
+        ps = self.page_size
+        hit = PrefixHit()
+        children = self._roots
+        i = 0
+        while (i + 1) * ps <= cap:
+            blk = tuple(toks[i * ps:(i + 1) * ps])
+            node = children.get(blk)
+            if node is None:
+                break
+            hit.pages.append(node.page)
+            hit.nodes.append(node)
+            children = node.children
+            i += 1
+        hit.tokens = i * ps
+        rem = toks[i * ps:cap]
+        if rem and children:
+            best, best_m = None, 0
+            # sorted iteration: deterministic winner among equal-length
+            # head matches (block order, then page id, is stable)
+            for blk in sorted(children):
+                m = 0
+                for a, b in zip(blk, rem):
+                    if a != b:
+                        break
+                    m += 1
+                if m > best_m:
+                    best, best_m = children[blk], m
+            if best is not None:
+                hit.cow_page = best.page
+                hit.cow_tokens = best_m
+                hit.nodes.append(best)
+        return hit
+
+    # -- mutation ----------------------------------------------------------
+
+    def acquire(self, hit: PrefixHit) -> None:
+        """Take one reference per matched page on behalf of a request
+        and refresh the chain's recency. The COW candidate is pinned
+        TOO: the copy is a device op the engine performs a tick later,
+        and an eviction in between could hand the source page to a new
+        owner who overwrites it — the engine releases the pin right
+        after :func:`~pipegoose_tpu.serving.kv_pool.copy_page` runs."""
+        if hit.pages:
+            self.pool.share(hit.pages)
+        if hit.cow_page is not None:
+            self.pool.share([hit.cow_page])
+        for node in hit.nodes:
+            self._clock += 1
+            node.last_used = self._clock
+        return None
+
+    def insert(self, tokens: Sequence[int], pages: Sequence[int]) -> int:
+        """Register a prefilled request's page-aligned prefix: page ``i``
+        of ``pages`` holds the KV of tokens ``[i*ps, (i+1)*ps)``. Only
+        FULL pages are inserted (a partial tail page keeps growing under
+        its owner — its content is not stable). Existing nodes win (two
+        requests racing the same prefix converge on the first's pages;
+        the second's stay private). Each newly inserted page gains the
+        cache's own reference. Returns the number of new nodes."""
+        toks = [int(t) for t in np.asarray(tokens)]
+        ps = self.page_size
+        n_full = min(len(toks) // ps, len(pages))
+        children = self._roots
+        parent = None
+        added = 0
+        for i in range(n_full):
+            blk = tuple(toks[i * ps:(i + 1) * ps])
+            node = children.get(blk)
+            if node is None:
+                node = _Node(blk, int(pages[i]), parent)
+                self.pool.share([node.page])
+                children[blk] = node
+                self._nodes[id(node)] = node
+                added += 1
+            self._clock += 1
+            node.last_used = self._clock
+            parent = node
+            children = node.children
+        return added
+
+    def evict(self, n: int) -> int:
+        """Free up to ``n`` pages back to the pool: repeatedly drop the
+        least-recently-used LEAF whose page only the cache references.
+        Returns the number actually freed (< n when everything left is
+        pinned by live requests)."""
+        freed = 0
+        while freed < n:
+            victim = None
+            for node in self._nodes.values():
+                if node.children or self.pool.refcount(node.page) != 1:
+                    continue
+                if victim is None or node.last_used < victim.last_used:
+                    victim = node
+            if victim is None:
+                break
+            self._remove(victim)
+            self.pool.release([victim.page])
+            freed += 1
+        return freed
+
+    def clear(self) -> int:
+        """Drop every unpinned page (tests / shutdown). Pinned pages
+        stay — their requests still read them."""
+        return self.evict(len(self._nodes))
+
+    def _remove(self, node: _Node) -> None:
+        siblings = (node.parent.children if node.parent is not None
+                    else self._roots)
+        del siblings[node.block]
+        del self._nodes[id(node)]

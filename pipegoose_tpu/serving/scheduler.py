@@ -8,14 +8,31 @@ shapes); the scheduler's job is to keep those slots full:
   pool can cover the candidate's WORST-CASE footprint
   (``ceil((prompt + max_new) / page_size)``) on top of every active
   request's outstanding reservation. Pages are then allocated LAZILY —
-  prompt pages at admission, decode pages one at a time as the write
-  position crosses a page boundary — so short-finishing requests never
-  hold their worst case, while the reservation arithmetic guarantees a
-  lazy ``alloc`` can never fail mid-flight. Head-of-line blocking is
-  deliberate: FIFO admission keeps the schedule deterministic.
+  the first prefill chunk's pages at admission (the WHOLE prompt's when
+  chunked prefill is off: one monolithic chunk), decode pages one at a
+  time as the write position crosses a page boundary — so
+  short-finishing requests never hold their worst case, while the
+  reservation arithmetic guarantees a lazy ``alloc`` can never fail
+  mid-flight. Head-of-line blocking is deliberate: FIFO admission keeps
+  the schedule deterministic.
+- **prefix caching** (``prefix_cache=PrefixCache(pool)``) short-cuts
+  admission: the longest page-aligned cached prefix of the prompt is
+  SHARED (refcount bump, no alloc, no prefill) and only the unique tail
+  is prefilled. The admission ledger then counts
+  ``free + cache-evictable`` as capacity and debits pages the hit pins
+  (refcount 1 -> 2), so a reservation made when a page looked evictable
+  can never be stranded by a later hit; ``_alloc`` evicts
+  least-recently-used unpinned cache pages on demand.
 - **eviction** frees a finished request's pages and reservation the
-  step its last token is emitted, so the next ``admit`` can re-use both
-  the slot and the pages mid-stream (continuous batching).
+  step its last token is emitted — shared pages just drop a reference —
+  so the next ``admit`` can re-use both the slot and the pages
+  mid-stream (continuous batching). :meth:`Scheduler.preempt` is the
+  mid-flight variant: a live request's pages all go back (cache-shared
+  ones survive in the cache) and the request re-queues at the HEAD;
+  re-admission re-prefills ``prompt + generated[:-1]`` (hitting the
+  cache for the shared prefix) and resumes decoding with the last
+  generated token pending — token-for-token identical to an
+  uninterrupted run.
 
 ``continuous=False`` turns the same machinery into the naive padded
 baseline: a batch is admitted only into an EMPTY slot set and drains
@@ -28,7 +45,7 @@ from __future__ import annotations
 import enum
 from collections import deque
 from dataclasses import dataclass, field
-from typing import List, Optional
+from typing import List, Optional, Tuple
 
 import numpy as np
 
@@ -57,6 +74,9 @@ class Request:
     slot: Optional[int] = None
     pages: List[int] = field(default_factory=list)
     outstanding: int = 0               # worst-case pages not yet allocated
+    prefilled_len: int = 0             # tokens whose KV is in pages + forwarded
+    hit_tokens: int = 0                # of those, tokens served by the cache
+    cow: Optional[Tuple[int, int]] = None  # (src page, valid tokens) pending copy
     finish_reason: Optional[str] = None
     t_submit: Optional[float] = None
     t_admit: Optional[float] = None
@@ -75,6 +95,15 @@ class Request:
         return self.prompt_len + max(len(self.generated) - 1, 0)
 
     @property
+    def target_len(self) -> int:
+        """Tokens a (re-)prefill must put in the pages before decoding
+        can resume: the prompt, plus — after a preemption — every
+        generated token except the pending last one. Equals
+        ``cached_len`` by construction; named separately because during
+        PREFILL it is the goal, not the state."""
+        return self.cached_len
+
+    @property
     def tokens(self) -> np.ndarray:
         return np.concatenate(
             [np.asarray(self.prompt, np.int64),
@@ -84,13 +113,23 @@ class Request:
 
 class Scheduler:
     def __init__(self, num_slots: int, pool: PagePool, max_context: int,
-                 continuous: bool = True):
+                 continuous: bool = True, prefix_cache=None,
+                 chunk_tokens: Optional[int] = None):
         if num_slots < 1:
             raise ValueError("need at least one decode slot")
+        if chunk_tokens is not None and (
+                chunk_tokens < pool.page_size or chunk_tokens % pool.page_size):
+            raise ValueError(
+                f"chunk_tokens={chunk_tokens} must be a positive multiple "
+                f"of page_size={pool.page_size} (chunks end on page "
+                f"boundaries so every chunk's pages exist before it runs)"
+            )
         self.num_slots = num_slots
         self.pool = pool
         self.max_context = max_context
         self.continuous = continuous
+        self.cache = prefix_cache
+        self.chunk_tokens = chunk_tokens
         self.slots: List[Optional[Request]] = [None] * num_slots
         self.queue: deque = deque()
         self._outstanding_total = 0
@@ -121,9 +160,12 @@ class Scheduler:
         self.queue.append(req)
 
     def admit(self, now: float) -> List[Request]:
-        """Move queued requests into free slots while the pool can cover
-        their worst case beyond all outstanding reservations. Returns the
-        newly admitted requests (they still need a prefill)."""
+        """Move queued requests into free slots while the pool (plus
+        evictable cache pages) can cover their worst case beyond all
+        outstanding reservations. A prefix-cache hit shares the matched
+        pages and shrinks both the worst case and the prefill. Returns
+        the newly admitted requests (they still need a prefill for
+        their unique tail, possibly empty chunks at a time)."""
         admitted: List[Request] = []
         if not self.continuous and any(s is not None for s in self.slots):
             return admitted  # naive padded batching: drain before refill
@@ -132,30 +174,123 @@ class Scheduler:
             if not free_slots:
                 break
             req = self.queue[0]
+            target = req.target_len
             worst = self.pool.pages_for(req.prompt_len + req.max_new_tokens)
-            if self.pool.free_count - self._outstanding_total < worst:
+            hit = None
+            shared: List[int] = []
+            evictable = pinned = 0
+            if self.cache is not None and (
+                self.pool.free_count + self.cache.cached_pages
+                - self._outstanding_total
+                < worst - (target - 1) // self.pool.page_size
+            ):
+                # O(1) reject: even if EVERY cached page were evictable
+                # and the hit were the longest possible, the head can't
+                # fit — skip the trie walk + whole-trie evictable scan.
+                # (A head blocked only by the EXACT ledger still rescans
+                # each tick; acceptable until caches reach a size where
+                # incremental evictable accounting pays for itself.)
+                break
+            if self.cache is not None:
+                # >= 1 token must be forwarded: its logits produce the
+                # next token (resumed requests re-derive their pending)
+                hit = self.cache.lookup(req.tokens[:target],
+                                        max_tokens=target - 1)
+                shared = hit.pages
+                pins = shared + (
+                    [hit.cow_page] if hit.cow_page is not None else []
+                )
+                pinned = sum(1 for p in pins if self.pool.refcount(p) == 1)
+                evictable = self.cache.evictable_count()
+            need_new = worst - len(shared)
+            if (self.pool.free_count + evictable - pinned
+                    - self._outstanding_total < need_new):
                 break  # FIFO head-of-line: deterministic admission order
             self.queue.popleft()
             req.slot = free_slots[0]
             self.slots[req.slot] = req
             req.status = Status.PREFILL
             req.t_admit = now
-            n_prompt = self.pool.pages_for(req.prompt_len)
-            req.pages = self.pool.alloc(n_prompt)
-            req.outstanding = worst - n_prompt
+            req.cow = None
+            req.pages = []
+            req.prefilled_len = req.hit_tokens = 0
+            if hit is not None:
+                self.cache.acquire(hit)  # pins shared + COW source pages
+                req.pages = list(shared)
+                req.prefilled_len = hit.tokens
+                req.hit_tokens = hit.total_tokens
+                if hit.cow_page is not None:
+                    req.cow = (hit.cow_page, hit.cow_tokens)
+            cow_tokens = req.cow[1] if req.cow else 0
+            chunk_end = target if self.chunk_tokens is None else min(
+                req.prefilled_len + cow_tokens + self.chunk_tokens, target
+            )
+            n_now = self.pool.pages_for(chunk_end) - len(req.pages)
+            req.pages += self._alloc(n_now)
+            req.outstanding = need_new - n_now
             self._outstanding_total += req.outstanding
             admitted.append(req)
         return admitted
 
-    def ensure_page(self, req: Request) -> None:
-        """Lazy growth: allocate the next page when the pending token's
-        write position crosses into unallocated territory. Cannot fail —
-        admission reserved the worst case."""
-        pos = req.cached_len  # position the next step writes
-        if pos >= len(req.pages) * self.pool.page_size:
-            req.pages += self.pool.alloc(1)
+    def preempt(self, req: Request) -> None:
+        """Mid-stream eviction under memory pressure (or an operator's
+        rebalance): give back every page — shared prefix pages survive
+        in the cache for the re-admission to hit — and re-queue the
+        request ahead of never-admitted arrivals, ordered by ORIGINAL
+        submit order among preempted peers (a bare appendleft would
+        reverse two requests preempted in the same tick), so FIFO
+        determinism survives any preemption pattern. Generated tokens
+        are kept; re-admission re-prefills prompt + generated minus the
+        pending token, which decode then resumes on."""
+        if req.status not in (Status.PREFILL, Status.DECODE):
+            raise ValueError(f"cannot preempt a {req.status.value} request")
+        self._release_all(req)
+        self._outstanding_total -= req.outstanding
+        req.outstanding = 0
+        self.slots[req.slot] = None
+        req.slot = None
+        req.prefilled_len = req.hit_tokens = 0
+        req.status = Status.QUEUED
+        # t_admit marks a previously admitted (re-queued) request;
+        # fresh submissions have none and always sort after them
+        pos = 0
+        while (pos < len(self.queue)
+               and self.queue[pos].t_admit is not None
+               and self.queue[pos].uid < req.uid):
+            pos += 1
+        self.queue.insert(pos, req)
+
+    def ensure_pages(self, req: Request, n_tokens: int) -> None:
+        """Lazy growth to cover ``n_tokens`` cached positions (decode:
+        one past the pending write; chunked prefill: the chunk's end;
+        speculation: the draft bundle's end). Cannot fail: admission
+        reserved the worst case against free + evictable capacity, and
+        the one hole in that ledger — a LATER ``insert`` hanging a
+        live request's child under a node an earlier admission already
+        credited as evictable, which makes the ancestor unrecoverable
+        with no debit — is closed by RETRACTION (``_alloc(owner=req)``
+        preempts the newest other active request; it re-queues and
+        re-prefills through the cache). The submit-time
+        ``worst <= capacity`` check guarantees retraction terminates:
+        with every other request preempted and the cache drained, the
+        owner's worst case always fits."""
+        if req.status not in (Status.PREFILL, Status.DECODE):
+            # growing a slotless request would drive its reservation
+            # negative and leak the pages at re-admission — callers
+            # iterating a materialized batch must re-check status after
+            # any neighbor's ensure_pages (it may have retracted them)
+            raise RuntimeError(
+                f"ensure_pages on a {req.status.value} request "
+                f"(retracted mid-batch by a neighbor's lazy growth?)"
+            )
+        while len(req.pages) * self.pool.page_size < n_tokens:
+            req.pages += self._alloc(1, owner=req)
             req.outstanding -= 1
             self._outstanding_total -= 1
+
+    def ensure_page(self, req: Request) -> None:
+        """Decode-step growth: cover the pending token's write position."""
+        self.ensure_pages(req, req.cached_len + 1)
 
     def record_token(self, req: Request, token: int, now: float) -> None:
         if req.t_first_token is None:
@@ -167,12 +302,43 @@ class Scheduler:
         elif len(req.generated) >= req.max_new_tokens:
             self._finish(req, "length", now)
 
+    def _alloc(self, n: int, owner: Optional[Request] = None) -> List[int]:
+        """Pool alloc that treats LRU-evictable cache pages as free.
+        With ``owner`` set (the must-not-fail reservation path), a
+        shortfall that eviction cannot cover retracts newest-first
+        OTHER active requests until it can — see :meth:`ensure_pages`.
+        Admission never passes ``owner``: its ledger check and alloc
+        are atomic within one ``admit`` iteration (no insert can
+        intervene), and a blocked admission simply waits."""
+        if n <= 0:
+            return []
+        if self.cache is not None and self.pool.free_count < n:
+            self.cache.evict(n - self.pool.free_count)
+            if self.pool.free_count < n and owner is not None:
+                for victim in sorted(
+                    (r for r in self.slots
+                     if r is not None and r is not owner),
+                    key=lambda r: r.uid, reverse=True,
+                ):
+                    self.preempt(victim)
+                    self.cache.evict(n - self.pool.free_count)
+                    if self.pool.free_count >= n:
+                        break
+        return self.pool.alloc(n)
+
+    def _release_all(self, req: Request) -> None:
+        if req.cow is not None:          # un-run COW copy: drop the pin
+            self.pool.release([req.cow[0]])
+            req.cow = None
+        if req.pages:
+            self.pool.release(req.pages)
+            req.pages = []
+
     def _finish(self, req: Request, reason: str, now: float) -> None:
         req.status = Status.DONE
         req.finish_reason = reason
         req.t_done = now
-        self.pool.free(req.pages)
-        req.pages = []
+        self._release_all(req)
         self._outstanding_total -= req.outstanding
         req.outstanding = 0
         self.slots[req.slot] = None

@@ -23,11 +23,12 @@ python -m pytest -q -p no:cacheprovider \
     "$@"
 
 # The sharding-regression gate (mesh doctor, telemetry/doctor.py):
-# compile the hybrid train step AND the serving decode step on an
-# 8-fake-device mesh and fail (exit 2) on partitioner-inserted
-# resharding collectives, intended-vs-actual spec mismatches, or large
-# replicated buffers — a broken PartitionSpec dies here at compile
-# time, not in a TPU bench.
+# compile the hybrid train step AND the serving decode step AND the
+# chunked-prefill mixed-step program (prefix cache + chunking on,
+# ISSUE 6) on an 8-fake-device mesh and fail (exit 2) on
+# partitioner-inserted resharding collectives, intended-vs-actual spec
+# mismatches, or large replicated buffers — a broken PartitionSpec
+# dies here at compile time, not in a TPU bench.
 echo "== sharding-regression guard (mesh doctor) =="
 python scripts/mesh_doctor.py --fake-devices 8 --tp 2 --dp 4 \
     --check --serving --quiet

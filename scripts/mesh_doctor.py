@@ -63,14 +63,22 @@ def build_train_report(args, ctx, cfg, params, bloom):
     )
 
 
-def build_serving_report(args, ctx, cfg, params, bloom):
+def build_serving_reports(args, ctx, cfg, params, bloom):
+    """Decode step AND the chunked-prefill program of the mixed step
+    (prefix cache + chunking on): ISSUE 6 pins BOTH at zero
+    partitioner-inserted resharding, so a PartitionSpec regression in
+    either half of the serving tick dies here at compile time."""
     from pipegoose_tpu.serving import ServingEngine
 
     engine = ServingEngine(
         params, cfg, num_slots=2, num_pages=16, page_size=8,
         max_context=32, mesh=ctx.mesh, param_specs=bloom.tp_specs(params),
+        prefix_cache=True, prefill_chunk=16,
     )
-    return engine.doctor(large_bytes=args.large_bytes)
+    return {
+        "decode_step": engine.doctor(large_bytes=args.large_bytes),
+        "prefill_chunk": engine.doctor_chunk(large_bytes=args.large_bytes),
+    }
 
 
 def run_guards(name, report, args) -> int:
@@ -108,7 +116,8 @@ def main() -> int:
                          "platform count; works under a sitecustomize "
                          "that pins an accelerator platform)")
     ap.add_argument("--serving", action="store_true",
-                    help="also doctor the paged decode step")
+                    help="also doctor the paged decode step and the "
+                         "chunked-prefill mixed-step program")
     ap.add_argument("--overlap", action="store_true",
                     help="build the ring collective-matmul train step "
                          "(config.overlap_tp — docs/comm.md)")
@@ -163,8 +172,8 @@ def main() -> int:
         reports = {"train_step": build_train_report(args, ctx, cfg, params,
                                                     bloom)}
         if args.serving:
-            reports["decode_step"] = build_serving_report(args, ctx, cfg,
-                                                          params, bloom)
+            reports.update(build_serving_reports(args, ctx, cfg, params,
+                                                 bloom))
         for name, report in reports.items():
             if not args.quiet:
                 print(f"== {name} ==")

@@ -5,6 +5,10 @@ Timing recipe per bench.py: loop inside jit (lax.scan), scalar fetch,
 RTT subtracted. One attach per run (tunnel is single-client).
 
     python scripts/sweep_tpu_perf.py [kernel|model|fusedce|serving|comm]
+    python scripts/sweep_tpu_perf.py serving --prefix-replay   # ISSUE 6:
+        # Zipf shared-prefix replay arms (baseline / chunked / cached /
+        # cached+spec) per slot count instead of the continuous-vs-
+        # static A/B
 """
 from __future__ import annotations
 
@@ -315,15 +319,24 @@ def comm_sweep():
     print(json.dumps(results))
 
 
-def serving_sweep():
+def serving_sweep(prefix_replay: bool = False):
     """Continuous-batching vs naive padded serving (serving/engine.py)
     across slot counts on the real chip: the decode-step savings grow
     with the slot count as long as the mixed-length workload keeps
     slots refillable. Prompt lengths stay inside one page bucket so
     each engine compiles a single prefill program (dispatch RTT, not
-    compile count, should dominate)."""
+    compile count, should dominate).
+
+    ``--prefix-replay`` swaps the workload for the ISSUE 6 Zipf-skewed
+    shared-prefix replay and measures the four engine arms (monolithic
+    baseline, chunked prefill, chunked + prefix cache, + speculative)
+    per slot count — tokens/s, TTFT p50/p99, hit rate, prefill-token
+    reduction, max decode gap."""
     from pipegoose_tpu.models import bloom
-    from pipegoose_tpu.serving import serving_ab_benchmark
+    from pipegoose_tpu.serving import (
+        prefix_replay_benchmark,
+        serving_ab_benchmark,
+    )
 
     cfg = bloom.BloomConfig.bloom_560m(dtype=jnp.bfloat16)
     params = bloom.init_params(cfg, jax.random.PRNGKey(1))
@@ -341,10 +354,19 @@ def serving_sweep():
         label = f"slots{slots}"
         reg.disable()
         try:
-            results[label] = serving_ab_benchmark(
-                params, cfg, specs, num_slots=slots,
-                num_pages=1 + 3 * slots, page_size=32, max_context=128,
-            )
+            if prefix_replay:
+                results[label] = prefix_replay_benchmark(
+                    params, cfg, n_requests=4 * slots, n_prefixes=3,
+                    prefix_len=64, suffix_lens=(8, 16, 24), max_new=24,
+                    num_slots=slots, num_pages=1 + 16 * slots,
+                    page_size=32, max_context=256, prefill_chunk=64,
+                    include_speculative=True, speculative=(4, 3),
+                )
+            else:
+                results[label] = serving_ab_benchmark(
+                    params, cfg, specs, num_slots=slots,
+                    num_pages=1 + 3 * slots, page_size=32, max_context=128,
+                )
         except Exception as e:  # noqa: BLE001
             results[label] = {"error": f"{type(e).__name__}: {e}"[:300]}
         finally:
@@ -367,6 +389,9 @@ if __name__ == "__main__":
              "comm": comm_sweep}
     if mode not in modes:
         raise SystemExit(f"unknown mode {mode!r}; pick one of {sorted(modes)}")
+    if mode == "serving" and "--prefix-replay" in sys.argv[2:]:
+        modes["serving"] = functools.partial(serving_sweep,
+                                             prefix_replay=True)
     # telemetry JSONL artifact (the serving sweep's engines emit their
     # per-step time series into it; every mode gets a final snapshot) —
     # set SWEEP_TELEMETRY_JSONL="" to disable

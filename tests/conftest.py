@@ -117,6 +117,11 @@ FAST_TESTS = {
     # serving: continuous batching == per-request generate, 1-device + tp
     "tests/serving/test_engine.py::test_mixed_lengths_token_identical_to_generate",
     "tests/serving/test_engine.py::test_tp_sharded_serving_matches_generate[2]",
+    # serving perf modes (ISSUE 6): cache-hit equivalence, chunked
+    # interleaving, and speculative greedy parity
+    "tests/serving/test_prefix_cache.py::test_cache_on_off_token_identical",
+    "tests/serving/test_chunked_prefill.py::test_decode_progresses_while_long_prompt_prefills",
+    "tests/serving/test_speculative.py::test_speculative_greedy_parity[k1n3]",
     # telemetry: engine instrumentation vs legacy dict + compiled comms
     "tests/serving/test_engine.py::test_engine_telemetry_agrees_with_legacy_metrics",
     "tests/telemetry/test_derived.py::test_compiled_step_stats_reports_flops_and_comms",
@@ -232,6 +237,16 @@ SLOW_TESTS = {
     # and the heavier non-pinned nodes keep tier-1 siblings — the
     # acceptance pins (layer parity [2]+[4], doctor ppermute pin, int8
     # short-run + byte accounting) all stay in tier-1
+    # serving perf modes (ISSUE 6): heavier parametrizations and
+    # composition runs move out of tier-1 — each keeps a sibling there
+    # (spec parity [k1n3] + eos + full-stack, chunk parity via the
+    # interleaving test, trie-eviction units for the pressure run)
+    "tests/serving/test_speculative.py::test_speculative_greedy_parity[k1n1]",
+    "tests/serving/test_speculative.py::test_speculative_greedy_parity[k3n2]",
+    "tests/serving/test_speculative.py::test_speculative_counters_and_steps",
+    "tests/serving/test_prefix_cache.py::test_pool_pressure_evicts_lru_and_stays_correct",
+    "tests/serving/test_chunked_prefill.py::test_chunked_prefill_token_identical",
+    "tests/serving/test_chunked_prefill.py::test_chunk_progress_counts_for_the_watchdog",
     "tests/test_comm_hybrid.py::test_quantized_full_run_loss_parity[int8]",
     "tests/test_comm_hybrid.py::test_quantized_full_run_loss_parity[bf16]",
     "tests/test_comm_hybrid.py::test_plain_dp_grad_comm_matches_zero_path",

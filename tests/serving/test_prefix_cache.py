@@ -1,0 +1,376 @@
+"""Prefix-cache correctness: refcounted sharing, the radix index, COW
+duplication, refcount-1 LRU eviction — and the serving oracle extended
+to it: greedy output with the cache ON is token-identical to generate()
+(and to the cache-OFF engine), including tp=2, COW mid-page tails, and
+evict→re-admit. Sharing is memory management; it must be invisible in
+the tokens and fully reversible in the pool accounting."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pipegoose_tpu.distributed import ParallelContext
+from pipegoose_tpu.models import bloom, generate as gen
+from pipegoose_tpu.serving import (
+    PagePool,
+    PrefixCache,
+    Request,
+    ServingEngine,
+    Status,
+)
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = bloom.BloomConfig(vocab_size=64, hidden_size=64, n_layer=2, n_head=4)
+    params = bloom.init_params(cfg, jax.random.PRNGKey(0))
+    rng = np.random.RandomState(7)
+    shared = rng.randint(1, 64, (13,))          # 3 full pages + 1 tail @ ps=4
+    reqs = [
+        (np.concatenate([shared, rng.randint(1, 64, (k,))]), n)
+        for k, n in [(3, 6), (5, 4), (2, 7)]
+    ] + [
+        (shared[:10], 5),                       # strict prefix: COW mid-page
+        (rng.randint(1, 64, (7,)), 6),          # unrelated: pure miss
+    ]
+    return cfg, params, shared, reqs
+
+
+def _reference(params, cfg, prompt, max_new, eos=None):
+    out = gen.generate(
+        params, jnp.asarray(prompt)[None], cfg, max_new_tokens=max_new,
+        eos_token_id=eos,
+    )
+    return np.asarray(out)[0, len(prompt):]
+
+
+# --- refcounted pool --------------------------------------------------------
+
+
+def test_share_release_refcounting():
+    pool = PagePool(num_pages=9, page_size=4)
+    (p,) = pool.alloc(1)
+    assert pool.refcount(p) == 1
+    pool.share([p])
+    pool.share([p])
+    assert pool.refcount(p) == 3
+    assert pool.shared_count == 1
+    pool.release([p])
+    assert pool.refcount(p) == 2
+    assert pool.free_count == 7          # still held: not freed
+    pool.release([p])
+    pool.release([p])
+    assert pool.refcount(p) == 0
+    assert pool.free_count == 8          # last reference frees
+    with pytest.raises(RuntimeError, match="not allocated"):
+        pool.release([p])
+    with pytest.raises(RuntimeError, match="not allocated"):
+        pool.share([p])
+
+
+def test_history_records_refcount_deltas():
+    pool = PagePool(num_pages=5, page_size=4)
+    pages = pool.alloc(2)
+    pool.share(pages)
+    pool.release(pages)
+    pool.release(pages)
+    events = [(e, d) for e, _, d in pool.history]
+    assert events == [("alloc", +1), ("share", +1), ("release", -1),
+                      ("release", -1)]
+
+
+def test_fragmentation_gauge():
+    pool = PagePool(num_pages=9, page_size=4)
+    assert pool.fragmentation() == 0.0          # one contiguous run
+    a = pool.alloc(8)
+    assert pool.fragmentation() == 0.0          # empty free list
+    pool.release([a[1], a[3], a[5]])            # non-adjacent holes
+    assert pool.fragmentation() == pytest.approx(1 - 1 / 3)
+    pool.release([a[0], a[2], a[4], a[6], a[7]])
+    assert pool.fragmentation() == 0.0
+
+
+# --- radix index ------------------------------------------------------------
+
+
+def test_trie_lookup_insert_and_partial_match():
+    pool = PagePool(num_pages=17, page_size=4)
+    cache = PrefixCache(pool)
+    toks = list(range(1, 14))                   # 13 tokens: 3 full pages
+    pages = pool.alloc(4)
+    assert cache.insert(toks[:12], pages[:3]) == 3
+    assert cache.cached_pages == 3
+    # full walk capped at len-1: a 13-token prompt matches all 3 pages
+    hit = cache.lookup(toks, max_tokens=12)
+    assert hit.pages == pages[:3] and hit.tokens == 12
+    assert hit.cow_page is None
+    # strict 10-token prefix: 2 full pages + 1 COW token from page 3
+    hit = cache.lookup(toks[:10], max_tokens=9)
+    assert hit.pages == pages[:2] and hit.tokens == 8
+    assert hit.cow_page == pages[2] and hit.cow_tokens == 1
+    # diverging mid-page: 2 full pages + 2 COW tokens (head match only)
+    hit = cache.lookup(toks[:8] + [9, 10, 99, 99], max_tokens=11)
+    assert hit.tokens == 8 and hit.cow_tokens == 2
+    # different first block: clean miss
+    hit = cache.lookup([42] * 12, max_tokens=11)
+    assert hit.pages == [] and hit.cow_page is None
+    # re-insert dedups: existing nodes win, no new references
+    before = [pool.refcount(p) for p in pages[:3]]
+    assert cache.insert(toks[:12], pool.alloc(3)) == 0
+    assert [pool.refcount(p) for p in pages[:3]] == before
+
+
+def test_acquire_pins_and_eviction_respects_refcounts():
+    pool = PagePool(num_pages=9, page_size=4)
+    cache = PrefixCache(pool)
+    a = pool.alloc(2)
+    b = pool.alloc(1)
+    cache.insert(list(range(8)), a)             # chain a0 -> a1
+    cache.insert([9, 9, 9, 9], b)               # separate root
+    pool.release(a)
+    pool.release(b)                             # cache is now sole owner
+    assert cache.evictable_count() == 3
+    hit = cache.lookup(list(range(8)) + [0], max_tokens=8)
+    cache.acquire(hit)                          # pins a0, a1
+    assert cache.evictable_count() == 1
+    # eviction may only take the unpinned root b, then stalls
+    assert cache.evict(3) == 1
+    assert cache.cached_pages == 2
+    assert pool.free_count == 6
+    pool.release(hit.pages)                     # unpin
+    # leaf-first LRU: a1 (leaf) must go before a0 (its parent)
+    assert cache.evict(1) == 1
+    assert cache.cached_pages == 1
+    assert pool.refcount(a[0]) == 1 and pool.refcount(a[1]) == 0
+    assert cache.evict(5) == 1                  # a0 now a leaf
+    assert pool.free_count == 8 and cache.cached_pages == 0
+
+
+def test_evictable_count_excludes_inner_nodes_over_pinned_children():
+    """Two requests race the same first block cold: both prefill it
+    privately, the second's divergent child lands under the first's
+    node WITHOUT the second referencing the parent chain. Once the
+    first finishes, the parent is refcount-1 but can never become a
+    leaf while the pinned child lives — the admission ledger must NOT
+    count it as spendable capacity (its never-fail reservation
+    contract rests on the count being exact, not an upper bound)."""
+    pool = PagePool(num_pages=9, page_size=4)
+    cache = PrefixCache(pool)
+    b1, b2, b3 = [1] * 4, [2] * 4, [3] * 4
+    a = pool.alloc(2)
+    cache.insert(b1 + b2, a)                   # A publishes P1 -> P2
+    pool.release(a)                            # A finishes: both refcount 1
+    c = pool.alloc(2)
+    cache.insert(b1 + b3, c)                   # C: P1 exists (A's page
+    # wins), only its b3 child is new — C holds no reference on P1
+    assert pool.refcount(a[0]) == 1            # the inner node
+    assert pool.refcount(c[1]) == 2            # C live + cache
+    # recoverable right now: P2 only (leaf, refcount 1). P1 sits above
+    # C's pinned child; counting it would let admission over-reserve.
+    assert cache.evictable_count() == 1
+    assert cache.evict(3) == 1                 # and evict agrees exactly
+    pool.release(c)                            # C finishes (its private
+    # unpublished b1 page frees outright, its b3 page falls to cache-only)
+    assert cache.evictable_count() == 2        # P1 subtree now free-able
+    assert cache.evict(3) == 2
+    assert pool.free_count == 8                # every page reclaimed
+
+
+def test_lazy_growth_retracts_when_insert_invalidates_the_ledger():
+    """The temporal ledger hole: an admission credits an evictable node,
+    then a LATER insert hangs a live request's child under it — the
+    ancestor becomes unrecoverable with no debit. The never-fail
+    contract must hold anyway: lazy growth RETRACTS the newest other
+    active request (pages back, re-queued) instead of raising."""
+    from pipegoose_tpu.serving import Request, Scheduler, Status
+
+    pool = PagePool(num_pages=9, page_size=4)
+    cache = PrefixCache(pool)
+    sched = Scheduler(2, pool, max_context=32, prefix_cache=cache)
+    blk_a = [7] * 4
+    # R0: prompt [A,B] (8 toks) + 4 new = worst 3, admitted on a COLD
+    # cache (so it prefills A privately, holding no reference on any
+    # future node for it)
+    r0 = Request(prompt=np.array(blk_a + [8] * 4), max_new_tokens=4)
+    sched.submit(r0, 0.0)
+    (a0,) = sched.admit(0.0)
+    assert (len(a0.pages), a0.outstanding) == (2, 1)
+    # another request published [A] and finished: an orphaned node the
+    # ledger may count as evictable credit
+    (pa,) = pool.alloc(1)
+    cache.insert(blk_a, [pa])
+    pool.release([pa])
+    assert cache.evictable_count() == 1
+    # R1: distinct prompt, worst 5 — admission NEEDS the credit
+    r1 = Request(prompt=np.array([9] * 4), max_new_tokens=16)
+    sched.submit(r1, 0.0)
+    (a1,) = sched.admit(0.0)
+    assert (len(a1.pages), a1.outstanding) == (1, 4)
+    # R0's prefill completes and publishes [A]: its B page hangs as a
+    # pinned child under the orphan node -> the credit is now phantom
+    cache.insert(r0.tokens[:8], r0.pages)
+    assert cache.evictable_count() == 0
+    sched.ensure_pages(r0, 9)           # R0 claims its reserved page
+    # R1 claims its worst case; free pages can no longer cover it —
+    # retraction must kick in (preempt R0, newest other), not raise
+    sched.ensure_pages(r1, 20)
+    assert len(r1.pages) == 5
+    assert r0.status is Status.QUEUED and r0.pages == []
+    assert sched.queue[0] is r0
+
+
+# --- engine oracle ----------------------------------------------------------
+
+
+def test_cache_on_off_token_identical(setup):
+    """The tentpole contract: greedy tokens with the prefix cache ON
+    (cold AND warm — the warm run skips prefill for shared pages) equal
+    per-request generate() and the cache-OFF engine, and every
+    non-cached page is reclaimed."""
+    cfg, params, _, reqs = setup
+    eng = ServingEngine(params, cfg, num_slots=3, num_pages=32,
+                        page_size=4, max_context=64, prefix_cache=True,
+                        prefill_chunk=8)
+    for run in ("cold", "warm"):
+        outs, metrics = eng.run([
+            Request(prompt=p, max_new_tokens=n) for p, n in reqs
+        ])
+        for o, (p, n) in zip(outs, reqs):
+            np.testing.assert_array_equal(
+                o.generated, _reference(params, cfg, p, n),
+                err_msg=f"{run} run: request {o.uid} diverged with cache on",
+            )
+        # only cache-held pages remain; everything else reclaimed
+        assert eng.pool.used_count == eng.prefix_cache.cached_pages
+    assert metrics["prefix_cache"]["hit_rate"] > 0.5  # warm: shared prefix
+
+
+def test_cow_mid_page_tail_matches_generate(setup):
+    """A strict mid-page prefix of a cached prompt: the engine must COW
+    the partially matched page (counter pins exactly one copy) and still
+    produce generate()'s tokens."""
+    from pipegoose_tpu.telemetry import MetricsRegistry
+
+    cfg, params, shared, _ = setup
+    reg = MetricsRegistry(enabled=True)
+    eng = ServingEngine(params, cfg, num_slots=2, num_pages=32,
+                        page_size=4, max_context=64, prefix_cache=True,
+                        registry=reg)
+    eng.run([Request(prompt=shared, max_new_tokens=4)])       # seed cache
+    outs, _ = eng.run([Request(prompt=shared[:10], max_new_tokens=5)])
+    np.testing.assert_array_equal(
+        outs[0].generated, _reference(params, cfg, shared[:10], 5)
+    )
+    snap = reg.snapshot()["counters"]
+    assert snap["serving.prefix_cache.cow_copies"] == 1
+    # 2 full shared pages (8 tokens) + 1 COW token, 9-token target
+    assert snap["serving.prefix_cache.hit_tokens"] == 9
+    assert snap["serving.prefix_cache.shared_pages"] == 2
+
+
+def test_hit_skips_prefill_flops_proportionally(setup):
+    """The FLOP meter: tokens forwarded through prefill drop by exactly
+    the hit count — the cache does not recompute shared pages."""
+    from pipegoose_tpu.telemetry import MetricsRegistry
+
+    cfg, params, shared, _ = setup
+    reg = MetricsRegistry(enabled=True)
+    eng = ServingEngine(params, cfg, num_slots=2, num_pages=32,
+                        page_size=4, max_context=64, prefix_cache=True,
+                        registry=reg)
+    c_fwd = reg.counter("serving.prefill_tokens_total")
+    c_hit = reg.counter("serving.prefix_cache.hit_tokens")
+    eng.run([Request(prompt=shared, max_new_tokens=3)])
+    cold_fwd = c_fwd.value
+    assert cold_fwd == 13 and c_hit.value == 0
+    eng.run([Request(prompt=shared, max_new_tokens=3)])
+    warm_fwd = c_fwd.value - cold_fwd
+    # 12 of 13 tokens hit (cap: the last must be forwarded for logits)
+    assert c_hit.value == 12
+    assert warm_fwd == 13 - 12 == 1
+
+
+def test_evicted_and_readmitted_request_matches_uninterrupted(setup):
+    """ISSUE 6 satellite: preempt a shared-prefix request mid-decode,
+    let it re-admit (hitting the cache for prompt + replaying its own
+    generated tokens), and require token-identity with an uninterrupted
+    run plus exact pool-accounting reversal."""
+    cfg, params, shared, _ = setup
+    eng = ServingEngine(params, cfg, num_slots=2, num_pages=32,
+                        page_size=4, max_context=64, prefix_cache=True,
+                        prefill_chunk=8)
+    eng.run([Request(prompt=shared, max_new_tokens=4)])       # warm cache
+    free_before = eng.pool.free_count
+    cached_before = eng.prefix_cache.cached_pages
+
+    state = {"hits": 0}
+
+    def preempt_once(engine, tick):
+        if state["hits"]:
+            return
+        for r in engine.sched.active():
+            if r.status is Status.DECODE and len(r.generated) >= 3:
+                engine.sched.preempt(r)
+                state["hits"] += 1
+                return
+
+    outs, metrics = eng.run(
+        [Request(prompt=shared, max_new_tokens=8)], tick_hook=preempt_once
+    )
+    assert state["hits"] == 1, "request was never preempted"
+    assert metrics["prefills"] == 2            # original + re-admission
+    np.testing.assert_array_equal(
+        outs[0].generated, _reference(params, cfg, shared, 8),
+        err_msg="evict -> re-admit changed the token stream",
+    )
+    # refcounts returned the pool to its pre-admission state: the
+    # request's private pages freed, its shared references dropped
+    assert eng.pool.free_count == free_before
+    assert eng.prefix_cache.cached_pages == cached_before
+
+
+def test_pool_pressure_evicts_lru_and_stays_correct(setup):
+    """A pool sized so cached pages must be evicted for new admissions:
+    admission's free+evictable ledger lets the run proceed, eviction
+    frees LRU leaves, and tokens never change."""
+    cfg, params, shared, _ = setup
+    rng = np.random.RandomState(3)
+    eng = ServingEngine(params, cfg, num_slots=1, num_pages=9,
+                        page_size=4, max_context=32, prefix_cache=True)
+    reqs = [(shared[:9], 4), (rng.randint(1, 64, (10,)), 4),
+            (rng.randint(1, 64, (11,)), 4), (shared[:9], 4)]
+    outs, _ = eng.run([Request(prompt=p, max_new_tokens=n) for p, n in reqs])
+    for o, (p, n) in zip(outs, reqs):
+        np.testing.assert_array_equal(
+            o.generated, _reference(params, cfg, p, n),
+            err_msg=f"request {o.uid} diverged under cache eviction",
+        )
+    assert eng.pool.used_count == eng.prefix_cache.cached_pages <= 8
+
+
+@pytest.mark.parametrize("tp", [2])
+def test_tp_sharded_cache_matches_generate(setup, devices, tp):
+    """tp=2 shard_map serving with the prefix cache + chunked prefill:
+    shared head-sharded pages, COW copies, and the chunk program all run
+    inside shard_map — tokens still equal single-device generate()."""
+    cfg, params, shared, reqs = setup
+    ctx = ParallelContext(tensor_parallel_size=tp, data_parallel_size=4)
+    try:
+        eng = ServingEngine(
+            params, cfg, num_slots=2, num_pages=32, page_size=4,
+            max_context=64, mesh=ctx.mesh, param_specs=bloom.tp_specs(params),
+            prefix_cache=True, prefill_chunk=8,
+        )
+        sub = reqs[:2] + [reqs[3]]              # shared pair + COW case
+        for run in ("cold", "warm"):
+            outs, _ = eng.run([
+                Request(prompt=p, max_new_tokens=n) for p, n in sub
+            ])
+            for o, (p, n) in zip(outs, sub):
+                np.testing.assert_array_equal(
+                    o.generated, _reference(params, cfg, p, n),
+                    err_msg=f"tp={tp} {run} request {o.uid} diverged",
+                )
+        assert eng.pool.used_count == eng.prefix_cache.cached_pages
+    finally:
+        ctx.destroy()

@@ -95,6 +95,25 @@ def test_continuous_refills_mid_stream_static_drains():
     assert len(drive(continuous=False)) == 0  # drains first
 
 
+def test_preempt_requeues_in_original_submit_order():
+    """Preempting several requests in ANY order re-queues them by
+    original submit order, ahead of never-admitted arrivals — FIFO
+    determinism survives preemption patterns (a bare appendleft would
+    reverse two same-tick preemptions)."""
+    sched = Scheduler(2, PagePool(33, 4), max_context=32)
+    a, b, c = _req(4, 4), _req(4, 4), _req(4, 4)
+    for r in (a, b, c):
+        sched.submit(r, now=0.0)
+    admitted = sched.admit(now=0.0)           # a, b take the slots
+    assert [r.uid for r in admitted] == [0, 1]
+    sched.preempt(b)                 # preempt in REVERSE order
+    sched.preempt(a)
+    assert [r.uid for r in sched.queue] == [0, 1, 2]
+    assert a.pages == [] and sched.pool.used_count == 0
+    readmitted = sched.admit(now=2.0)
+    assert [r.uid for r in readmitted] == [0, 1]
+
+
 def test_fifo_head_of_line_is_deterministic():
     """A small request behind a too-big head does NOT jump the queue —
     admission order is a pure function of submit order."""
