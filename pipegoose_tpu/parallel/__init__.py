@@ -1,14 +1,18 @@
 from pipegoose_tpu.parallel.auto import make_auto_train_step
 from pipegoose_tpu.parallel.hybrid import (
+    hybrid_step_kwargs,
     make_hybrid_train_step,
+    parallel_context_sizes,
     sync_replicated_grads,
     train_step_intended_specs,
     zero_state_spec,
 )
 
 __all__ = [
+    "hybrid_step_kwargs",
     "make_hybrid_train_step",
     "make_auto_train_step",
+    "parallel_context_sizes",
     "sync_replicated_grads",
     "train_step_intended_specs",
     "zero_state_spec",

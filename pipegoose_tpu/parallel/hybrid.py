@@ -110,6 +110,35 @@ def train_step_intended_specs(
     return specs + ((P(),) if with_rng else ())
 
 
+def parallel_context_sizes(candidate: Any) -> dict:
+    """``ParallelContext`` kwargs implied by one planner candidate
+    (duck-typed: anything with ``dp``/``tp``/``pp``/``ep`` attributes,
+    normally a ``pipegoose_tpu.planner.Candidate``). The enumeration
+    hook lives HERE so the layout-to-mesh mapping has one source of
+    truth — the planner, the CLIs, and tests all build their contexts
+    through it instead of hand-assembling axis sizes."""
+    return dict(
+        tensor_parallel_size=int(getattr(candidate, "tp", 1)),
+        pipeline_parallel_size=int(getattr(candidate, "pp", 1)),
+        data_parallel_size=int(getattr(candidate, "dp", 1)),
+        expert_parallel_size=int(getattr(candidate, "ep", 1)),
+    )
+
+
+def hybrid_step_kwargs(candidate: Any) -> dict:
+    """:func:`make_hybrid_train_step` kwargs implied by one planner
+    candidate: the gradient wire precision, the overlap declaration,
+    and — for a pipelined candidate — the ``("pipe",)`` grad sync the
+    stage-partial gradients need (test_3d_parallel's composition)."""
+    kw: dict = dict(
+        grad_comm=getattr(candidate, "grad_comm", None),
+        overlap_tp=bool(getattr(candidate, "overlap_tp", False)),
+    )
+    if int(getattr(candidate, "pp", 1)) > 1:
+        kw["grad_sync_axes"] = ("pipe",)
+    return kw
+
+
 def _set_comm_gauges(params, mesh, optimizer, comm_mode: str,
                      overlap_tp: bool, dp_axis: str) -> None:
     """Export the communication-engine config/savings next to the MFU

@@ -1,0 +1,166 @@
+"""BLOOM builder for the planner: candidate -> compilable hybrid step.
+
+Maps one :class:`~pipegoose_tpu.planner.space.Candidate` onto the SAME
+production machinery the trainer uses — ``make_hybrid_train_step`` with
+``bloom.loss_fn`` (dense) or ``bloom.loss_fn_pp`` (pipelined,
+``grad_sync_axes=("pipe",)`` like tests/test_3d_parallel.py) — via the
+enumeration hooks in ``parallel/hybrid.py``
+(``parallel_context_sizes``/``hybrid_step_kwargs``), so the planner
+scores the real compiled program, not a proxy.
+
+Shape-only throughout: params come from ``jax.eval_shape`` over
+``init_params`` + ``pad_for_tp`` (nothing materializes — a bloom-176b
+plan needs no 350 GB of host RAM), and the step is never executed, only
+lowered+compiled by the doctor.
+"""
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+from typing import Any, Dict, Optional
+
+from pipegoose_tpu.planner.space import Candidate
+
+
+class BloomPlanModel:
+    """``builder`` protocol implementation (see planner/planner.py) for
+    the BLOOM family at one (batch, seq) workload."""
+
+    def __init__(self, config: Any, batch: int, seq: int,
+                 lr: float = 1e-3):
+        self.config = config
+        self.batch = int(batch)
+        self.seq = int(seq)
+        self.lr = lr
+
+    @property
+    def tokens_per_step(self) -> int:
+        return self.batch * self.seq
+
+    def describe(self) -> Dict[str, Any]:
+        cfg = self.config
+        return {
+            "name": f"bloom(v={cfg.vocab_size},h={cfg.hidden_size},"
+                    f"L={cfg.n_layer},heads={cfg.n_head})",
+            "vocab_size": cfg.vocab_size,
+            "hidden_size": cfg.hidden_size,
+            "n_layer": cfg.n_layer,
+            "n_head": cfg.n_head,
+            "dtype": str(getattr(cfg, "dtype", "float32")),
+            "batch": self.batch,
+            "seq": self.seq,
+        }
+
+    # -- model-divisibility pruning ---------------------------------------
+
+    def validity(self, c: Candidate) -> Optional[str]:
+        cfg = self.config
+        if c.ep > 1:
+            return "dense BLOOM has no expert axis (ep > 1 needs a MoE model)"
+        if cfg.n_head % c.tp:
+            return f"n_head {cfg.n_head} not divisible by tp={c.tp}"
+        if cfg.hidden_size % c.tp:
+            return f"hidden {cfg.hidden_size} not divisible by tp={c.tp}"
+        if self.batch % c.dp:
+            return f"batch {self.batch} not divisible by dp={c.dp}"
+        if c.overlap_tp and self.seq % c.tp:
+            return (f"overlap_tp needs seq % tp == 0 "
+                    f"(seq={self.seq}, tp={c.tp})")
+        if c.pp > 1:
+            if cfg.n_layer % c.pp:
+                return f"n_layer {cfg.n_layer} not divisible by pp={c.pp}"
+            local_batch = self.batch // c.dp
+            if local_batch % c.n_microbatches:
+                return (f"per-replica batch {local_batch} not divisible "
+                        f"by {c.n_microbatches} microbatches")
+        return None
+
+    # -- step construction --------------------------------------------------
+
+    @contextlib.contextmanager
+    def build(self, c: Candidate):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        from pipegoose_tpu.distributed import ParallelContext
+        from pipegoose_tpu.models import bloom
+        from pipegoose_tpu.optim.zero import DistributedOptimizer
+        from pipegoose_tpu.parallel import (
+            hybrid_step_kwargs,
+            make_hybrid_train_step,
+            parallel_context_sizes,
+            train_step_intended_specs,
+        )
+
+        cfg = dataclasses.replace(
+            self.config, overlap_tp=c.overlap_tp, remat=c.remat
+        )
+
+        # shape-only padded params; the post-padding config is derived
+        # from the SDS embedding shape (pad_for_tp:525-533's math)
+        def _padded(key):
+            p = bloom.init_params(cfg, key)
+            p, _ = bloom.pad_for_tp(p, cfg, c.tp)
+            return p
+
+        p_sds = jax.eval_shape(_padded, jax.random.PRNGKey(0))
+        v_padded = p_sds["embed"]["weight"].shape[0]
+        if v_padded != cfg.vocab_size:
+            cfg = dataclasses.replace(
+                cfg, vocab_size=v_padded,
+                valid_vocab_size=cfg.valid_vocab_size or cfg.vocab_size,
+            )
+
+        ctx = ParallelContext(**parallel_context_sizes(c))
+        try:
+            if c.pp > 1:
+                specs = bloom.pp_specs(p_sds)
+                n_micro = c.n_microbatches
+
+                def loss_fn(p, ids):
+                    return bloom.loss_fn_pp(
+                        p, ids, None, ids, cfg, n_micro,
+                        tp_axis="tensor", pipe_axis="pipe",
+                    )
+            else:
+                specs = bloom.tp_specs(p_sds)
+
+                def loss_fn(p, ids):
+                    return bloom.loss_fn(
+                        p, ids, None, ids, cfg, tp_axis="tensor"
+                    )
+
+            opt = DistributedOptimizer(
+                optax.adam(self.lr), axis_name="data",
+                grad_comm=c.grad_comm,
+            )
+            init_fn, make_step = make_hybrid_train_step(
+                loss_fn, specs, opt, ctx, **hybrid_step_kwargs(c)
+            )
+            opt_sds = jax.eval_shape(init_fn, p_sds)
+            step = make_step(p_sds)
+            batch_sds = jax.ShapeDtypeStruct(
+                (self.batch, self.seq), jnp.int32
+            )
+            bubble = 0.0
+            if c.pp > 1:
+                from pipegoose_tpu.nn.pipeline_parallel.scheduler import (
+                    GPipeScheduler,
+                )
+
+                bubble = GPipeScheduler(
+                    c.n_microbatches, c.pp
+                ).bubble_fraction
+            yield {
+                "step": step,
+                "args": (p_sds, opt_sds, batch_sds),
+                "intended": train_step_intended_specs(
+                    opt, p_sds, specs, ctx.mesh
+                ),
+                "labels": ("params", "opt_state", "batch"),
+                "mesh": ctx.mesh,
+                "bubble_fraction": bubble,
+            }
+        finally:
+            ctx.destroy()

@@ -1,0 +1,179 @@
+"""Static cost model: compiled program -> predicted step time.
+
+Everything here is computed from artifacts a shape-only lower+compile
+already produced (telemetry/doctor.py) plus the per-chip spec tables
+next to ``PEAK_FLOPS`` (telemetry/derived.py) — no hardware, no
+execution:
+
+- compute seconds: XLA cost-analysis FLOPs of the per-device SPMD
+  program over the chip's peak;
+- comm seconds: the doctor's per-collective wire-byte estimates
+  (``estimated_wire_bytes`` — payload conventions normalized per op)
+  grouped by the mesh axes each collective spans, divided by the
+  fabric bandwidth those axes ride (ICI inside a slice, DCI for
+  cross-slice axes like the DiLoCo outer loop); the ring-overlap path
+  hides a configured fraction of the tensor-axis traffic behind the
+  partial matmuls;
+- pipeline bubble: the analytic idle fraction from the schedulers
+  (``GPipeScheduler``/``OneFOneBScheduler.bubble_fraction``) inflates
+  the busy time;
+- HBM feasibility: the doctor's per-device peak vs the chip budget —
+  an infeasible candidate is pruned with the numbers in the reason.
+
+The model ranks layouts; it does not promise wall-clock accuracy. The
+``sweep_tpu_perf.py plan`` mode measures the top-K and records the
+predicted-vs-measured delta next to the plan artifact (docs/planner.md).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+from pipegoose_tpu.telemetry.derived import (
+    dci_bytes_per_s_for,
+    hbm_bytes_for,
+    ici_bytes_per_s_for,
+    peak_flops_for,
+)
+from pipegoose_tpu.telemetry.doctor import wire_bytes_by_axes
+
+
+@dataclasses.dataclass
+class CostModel:
+    """Per-chip budgets + scoring knobs for one target device kind."""
+
+    device_kind: str = "cpu"
+    peak_flops: float = 1e12
+    ici_bytes_per_s: float = 10e9
+    dci_bytes_per_s: float = 1e9
+    hbm_bytes: float = 16 * 1024**3
+    # mesh axes that ride the data-center network instead of ICI
+    dci_axes: Tuple[str, ...] = ("diloco",)
+    # fraction of tensor-axis wire time the ring collective-matmul
+    # overlap hides behind partial matmuls (docs/comm.md measured the
+    # hops interleaving with tp-1 partial matmuls; 0.75 is the planner's
+    # deliberately conservative default)
+    overlap_hidden_fraction: float = 0.75
+
+    @classmethod
+    def for_device(
+        cls,
+        device_kind: Optional[str] = None,
+        hbm_bytes: Optional[float] = None,
+    ) -> "CostModel":
+        """Budgets from the spec tables (telemetry/derived.py) for a
+        device-kind string; defaults to the first visible device.
+        ``hbm_bytes`` overrides the table (plan for a chip you don't
+        have)."""
+        if device_kind is None:
+            import jax
+
+            dev = jax.devices()[0]
+            device_kind = getattr(dev, "device_kind", dev.platform)
+        return cls(
+            device_kind=device_kind,
+            peak_flops=peak_flops_for(device_kind),
+            ici_bytes_per_s=ici_bytes_per_s_for(device_kind),
+            dci_bytes_per_s=dci_bytes_per_s_for(device_kind),
+            hbm_bytes=(float(hbm_bytes) if hbm_bytes is not None
+                       else hbm_bytes_for(device_kind)),
+        )
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_json(cls, d: dict) -> "CostModel":
+        base = cls()
+        return cls(
+            device_kind=str(d.get("device_kind", base.device_kind)),
+            peak_flops=float(d.get("peak_flops", base.peak_flops)),
+            ici_bytes_per_s=float(d.get("ici_bytes_per_s",
+                                        base.ici_bytes_per_s)),
+            dci_bytes_per_s=float(d.get("dci_bytes_per_s",
+                                        base.dci_bytes_per_s)),
+            hbm_bytes=float(d.get("hbm_bytes", base.hbm_bytes)),
+            dci_axes=tuple(d.get("dci_axes", base.dci_axes)),
+            overlap_hidden_fraction=float(
+                d.get("overlap_hidden_fraction",
+                      base.overlap_hidden_fraction)),
+        )
+
+    def bandwidth_for_axes(self, axes: Tuple[str, ...]) -> float:
+        if any(ax in self.dci_axes for ax in axes):
+            return self.dci_bytes_per_s
+        return self.ici_bytes_per_s
+
+
+def hbm_check(report: Any, cost_model: CostModel) -> Optional[str]:
+    """None when the compiled program fits the chip, else the prune
+    reason with the numbers. The live backend ``bytes_limit`` wins
+    where the doctor saw one (a real TPU); the spec-table budget covers
+    fake-device planning."""
+    from pipegoose_tpu.telemetry.doctor import _fmt_bytes
+
+    budget = float(report.memory.hbm_limit or cost_model.hbm_bytes)
+    peak = float(report.memory.peak_bytes)
+    if peak > budget:
+        return (f"HBM-infeasible: per-device peak {_fmt_bytes(int(peak))} "
+                f"> budget {_fmt_bytes(int(budget))} "
+                f"({cost_model.device_kind})")
+    return None
+
+
+def score_breakdown(
+    candidate: Any,
+    report: Any,
+    cost_model: CostModel,
+    tokens_per_step: int,
+    bubble_fraction: float = 0.0,
+) -> Dict[str, Any]:
+    """The per-candidate score anatomy (docs/planner.md):
+
+    {"score" (predicted global tokens/s — the ranking key),
+     "step_seconds", "compute_seconds", "comm_seconds",
+     "comm_seconds_by_axes", "wire_bytes_by_axes", "bubble_fraction",
+     "flops_per_device", "hbm_peak_bytes", "hbm_budget_bytes",
+     "tokens_per_step"}.
+
+    All candidates score the SAME global batch, so the tokens/s ranking
+    is exactly the inverse step-time ranking.
+    """
+    # a backend without AOT cost analysis yields cost_flops=None
+    # (doctor.py treats it as advisory): the ranking then rests on comm
+    # time alone — carried as an explicit compute_modeled=False marker
+    # in the breakdown, and run_plan logs it, never a silent zero
+    compute_modeled = report.cost_flops is not None
+    flops = float(report.cost_flops or 0.0)
+    compute_s = flops / cost_model.peak_flops
+    wire = wire_bytes_by_axes(report)
+    comm_by_axes: Dict[str, float] = {}
+    wire_by_axes: Dict[str, int] = {}
+    overlap_on = bool(getattr(candidate, "overlap_tp", False))
+    for axes, nbytes in sorted(wire.items()):
+        t = nbytes / cost_model.bandwidth_for_axes(axes)
+        if overlap_on and axes == ("tensor",):
+            t *= 1.0 - cost_model.overlap_hidden_fraction
+        key = "+".join(axes) if axes else "?"
+        comm_by_axes[key] = comm_by_axes.get(key, 0.0) + t
+        wire_by_axes[key] = wire_by_axes.get(key, 0) + int(nbytes)
+    comm_s = sum(comm_by_axes.values())
+    busy_s = compute_s + comm_s
+    bubble = min(max(float(bubble_fraction), 0.0), 0.99)
+    step_s = busy_s / (1.0 - bubble) if busy_s > 0 else 0.0
+    score = tokens_per_step / step_s if step_s > 0 else 0.0
+    return {
+        "score": score,
+        "step_seconds": step_s,
+        "compute_modeled": compute_modeled,
+        "compute_seconds": compute_s,
+        "comm_seconds": comm_s,
+        "comm_seconds_by_axes": comm_by_axes,
+        "wire_bytes_by_axes": wire_by_axes,
+        "bubble_fraction": bubble,
+        "flops_per_device": flops,
+        "hbm_peak_bytes": int(report.memory.peak_bytes),
+        "hbm_budget_bytes": int(report.memory.hbm_limit
+                                or cost_model.hbm_bytes),
+        "tokens_per_step": int(tokens_per_step),
+    }

@@ -1,0 +1,259 @@
+"""PlanReport: the ranked, serializable output of one planner run.
+
+Same artifact discipline as the mesh doctor's reports
+(telemetry/doctor.py): dataclasses, ``to_json``/``from_json``
+round-trip, ``format_table`` for humans, and FORWARD-COMPATIBLE
+deserialization — every ``from_json`` picks known keys only, so a plan
+artifact written by a newer version (extra fields at any level) still
+loads in an older CLI's ``--check`` mode instead of crashing CI.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple
+
+from pipegoose_tpu.planner.space import Candidate, candidate_key
+from pipegoose_tpu.telemetry.doctor import DoctorReport, _fmt_bytes
+
+
+@dataclasses.dataclass
+class CandidateResult:
+    """One scored (or pruned) candidate."""
+
+    candidate: Candidate
+    feasible: bool
+    prune_reason: Optional[str] = None
+    score: Optional[float] = None        # predicted global tokens/s
+    breakdown: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    doctor: Optional[DoctorReport] = None
+    measured: Optional[Dict[str, Any]] = None   # sweep/bench fill this in
+
+    @property
+    def name(self) -> str:
+        return self.candidate.name
+
+    def to_json(self) -> dict:
+        return {
+            "candidate": self.candidate.to_json(),
+            "feasible": self.feasible,
+            "prune_reason": self.prune_reason,
+            "score": self.score,
+            "breakdown": dict(self.breakdown),
+            "doctor": self.doctor.to_json() if self.doctor else None,
+            "measured": dict(self.measured) if self.measured else None,
+        }
+
+    @classmethod
+    def from_json(cls, d: dict) -> "CandidateResult":
+        return cls(
+            candidate=Candidate.from_json(d["candidate"]),
+            feasible=bool(d["feasible"]),
+            prune_reason=d.get("prune_reason"),
+            score=(None if d.get("score") is None else float(d["score"])),
+            breakdown=dict(d.get("breakdown") or {}),
+            doctor=(DoctorReport.from_json(d["doctor"])
+                    if d.get("doctor") else None),
+            measured=(dict(d["measured"]) if d.get("measured") else None),
+        )
+
+
+@dataclasses.dataclass
+class PlanReport:
+    """Ranked candidates (feasible best-first, then pruned) for one
+    model/topology, plus the budgets they were scored against."""
+
+    device_kind: str
+    n_devices: int
+    model: Dict[str, Any]
+    tokens_per_step: int
+    cost_model: Dict[str, Any]
+    candidates: List[CandidateResult]
+
+    # -- views -------------------------------------------------------------
+
+    @property
+    def ranked(self) -> List[CandidateResult]:
+        return [c for c in self.candidates if c.feasible]
+
+    @property
+    def pruned(self) -> List[CandidateResult]:
+        return [c for c in self.candidates if not c.feasible]
+
+    @property
+    def top(self) -> Optional[CandidateResult]:
+        r = self.ranked
+        return r[0] if r else None
+
+    def find(self, want: Candidate) -> Optional[CandidateResult]:
+        key = candidate_key(want)
+        for c in self.candidates:
+            if candidate_key(c.candidate) == key:
+                return c
+        return None
+
+    def sort(self) -> None:
+        """Feasible candidates by score descending, pruned last (stable
+        within each group)."""
+        self.candidates.sort(
+            key=lambda c: (not c.feasible, -(c.score or 0.0))
+        )
+
+    # -- check gate --------------------------------------------------------
+
+    def check(
+        self, current: Candidate, tolerance: float = 0.25
+    ) -> Tuple[bool, str]:
+        """CI gate semantics: the currently-configured layout must be in
+        the plan, feasible, and score at least ``(1 - tolerance)`` of
+        the top-1. Returns (ok, human-readable message). The configured
+        layout is canonicalized first (space.py) — a runtime-no-op flag
+        like int8 wire on dp=1 matches its canonical twin instead of
+        reading as 'not in the plan'."""
+        from pipegoose_tpu.planner.space import canonicalize
+
+        current = canonicalize(current)
+        top = self.top
+        if top is None:
+            return False, "no feasible candidate in the plan"
+        cur = self.find(current)
+        if cur is None:
+            return False, (
+                f"configured layout {current.name} is not in the plan's "
+                f"candidate space ({len(self.candidates)} candidates)"
+            )
+        if not cur.feasible:
+            return False, (
+                f"configured layout {cur.name} is infeasible: "
+                f"{cur.prune_reason}"
+            )
+        floor = (1.0 - tolerance) * float(top.score or 0.0)
+        if (cur.score or 0.0) < floor:
+            return False, (
+                f"configured layout {cur.name} predicts "
+                f"{cur.score:,.0f} tokens/s < {1 - tolerance:.0%} of "
+                f"top-1 {top.name} ({top.score:,.0f} tokens/s) — "
+                f"re-plan or switch layouts"
+            )
+        return True, (
+            f"configured layout {cur.name} scores {cur.score:,.0f} "
+            f"tokens/s vs top-1 {top.name} {top.score:,.0f} "
+            f"(within {tolerance:.0%})"
+        )
+
+    # -- predicted vs measured ---------------------------------------------
+
+    def record_measurement(
+        self, candidate: Candidate, measured: Dict[str, Any]
+    ) -> Optional[CandidateResult]:
+        """Attach a measured result (e.g. ``{"tokens_per_sec": x}``)
+        to the matching candidate, recording the predicted-vs-measured
+        delta in the artifact. Returns the updated result, or None if
+        the candidate is not in the plan."""
+        cur = self.find(candidate)
+        if cur is None:
+            return None
+        m = dict(measured)
+        if cur.score and m.get("tokens_per_sec"):
+            m["predicted_tokens_per_sec"] = float(cur.score)
+            m["measured_over_predicted"] = (
+                float(m["tokens_per_sec"]) / float(cur.score)
+            )
+        cur.measured = m
+        return cur
+
+    def predicted_vs_measured(self) -> Dict[str, Any]:
+        """Summary of every measured candidate: per-candidate ratios
+        plus whether the predicted-best and measured-best agree — the
+        regression signal the sweep records next to the BENCH artifacts."""
+        rows = [c for c in self.candidates if c.measured
+                and c.measured.get("tokens_per_sec") is not None]
+        if not rows:
+            return {"measured": 0}
+        best_measured = max(
+            rows, key=lambda c: float(c.measured["tokens_per_sec"])
+        )
+        scored = [c for c in rows if c.score]
+        best_predicted = (max(scored, key=lambda c: float(c.score))
+                          if scored else None)
+        return {
+            "measured": len(rows),
+            "predicted_best": best_predicted.name if best_predicted else None,
+            "measured_best": best_measured.name,
+            "rank_agreement": bool(
+                best_predicted is not None
+                and best_predicted.name == best_measured.name
+            ),
+            "per_candidate": {
+                c.name: {
+                    "predicted": c.score,
+                    "measured": float(c.measured["tokens_per_sec"]),
+                    "measured_over_predicted":
+                        c.measured.get("measured_over_predicted"),
+                }
+                for c in rows
+            },
+        }
+
+    # -- serialization -----------------------------------------------------
+
+    def to_json(self) -> dict:
+        return {
+            "version": 1,
+            "device_kind": self.device_kind,
+            "n_devices": self.n_devices,
+            "model": dict(self.model),
+            "tokens_per_step": self.tokens_per_step,
+            "cost_model": dict(self.cost_model),
+            "candidates": [c.to_json() for c in self.candidates],
+        }
+
+    @classmethod
+    def from_json(cls, d: dict) -> "PlanReport":
+        return cls(
+            device_kind=str(d["device_kind"]),
+            n_devices=int(d["n_devices"]),
+            model=dict(d.get("model") or {}),
+            tokens_per_step=int(d.get("tokens_per_step", 0)),
+            cost_model=dict(d.get("cost_model") or {}),
+            candidates=[CandidateResult.from_json(c)
+                        for c in d.get("candidates", [])],
+        )
+
+    # -- humans ------------------------------------------------------------
+
+    def format_table(self, top_k: Optional[int] = None) -> str:
+        from pipegoose_tpu.telemetry.doctor import _align
+
+        lines = [
+            f"plan: {self.n_devices} x {self.device_kind}  "
+            f"model={self.model.get('name', '?')}  "
+            f"tokens/step={self.tokens_per_step}",
+            "",
+        ]
+        ranked = self.ranked
+        shown = ranked if top_k is None else ranked[:top_k]
+        if shown:
+            rows = [("#", "candidate", "pred tok/s", "compute",
+                     "comm", "bubble", "hbm peak")]
+            for i, c in enumerate(shown):
+                b = c.breakdown
+                rows.append((
+                    str(i + 1), c.name, f"{c.score:,.0f}",
+                    f"{b.get('compute_seconds', 0) * 1e3:.2f}ms",
+                    f"{b.get('comm_seconds', 0) * 1e3:.2f}ms",
+                    f"{b.get('bubble_fraction', 0):.0%}",
+                    _fmt_bytes(b.get("hbm_peak_bytes", 0)),
+                ))
+            lines += _align(rows)
+            if top_k is not None and len(ranked) > top_k:
+                lines.append(f"  ... {len(ranked) - top_k} more ranked "
+                             f"candidate(s)")
+        else:
+            lines.append("  (no feasible candidate)")
+        pruned = self.pruned
+        if pruned:
+            lines += ["", f"pruned ({len(pruned)}):"]
+            lines += _align([("candidate", "reason")] + [
+                (c.name, c.prune_reason or "?") for c in pruned
+            ])
+        return "\n".join(lines)

@@ -1,0 +1,188 @@
+"""Candidate layout space: every way to run a model on N chips.
+
+GSPMD (arxiv 2105.04663) and Mesh-TensorFlow (arxiv 1811.02084) frame
+layout choice as *the* scaling decision; this module makes the choice
+set explicit and finite. A :class:`Candidate` is one point in the
+(dp, tp, pp, ep) x overlap x grad_comm x remat space;
+:func:`enumerate_candidates` walks the device count's factorizations
+crossed with the engine options, applying only LAYOUT-level dedup rules
+(an overlap flag on tp=1 or a wire format on dp=1 changes nothing, so
+those duplicates are skipped, not pruned). Model-specific feasibility
+(head divisibility, sequence divisibility for the overlap path, HBM)
+belongs to the builder/planner, which prunes WITH A REASON — the
+enumeration itself never silently drops a distinct config.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterable, List, Optional, Sequence, Tuple
+
+GRAD_COMMS: Tuple[str, ...] = ("fp32", "bf16", "int8")
+
+
+@dataclasses.dataclass(frozen=True)
+class Candidate:
+    """One parallelism layout + engine-option choice."""
+
+    dp: int = 1
+    tp: int = 1
+    pp: int = 1
+    ep: int = 1
+    overlap_tp: bool = False
+    grad_comm: str = "fp32"
+    remat: bool = True
+    n_microbatches: int = 1   # pipeline microbatches; meaningful when pp > 1
+
+    def __post_init__(self):
+        for ax in ("dp", "tp", "pp", "ep"):
+            if getattr(self, ax) < 1:
+                raise ValueError(f"{ax} must be >= 1, got {getattr(self, ax)}")
+        if self.grad_comm not in GRAD_COMMS:
+            raise ValueError(
+                f"grad_comm must be one of {GRAD_COMMS}, got {self.grad_comm!r}"
+            )
+
+    @property
+    def n_devices(self) -> int:
+        return self.dp * self.tp * self.pp * self.ep
+
+    @property
+    def name(self) -> str:
+        parts = [f"dp{self.dp}", f"tp{self.tp}"]
+        if self.pp > 1:
+            parts.append(f"pp{self.pp}")
+        if self.ep > 1:
+            parts.append(f"ep{self.ep}")
+        s = "x".join(parts)
+        if self.pp > 1:
+            s += f"+m{self.n_microbatches}"
+        if self.overlap_tp:
+            s += "+overlap"
+        if self.grad_comm != "fp32":
+            s += f"+{self.grad_comm}"
+        if not self.remat:
+            s += "+noremat"
+        return s
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_json(cls, d: dict) -> "Candidate":
+        # known keys only, and VALUES survive too: a wire format this
+        # version doesn't know (a newer artifact's grad_comm) loads
+        # losslessly instead of tripping __post_init__ — deserialization
+        # must not enforce the constructor's enum (forward compat)
+        gc = str(d.get("grad_comm", "fp32"))
+        c = cls(
+            dp=int(d.get("dp", 1)), tp=int(d.get("tp", 1)),
+            pp=int(d.get("pp", 1)), ep=int(d.get("ep", 1)),
+            overlap_tp=bool(d.get("overlap_tp", False)),
+            grad_comm=gc if gc in GRAD_COMMS else "fp32",
+            remat=bool(d.get("remat", True)),
+            n_microbatches=int(d.get("n_microbatches", 1)),
+        )
+        if gc not in GRAD_COMMS:
+            object.__setattr__(c, "grad_comm", gc)
+        return c
+
+
+def canonicalize(c: Candidate) -> Candidate:
+    """The canonical twin of a candidate: options that are layout
+    no-ops dropped — overlap needs a tensor axis and the dense path,
+    a non-fp32 wire format needs a data axis, microbatches need a
+    pipeline. Enumeration emits only canonical forms; a configured
+    layout must be canonicalized the same way before matching against
+    a plan (``PlanReport.check`` does this), or a runtime-no-op flag
+    would read as 'not in the plan'."""
+    return Candidate(
+        dp=c.dp, tp=c.tp, pp=c.pp, ep=c.ep,
+        overlap_tp=c.overlap_tp and c.tp > 1 and c.pp == 1,
+        grad_comm=c.grad_comm if c.dp > 1 else "fp32",
+        remat=c.remat,
+        n_microbatches=c.n_microbatches if c.pp > 1 else 1,
+    )
+
+
+def divisors(n: int) -> List[int]:
+    return [d for d in range(1, n + 1) if n % d == 0]
+
+
+def mesh_factorizations(
+    n_devices: int,
+    pp_sizes: Sequence[int] = (1,),
+    ep_sizes: Sequence[int] = (1,),
+) -> List[Tuple[int, int, int, int]]:
+    """All (dp, tp, pp, ep) splits of ``n_devices``: for every requested
+    pp/ep size that divides the device count, every (dp, tp) split of
+    the remainder. Deterministic order — dp descending (the pure-DP
+    layout first, matching how operators usually escalate)."""
+    out: List[Tuple[int, int, int, int]] = []
+    for pp in pp_sizes:
+        for ep in ep_sizes:
+            if pp < 1 or ep < 1 or n_devices % (pp * ep):
+                continue
+            rem = n_devices // (pp * ep)
+            for tp in divisors(rem):
+                out.append((rem // tp, tp, pp, ep))
+    return out
+
+
+def enumerate_candidates(
+    n_devices: int,
+    pp_sizes: Sequence[int] = (1,),
+    ep_sizes: Sequence[int] = (1,),
+    grad_comms: Sequence[str] = GRAD_COMMS,
+    overlap: Sequence[bool] = (False, True),
+    remat: Sequence[bool] = (True, False),
+    n_microbatches: int = 2,
+) -> List[Candidate]:
+    """The candidate list the planner scores. Layout-level dedup only:
+
+    - ``overlap_tp`` needs a tensor axis (> 1) and the dense path
+      (pp == 1 — the PP composition ignores the flag), so those combos
+      collapse onto their overlap-off twin;
+    - a non-fp32 ``grad_comm`` with dp == 1 reduces over a size-1 axis
+      (no wire), so it collapses onto fp32.
+
+    Everything else — including configs a given model cannot run — is
+    emitted, for the planner to prune with a stated reason.
+    """
+    seen = set()
+    out: List[Candidate] = []
+    for dp, tp, pp, ep in mesh_factorizations(n_devices, pp_sizes, ep_sizes):
+        for ovl in overlap:
+            for gc in grad_comms:
+                for rm in remat:
+                    # canonicalize instead of skipping: a no-op option
+                    # collapses onto its canonical twin even when a
+                    # restricted sweep (e.g. overlap=(True,)) would not
+                    # enumerate that twin itself — every (dp,tp,pp,ep)
+                    # split always appears
+                    cand = canonicalize(Candidate(
+                        dp=dp, tp=tp, pp=pp, ep=ep, overlap_tp=ovl,
+                        grad_comm=gc, remat=rm,
+                        n_microbatches=n_microbatches,
+                    ))
+                    if cand.name not in seen:
+                        seen.add(cand.name)
+                        out.append(cand)
+    return out
+
+
+def candidate_key(c: Candidate) -> tuple:
+    """Identity tuple for matching a configured layout against a plan's
+    results (dataclass equality would also compare ``n_microbatches``
+    on non-pipelined candidates, where it is meaningless)."""
+    return (c.dp, c.tp, c.pp, c.ep, c.overlap_tp, c.grad_comm, c.remat,
+            c.n_microbatches if c.pp > 1 else 1)
+
+
+def find_candidate(
+    candidates: Iterable[Candidate], want: Candidate
+) -> Optional[Candidate]:
+    key = candidate_key(want)
+    for c in candidates:
+        if candidate_key(c) == key:
+            return c
+    return None

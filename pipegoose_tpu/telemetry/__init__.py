@@ -39,8 +39,14 @@ from pipegoose_tpu.telemetry.chrometrace import (
     trace_from_jsonl,
 )
 from pipegoose_tpu.telemetry.derived import (
+    HBM_BYTES,
+    PEAK_DCI_BYTES,
     PEAK_FLOPS,
+    PEAK_ICI_BYTES,
     collective_bytes,
+    dci_bytes_per_s_for,
+    hbm_bytes_for,
+    ici_bytes_per_s_for,
     compiled_step_stats,
     hbm_utilization,
     iter_collectives,
@@ -60,6 +66,7 @@ from pipegoose_tpu.telemetry.doctor import (
     diagnose,
     estimated_wire_bytes,
     set_doctor_gauges,
+    wire_bytes_by_axes,
     wire_bytes_by_op,
 )
 from pipegoose_tpu.telemetry.exporters import (
@@ -89,7 +96,10 @@ __all__ = [
     "JSONLExporter",
     "MemoryReport",
     "MetricsRegistry",
+    "HBM_BYTES",
+    "PEAK_DCI_BYTES",
     "PEAK_FLOPS",
+    "PEAK_ICI_BYTES",
     "PrometheusTextfileExporter",
     "ShardingRegressionError",
     "ShardingReport",
@@ -115,7 +125,11 @@ __all__ = [
     "register_pipeline_gauges",
     "set_doctor_gauges",
     "estimated_wire_bytes",
+    "wire_bytes_by_axes",
     "wire_bytes_by_op",
+    "dci_bytes_per_s_for",
+    "hbm_bytes_for",
+    "ici_bytes_per_s_for",
     "span",
     "span_events_to_trace",
     "step_flops",

@@ -40,18 +40,79 @@ PEAK_FLOPS: Dict[str, float] = {
     "cpu": 1e12,
 }
 
+# Peer tables to PEAK_FLOPS: per-chip interconnect bandwidth and HBM
+# capacity — the denominators of the parallelism planner's static cost
+# model (pipegoose_tpu/planner/). ICI is the intra-slice fabric every
+# mesh axis rides by default; DCI is the data-center network a
+# cross-slice axis (e.g. the DiLoCo outer loop) pays instead. Aggregate
+# per-chip numbers from the public TPU system specs (ICI Gbps -> B/s);
+# "cpu" rows are nominal placeholders so fake-device planning yields
+# finite, clearly-not-real times with the same code path.
+PEAK_ICI_BYTES: Dict[str, float] = {
+    "v5 lite": 200e9,   # v5e: 1600 Gbps aggregate
+    "v5e": 200e9,
+    "v5p": 600e9,       # 4800 Gbps
+    "v6 lite": 448e9,   # v6e: 3584 Gbps
+    "v6e": 448e9,
+    "v4": 300e9,        # 2400 Gbps
+    "cpu": 10e9,
+}
 
-def peak_flops_for(device_kind: Optional[str] = None) -> float:
-    """Peak FLOP/s for a device-kind string (substring match, like
-    bench.py always did); defaults to the first visible device."""
+PEAK_DCI_BYTES: Dict[str, float] = {
+    "v5 lite": 25e9,
+    "v5e": 25e9,
+    "v5p": 25e9,
+    "v6 lite": 25e9,
+    "v6e": 25e9,
+    "v4": 25e9,
+    "cpu": 1e9,
+}
+
+HBM_BYTES: Dict[str, float] = {
+    "v5 lite": 16 * 1024**3,
+    "v5e": 16 * 1024**3,
+    "v5p": 95 * 1024**3,
+    "v6 lite": 32 * 1024**3,
+    "v6e": 32 * 1024**3,
+    "v4": 32 * 1024**3,
+    "cpu": 16 * 1024**3,
+}
+
+
+def _kind_lookup(table: Dict[str, float], device_kind: Optional[str],
+                 default: float) -> float:
     if device_kind is None:
         dev = jax.devices()[0]
         device_kind = getattr(dev, "device_kind", dev.platform)
     kind = device_kind.lower()
-    for k, v in PEAK_FLOPS.items():
+    for k, v in table.items():
         if k in kind:
             return v
-    return 1e12
+    return default
+
+
+def peak_flops_for(device_kind: Optional[str] = None) -> float:
+    """Peak FLOP/s for a device-kind string (substring match, like
+    bench.py always did); defaults to the first visible device."""
+    return _kind_lookup(PEAK_FLOPS, device_kind, 1e12)
+
+
+def ici_bytes_per_s_for(device_kind: Optional[str] = None) -> float:
+    """Per-chip intra-slice interconnect bandwidth (B/s) for a
+    device-kind string; defaults to the first visible device."""
+    return _kind_lookup(PEAK_ICI_BYTES, device_kind, 10e9)
+
+
+def dci_bytes_per_s_for(device_kind: Optional[str] = None) -> float:
+    """Per-chip cross-slice (data-center network) bandwidth (B/s)."""
+    return _kind_lookup(PEAK_DCI_BYTES, device_kind, 1e9)
+
+
+def hbm_bytes_for(device_kind: Optional[str] = None) -> float:
+    """Per-chip HBM capacity (bytes) from the spec table — the planner's
+    feasibility budget where the backend reports no live ``bytes_limit``
+    (fake CPU devices report none)."""
+    return _kind_lookup(HBM_BYTES, device_kind, 16 * 1024**3)
 
 
 def mfu(flops_per_step: float, step_seconds: float,

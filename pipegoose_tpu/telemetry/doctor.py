@@ -272,15 +272,26 @@ class DoctorReport:
 
     sharding: ShardingReport
     memory: MemoryReport
+    # XLA cost-analysis FLOPs of the compiled (per-device, SPMD)
+    # program — the planner's compute-time numerator. None where the
+    # backend reports no cost analysis, and on reports deserialized
+    # from artifacts written before the field existed.
+    cost_flops: Optional[float] = None
 
     def to_json(self) -> dict:
         return {"sharding": self.sharding.to_json(),
-                "memory": self.memory.to_json()}
+                "memory": self.memory.to_json(),
+                "cost_flops": self.cost_flops}
 
     @classmethod
     def from_json(cls, d: dict) -> "DoctorReport":
+        # forward compat: pick known keys only — a plan/doctor artifact
+        # written by a NEWER version (extra fields at any level) must
+        # still load here, e.g. in the CLI's --check mode
         return cls(sharding=ShardingReport.from_json(d["sharding"]),
-                   memory=MemoryReport.from_json(d["memory"]))
+                   memory=MemoryReport.from_json(d["memory"]),
+                   cost_flops=(None if d.get("cost_flops") is None
+                               else float(d["cost_flops"])))
 
     def format_table(self, max_rows: int = 32) -> str:
         return (self.sharding.format_table(max_rows=max_rows)
@@ -734,7 +745,17 @@ def diagnose(
         groups=groups, output_bytes=out_bytes_per_device, temp_bytes=temp,
         peak_bytes=int(peak), source=source, hbm_limit=hbm_limit, top=top,
     )
-    return DoctorReport(sharding=sharding_report, memory=memory_report)
+    cost_flops = None
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        f = dict(ca or {}).get("flops")
+        cost_flops = float(f) if f is not None else None
+    except Exception:  # noqa: BLE001 - cost analysis is advisory
+        pass
+    return DoctorReport(sharding=sharding_report, memory=memory_report,
+                        cost_flops=cost_flops)
 
 
 # -- wire-byte estimation --------------------------------------------------
@@ -779,6 +800,26 @@ def estimated_wire_bytes(
     if op == "all-to-all":
         return b * (g - 1) // g
     return b  # collective-permute and friends: one hop of the payload
+
+
+def wire_bytes_by_axes(report: Any) -> Dict[Tuple[str, ...], int]:
+    """{mesh-axes tuple -> estimated per-device wire bytes} over a
+    report's collective schedule — the planner's comm-time numerator,
+    grouped by the fabric each axis group rides (ICI vs DCI). A
+    collective whose replica groups resolved to no axis subset lands
+    under the empty tuple ``()`` at its one-hop payload bytes
+    (``estimated_wire_bytes`` needs a group size and would report 0),
+    so unattributed traffic stays visible, never silently dropped."""
+    sr = _sharding_of(report)
+    out: Dict[Tuple[str, ...], int] = {}
+    for c in sr.collectives:
+        if c.mesh_axes:
+            key = tuple(c.mesh_axes)
+            nbytes = estimated_wire_bytes(c, sr.mesh_axes)
+        else:
+            key, nbytes = (), c.bytes
+        out[key] = out.get(key, 0) + nbytes
+    return out
 
 
 def wire_bytes_by_op(

@@ -10,10 +10,16 @@ repo's own test suite builds on (tests/conftest.py).
 """
 from __future__ import annotations
 
-import os
 from typing import Any
 
+from pipegoose_tpu.testing.fake_cluster import (  # noqa: F401
+    fake_cluster,
+    set_fake_device_flags,
+)
+
 __all__ = [
+    "fake_cluster",
+    "set_fake_device_flags",
     "force_cpu_devices",
     "old_jax_cpu_reason",
     "parameter_similarity",
@@ -42,28 +48,12 @@ def old_jax_cpu_reason(feature: str = "this check") -> Any:
 def force_cpu_devices(n: int = 8) -> None:
     """Pin the jax backend to ``n`` fake CPU devices.
 
-    Must run before the first backend touch. Handles the environments
-    where a sitecustomize pins ``jax_platforms`` to an accelerator
-    plugin (env vars alone are not enough once the plugin registered
-    itself) — the reference's ``spawn`` (testing/utils.py:32-41) plays
-    this role with OS processes.
+    Back-compat alias of :func:`fake_cluster` (the reference's
+    ``spawn``, testing/utils.py:32-41, plays this role with OS
+    processes); new code should call ``fake_cluster`` directly for the
+    returned device list and the ``require`` guard.
     """
-    import re
-
-    flag = f"--xla_force_host_platform_device_count={n}"
-    flags = os.environ.get("XLA_FLAGS", "")
-    if "xla_force_host_platform_device_count" in flags:
-        flags = re.sub(r"--xla_force_host_platform_device_count=\d+", flag, flags)
-    else:
-        flags = (flags + " " + flag).strip()
-    os.environ["XLA_FLAGS"] = flags
-    import jax
-
-    jax.config.update("jax_platforms", "cpu")
-    try:
-        jax.config.update("jax_num_cpu_devices", n)
-    except Exception:  # backend already initialized — flags had to be set earlier
-        pass
+    fake_cluster(n, require=False)
 
 
 def parameter_similarity(tree_a: Any, tree_b: Any, rtol: float = 1e-3) -> float:

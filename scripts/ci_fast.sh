@@ -16,6 +16,14 @@ cd "$(dirname "$0")/.."
 
 export JAX_PLATFORMS=cpu
 
+# Static jit-safety lint FIRST (scripts/lint_jit_safety.py): pure AST,
+# no jax import — host-sync calls (.item(), np.asarray, time.*,
+# jax.device_get) or bare excepts landing in a jit-path module fail in
+# about a second, before anything compiles. Known host-side modules
+# live in scripts/jit_safety_allowlist.txt.
+echo "== jit-safety lint =="
+python scripts/lint_jit_safety.py
+
 echo "== telemetry disabled-cost guards =="
 python -m pytest -q -p no:cacheprovider \
     "tests/telemetry/test_registry.py::test_disabled_overhead_under_5us" \
@@ -41,6 +49,19 @@ python scripts/mesh_doctor.py --fake-devices 8 --tp 2 --dp 4 \
 echo "== sharding-regression guard (mesh doctor, overlap variant) =="
 python scripts/mesh_doctor.py --fake-devices 8 --tp 2 --dp 4 \
     --overlap --grad-comm int8 --check --expect-ppermute --quiet
+
+# The parallelism-planner gate (pipegoose_tpu/planner/, ISSUE 7): rank
+# the layout space for the smoke model on 8 fake devices and verify the
+# expected-best config — the ring-overlap + int8-wire layout the comm
+# engine exists to make fastest — still scores within tolerance of the
+# planner's top-1. A regression that silently drops the ppermute
+# overlap or the compressed gradient wire format collapses that
+# config's relative score and exits 2 here, at compile time.
+echo "== parallelism-planner gate =="
+python scripts/plan_parallelism.py --fake-devices 8 \
+    --grad-comms fp32,int8 --remat-sweep on \
+    --check --tp 4 --dp 2 --overlap --grad-comm int8 \
+    --tolerance 0.3 --quiet
 
 echo "== fast tier =="
 python -m pytest tests/ -q -m fast -p no:cacheprovider \

@@ -4,18 +4,26 @@ config levers (remat, flash on/off) for the bloom-560m bench shape.
 Timing recipe per bench.py: loop inside jit (lax.scan), scalar fetch,
 RTT subtracted. One attach per run (tunnel is single-client).
 
-    python scripts/sweep_tpu_perf.py [kernel|model|fusedce|serving|comm]
+    python scripts/sweep_tpu_perf.py [kernel|model|fusedce|serving|comm|plan]
     python scripts/sweep_tpu_perf.py serving --prefix-replay   # ISSUE 6:
         # Zipf shared-prefix replay arms (baseline / chunked / cached /
         # cached+spec) per slot count instead of the continuous-vs-
         # static A/B
+    python scripts/sweep_tpu_perf.py plan   # ISSUE 7: static layout
+        # ranking (pipegoose_tpu/planner/), then measure ONLY the
+        # top-K (PLAN_TOP_K) and record predicted-vs-measured deltas
+        # in the PLAN_JSON artifact
 """
 from __future__ import annotations
 
 import functools
 import json
+import os as _os
 import sys
 import time
+
+# runnable from anywhere: the repo root is the import root
+sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
 
 import jax
 import jax.numpy as jnp
@@ -319,6 +327,128 @@ def comm_sweep():
     print(json.dumps(results))
 
 
+def plan_sweep():
+    """Planner-guided sweep (pipegoose_tpu/planner/, docs/planner.md):
+    rank the whole (dp, tp) x overlap x grad_comm layout space from
+    shape-only compiles, then MEASURE only the top-K candidates with
+    the comm-sweep timing recipe and record the predicted-vs-measured
+    delta per candidate in the plan artifact (``PLAN_JSON``, default
+    ``plan_report.json``) — the regression signal CI diffs next to the
+    BENCH artifacts. ``PLAN_TOP_K`` (default 3) bounds the measured
+    set; the static ranking itself costs no device time."""
+    import os
+
+    import optax
+
+    from pipegoose_tpu.distributed import ParallelContext
+    from pipegoose_tpu.models import bloom
+    from pipegoose_tpu.optim.zero import DistributedOptimizer
+    from pipegoose_tpu.parallel import (
+        hybrid_step_kwargs,
+        make_hybrid_train_step,
+        parallel_context_sizes,
+    )
+    from pipegoose_tpu.planner import (
+        BloomPlanModel,
+        CostModel,
+        enumerate_candidates,
+        run_plan,
+    )
+    from pipegoose_tpu.telemetry.doctor import report_json_dumps
+    from pipegoose_tpu.telemetry.exporters import atomic_write_text
+
+    ndev = len(jax.devices())
+    if ndev < 2:
+        print(json.dumps({"skipped": f"plan sweep needs >= 2 devices, "
+                                     f"have {ndev}"}))
+        return
+    on_tpu = jax.devices()[0].platform.lower() != "cpu"
+    if on_tpu:
+        cfg = bloom.BloomConfig.bloom_560m(
+            dtype=jnp.bfloat16, remat=True, use_flash=True
+        )
+        batch, seq, steps = 8, 1024, 8
+    else:
+        cfg = bloom.BloomConfig(
+            vocab_size=512, hidden_size=64, n_layer=2, n_head=4
+        )
+        batch, seq, steps = 8, 64, 3
+    top_k = int(os.environ.get("PLAN_TOP_K", "3"))
+
+    model = BloomPlanModel(cfg, batch=batch, seq=seq)
+    candidates = enumerate_candidates(
+        ndev, grad_comms=("fp32", "int8"), remat=(True,)
+    )
+    report = run_plan(model, candidates, CostModel.for_device())
+    print(report.format_table(top_k=10), flush=True)
+
+    def measure(c):
+        import dataclasses
+
+        ccfg = dataclasses.replace(
+            cfg, overlap_tp=c.overlap_tp, remat=c.remat
+        )
+        params = bloom.init_params(ccfg, jax.random.PRNGKey(0))
+        params, ccfg = bloom.pad_for_tp(params, ccfg, c.tp)
+        ctx = ParallelContext(**parallel_context_sizes(c))
+        try:
+            specs = bloom.tp_specs(params)
+            opt = DistributedOptimizer(
+                optax.adam(1e-4), axis_name="data", grad_comm=c.grad_comm
+            )
+
+            def loss_fn(p, ids, ccfg=ccfg):
+                return bloom.loss_fn(p, ids, None, ids, ccfg,
+                                     tp_axis="tensor")
+
+            init_fn, make_step = make_hybrid_train_step(
+                loss_fn, specs, opt, ctx, **hybrid_step_kwargs(c)
+            )
+            opt_state = init_fn(params)
+            step = make_step(params)
+            ids = jnp.asarray(np.random.RandomState(0).randint(
+                0, ccfg.valid_vocab_size or ccfg.vocab_size, (batch, seq)
+            ))
+            p = params
+            p, opt_state, loss = step(p, opt_state, ids)
+            float(loss)  # compile + warm
+            rtt = measure_rtt()
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                p, opt_state, loss = step(p, opt_state, ids)
+            float(loss)
+            dt = max(time.perf_counter() - t0 - rtt, 1e-9)
+        finally:
+            ctx.destroy()
+        return {"tokens_per_sec": round(batch * seq * steps / dt, 1),
+                "steps": steps}
+
+    # measure the top-K only — the whole point: static search prunes the
+    # space, hardware time goes to the few configs worth timing. NO
+    # batch backoff on OOM (unlike comm_sweep): the planner scored THIS
+    # workload, so a smaller batch would not be the predicted config —
+    # an OOM is recorded as the finding it is.
+    for res in report.ranked[:top_k]:
+        if res.candidate.pp > 1:
+            continue  # the timing loop above is the dense hybrid step
+        try:
+            measured = measure(res.candidate)
+        except Exception as e:  # noqa: BLE001
+            measured = {"error": f"{type(e).__name__}: {e}"[:300]}
+        if "tokens_per_sec" in measured:
+            report.record_measurement(res.candidate, measured)
+        print(res.name, json.dumps(measured), flush=True)
+
+    summary = report.predicted_vs_measured()
+    print(json.dumps({"predicted_vs_measured": summary}))
+    plan_path = os.environ.get("PLAN_JSON", "plan_report.json")
+    if plan_path:
+        atomic_write_text(plan_path, report_json_dumps(
+            report.to_json(), indent=1
+        ))
+        print(f"plan artifact: {plan_path}")
+
+
 def serving_sweep(prefix_replay: bool = False):
     """Continuous-batching vs naive padded serving (serving/engine.py)
     across slot counts on the real chip: the decode-step savings grow
@@ -386,7 +516,7 @@ if __name__ == "__main__":
     mode = sys.argv[1] if len(sys.argv) > 1 else "kernel"
     modes = {"kernel": kernel_sweep, "model": model_sweep,
              "fusedce": fusedce_sweep, "serving": serving_sweep,
-             "comm": comm_sweep}
+             "comm": comm_sweep, "plan": plan_sweep}
     if mode not in modes:
         raise SystemExit(f"unknown mode {mode!r}; pick one of {sorted(modes)}")
     if mode == "serving" and "--prefix-replay" in sys.argv[2:]:

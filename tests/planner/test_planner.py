@@ -1,0 +1,421 @@
+"""Parallelism planner (pipegoose_tpu/planner/, ISSUE 7): enumeration
+dedup rules, the static cost model's hand-computed arithmetic,
+PlanReport JSON round-trip + forward compat, check-gate semantics, and
+the end-to-end search on the 8-fake-device mesh (ranked candidates with
+embedded doctor reports, infeasible ones pruned WITH a reason, gauges
+exported, pipeline candidates carrying their analytic bubble)."""
+import json
+
+import jax
+import pytest
+
+from pipegoose_tpu.planner import (
+    BloomPlanModel,
+    Candidate,
+    CandidateResult,
+    CostModel,
+    PlanReport,
+    enumerate_candidates,
+    hbm_check,
+    mesh_factorizations,
+    run_plan,
+    score_breakdown,
+)
+from pipegoose_tpu.telemetry.doctor import (
+    CollectiveInfo,
+    DoctorReport,
+    MemoryReport,
+    ShardingReport,
+)
+
+
+# -- candidate space -------------------------------------------------------
+
+
+def test_mesh_factorizations_cover_every_split():
+    pairs = {(dp, tp) for dp, tp, pp, ep in mesh_factorizations(8)}
+    assert pairs == {(8, 1), (4, 2), (2, 4), (1, 8)}
+    with_pp = mesh_factorizations(8, pp_sizes=(1, 2))
+    assert (4, 1, 2, 1) in with_pp and (2, 2, 2, 1) in with_pp
+    # a pp size that doesn't divide the device count contributes nothing
+    assert all(pp != 3 for _, _, pp, _ in mesh_factorizations(8, (1, 3)))
+
+
+def test_enumerate_dedupes_layout_noops():
+    cands = enumerate_candidates(8)  # full default space
+    names = [c.name for c in cands]
+    assert len(names) == len(set(names))
+    # overlap needs a tensor axis; non-fp32 wire needs a data axis
+    assert not any(c.overlap_tp and c.tp == 1 for c in cands)
+    assert not any(c.grad_comm != "fp32" and c.dp == 1 for c in cands)
+    # the full space for 8 devices: 3 x (3 grad x 2 remat x overlap
+    # availability) splits + the dp1xtp8 column = 34 (ISSUE 7: >= 24)
+    assert len(cands) == 34
+    assert all(c.n_devices == 8 for c in cands)
+
+
+def test_restricted_sweep_keeps_canonical_layouts():
+    """A restricted option sweep must not lose whole (dp, tp) splits:
+    the no-op combos canonicalize onto their overlap-off / fp32 twin
+    even when the sweep itself would not enumerate that twin."""
+    only_overlap = enumerate_candidates(8, overlap=(True,),
+                                        grad_comms=("fp32",), remat=(True,))
+    names = {c.name for c in only_overlap}
+    assert "dp8xtp1" in names          # tp=1: overlap canonicalizes off
+    assert "dp4xtp2+overlap" in names
+    only_int8 = enumerate_candidates(8, overlap=(False,),
+                                     grad_comms=("int8",), remat=(True,))
+    names = {c.name for c in only_int8}
+    assert "dp1xtp8" in names          # dp=1: wire format canonicalizes
+    assert "dp8xtp1+int8" in names
+
+
+def test_candidate_json_round_trip_ignores_unknown_keys():
+    c = Candidate(dp=2, tp=4, overlap_tp=True, grad_comm="int8",
+                  remat=False)
+    d = c.to_json()
+    d["from_the_future"] = {"x": 1}     # newer-version field
+    assert Candidate.from_json(d) == c
+    assert c.name == "dp2xtp4+overlap+int8+noremat"
+    # unknown VALUES survive too: a newer version's wire format loads
+    # losslessly instead of tripping the constructor's enum check
+    d["grad_comm"] = "fp8"
+    back = Candidate.from_json(d)
+    assert back.grad_comm == "fp8" and "+fp8" in back.name
+    assert Candidate.from_json(back.to_json()).grad_comm == "fp8"
+
+
+# -- static cost model (pure arithmetic on a synthetic report) -------------
+
+
+def _synthetic_doctor(
+    collectives, peak_bytes=1 << 20, hbm_limit=None, cost_flops=2e9
+):
+    sharding = ShardingReport(
+        mesh_axes={"data": 4, "tensor": 2, "diloco": 1},
+        n_devices=8, buffers=[], collectives=list(collectives),
+    )
+    memory = MemoryReport(
+        groups={"params": peak_bytes // 2}, output_bytes=0, temp_bytes=None,
+        peak_bytes=peak_bytes, source="shape_walk", hbm_limit=hbm_limit,
+        top=[],
+    )
+    return DoctorReport(sharding=sharding, memory=memory,
+                        cost_flops=cost_flops)
+
+
+def _cm(**kw):
+    base = dict(device_kind="testchip", peak_flops=1e12,
+                ici_bytes_per_s=1e9, dci_bytes_per_s=1e8,
+                hbm_bytes=float(1 << 30))
+    base.update(kw)
+    return CostModel(**base)
+
+
+def test_score_breakdown_hand_computed():
+    # all-gather of 1024B over tensor (g=2): wire = 1024 * 1/2 = 512
+    # reduce-scatter of 256B over data (g=4): wire = 256 * 3 = 768
+    rep = _synthetic_doctor([
+        CollectiveInfo(op="all-gather", bytes=1024,
+                       mesh_axes=("tensor",), source="all_gather",
+                       intentional=True),
+        CollectiveInfo(op="reduce-scatter", bytes=256,
+                       mesh_axes=("data",), source="psum_scatter",
+                       intentional=True),
+    ])
+    b = score_breakdown(Candidate(dp=4, tp=2), rep, _cm(),
+                        tokens_per_step=1000)
+    assert b["wire_bytes_by_axes"] == {"tensor": 512, "data": 768}
+    assert b["compute_seconds"] == pytest.approx(2e9 / 1e12)
+    assert b["comm_seconds"] == pytest.approx((512 + 768) / 1e9)
+    step = 2e-3 + 1280e-9
+    assert b["step_seconds"] == pytest.approx(step)
+    assert b["score"] == pytest.approx(1000 / step)
+
+
+def test_overlap_discounts_only_the_tensor_axis():
+    rep = _synthetic_doctor([
+        CollectiveInfo(op="collective-permute", bytes=1000,
+                       mesh_axes=("tensor",), source="ppermute",
+                       intentional=True),
+        CollectiveInfo(op="collective-permute", bytes=1000,
+                       mesh_axes=("data",), source="ppermute",
+                       intentional=True),
+    ])
+    cm = _cm(overlap_hidden_fraction=0.75)
+    plain = score_breakdown(Candidate(dp=4, tp=2), rep, cm, 1000)
+    ovl = score_breakdown(Candidate(dp=4, tp=2, overlap_tp=True), rep,
+                          cm, 1000)
+    assert plain["comm_seconds_by_axes"]["tensor"] == pytest.approx(1e-6)
+    assert ovl["comm_seconds_by_axes"]["tensor"] == pytest.approx(0.25e-6)
+    assert ovl["comm_seconds_by_axes"]["data"] == \
+        plain["comm_seconds_by_axes"]["data"]
+
+
+def test_dci_axes_ride_the_slow_fabric_and_unattributed_is_kept():
+    rep = _synthetic_doctor([
+        CollectiveInfo(op="all-reduce", bytes=1000, mesh_axes=("diloco",),
+                       source="psum", intentional=True),
+        # unresolved replica groups: attributed to "?" — never dropped
+        CollectiveInfo(op="all-reduce", bytes=800, mesh_axes=None,
+                       source="", intentional=False),
+    ])
+    # a size-1 diloco axis would zero the wire estimate; the point is
+    # the bandwidth CHOICE, so widen the synthetic mesh's diloco axis
+    rep.sharding.mesh_axes["diloco"] = 2
+    b = score_breakdown(Candidate(dp=8), rep, _cm(), 1000)
+    # all-reduce over g=2: wire = 2 * 1000 * 1/2 = 1000 at DCI 1e8
+    assert b["comm_seconds_by_axes"]["diloco"] == pytest.approx(1000 / 1e8)
+    # the unattributed collective contributes its one-hop payload to
+    # the "?" bucket (estimated_wire_bytes has no group size there) —
+    # visible in both bytes AND seconds, never a silent zero
+    assert b["wire_bytes_by_axes"]["?"] == 800
+    assert b["comm_seconds_by_axes"]["?"] == pytest.approx(800 / 1e9)
+
+
+def test_bubble_inflates_step_time():
+    rep = _synthetic_doctor([])
+    flat = score_breakdown(Candidate(dp=8), rep, _cm(), 1000,
+                           bubble_fraction=0.0)
+    bub = score_breakdown(Candidate(dp=8), rep, _cm(), 1000,
+                          bubble_fraction=0.5)
+    assert bub["step_seconds"] == pytest.approx(2 * flat["step_seconds"])
+    assert bub["score"] == pytest.approx(flat["score"] / 2)
+
+
+def test_missing_cost_flops_is_marked_not_silent():
+    """A backend without AOT cost analysis yields cost_flops=None: the
+    breakdown must say compute is unmodeled, not pretend it's free."""
+    rep = _synthetic_doctor([
+        CollectiveInfo(op="all-gather", bytes=1024, mesh_axes=("tensor",),
+                       source="all_gather", intentional=True),
+    ], cost_flops=None)
+    b = score_breakdown(Candidate(dp=4, tp=2), rep, _cm(), 1000)
+    assert b["compute_modeled"] is False and b["compute_seconds"] == 0.0
+    modeled = score_breakdown(
+        Candidate(dp=4, tp=2), _synthetic_doctor([], cost_flops=1e9),
+        _cm(), 1000)
+    assert modeled["compute_modeled"] is True
+
+
+def test_hbm_check_prunes_with_stated_reason():
+    small = _synthetic_doctor([], peak_bytes=2 << 30)
+    reason = hbm_check(small, _cm(hbm_bytes=float(1 << 30)))
+    assert reason is not None and "HBM-infeasible" in reason
+    assert "2.0GiB" in reason and "1.0GiB" in reason
+    # a live backend limit wins over the table
+    live = _synthetic_doctor([], peak_bytes=2 << 30, hbm_limit=4 << 30)
+    assert hbm_check(live, _cm(hbm_bytes=float(1 << 30))) is None
+
+
+# -- PlanReport: serialization, forward compat, check gate -----------------
+
+
+def _tiny_plan():
+    mk = lambda c, score: CandidateResult(  # noqa: E731
+        candidate=c, feasible=True, score=score,
+        breakdown={"score": score, "tokens_per_step": 100},
+    )
+    report = PlanReport(
+        device_kind="testchip", n_devices=8,
+        model={"name": "toy"}, tokens_per_step=100,
+        cost_model=_cm().to_json(),
+        candidates=[
+            mk(Candidate(dp=2, tp=4, overlap_tp=True, grad_comm="int8"),
+               1000.0),
+            mk(Candidate(dp=4, tp=2), 800.0),
+            CandidateResult(
+                candidate=Candidate(dp=1, tp=8), feasible=False,
+                prune_reason="n_head 4 not divisible by tp=8",
+            ),
+        ],
+    )
+    report.sort()
+    return report
+
+
+def test_plan_report_json_round_trip():
+    rep = _tiny_plan()
+    back = PlanReport.from_json(json.loads(json.dumps(rep.to_json())))
+    assert [c.name for c in back.candidates] == \
+        [c.name for c in rep.candidates]
+    assert back.top.score == rep.top.score
+    assert back.pruned[0].prune_reason == rep.pruned[0].prune_reason
+
+
+def test_plan_report_from_json_ignores_unknown_keys():
+    """Forward compat (ISSUE 7 satellite): a plan artifact written by a
+    NEWER version — extra fields at every nesting level — still loads,
+    so an older CLI's --check gate keeps working."""
+    d = _tiny_plan().to_json()
+    d["new_top_level_field"] = "x"
+    d["cost_model"]["new_budget"] = 3.14
+    d["candidates"][0]["new_per_candidate_field"] = [1, 2]
+    d["candidates"][0]["candidate"]["sp"] = 2          # a future axis
+    d["candidates"][0]["breakdown"]["new_metric"] = 0  # breakdown is opaque
+    back = PlanReport.from_json(d)
+    assert back.top.name == "dp2xtp4+overlap+int8"
+    assert back.top.breakdown["new_metric"] == 0  # opaque dicts pass through
+    ok, _ = back.check(back.top.candidate, tolerance=0.1)
+    assert ok
+
+
+def test_check_gate_semantics():
+    rep = _tiny_plan()
+    top = Candidate(dp=2, tp=4, overlap_tp=True, grad_comm="int8")
+    ok, msg = rep.check(top, tolerance=0.1)
+    assert ok, msg
+    # within tolerance: 800 >= (1 - 0.25) * 1000
+    ok, msg = rep.check(Candidate(dp=4, tp=2), tolerance=0.25)
+    assert ok, msg
+    # below tolerance
+    ok, msg = rep.check(Candidate(dp=4, tp=2), tolerance=0.1)
+    assert not ok and "re-plan" in msg
+    # infeasible configured layout
+    ok, msg = rep.check(Candidate(dp=1, tp=8))
+    assert not ok and "infeasible" in msg
+    # not in the space at all
+    ok, msg = rep.check(Candidate(dp=8, tp=1, grad_comm="bf16"))
+    assert not ok and "not in the plan" in msg
+    # a runtime-no-op flag canonicalizes before matching: int8 wire on
+    # the dp=1 layout is the same layout as its fp32 twin
+    ok, msg = rep.check(
+        Candidate(dp=1, tp=8, grad_comm="int8", overlap_tp=False))
+    assert not ok and "infeasible" in msg  # matched the pruned twin
+
+
+def test_record_measurement_and_summary():
+    rep = _tiny_plan()
+    assert rep.record_measurement(
+        Candidate(dp=2, tp=4, overlap_tp=True, grad_comm="int8"),
+        {"tokens_per_sec": 500.0},
+    ) is not None
+    rep.record_measurement(Candidate(dp=4, tp=2),
+                           {"tokens_per_sec": 600.0})
+    s = rep.predicted_vs_measured()
+    assert s["measured"] == 2
+    assert s["predicted_best"] == "dp2xtp4+overlap+int8"
+    assert s["measured_best"] == "dp4xtp2"
+    assert s["rank_agreement"] is False
+    pc = s["per_candidate"]["dp2xtp4+overlap+int8"]
+    assert pc["measured_over_predicted"] == pytest.approx(0.5)
+    # measurements survive the JSON round-trip
+    back = PlanReport.from_json(rep.to_json())
+    assert back.predicted_vs_measured()["measured_best"] == "dp4xtp2"
+
+
+# -- end to end on the fake 8-device mesh ----------------------------------
+
+
+@pytest.fixture(scope="module")
+def small_plan(devices):
+    from pipegoose_tpu.models import bloom
+    from pipegoose_tpu.telemetry.registry import MetricsRegistry
+
+    cfg = bloom.BloomConfig(vocab_size=128, hidden_size=64, n_layer=2,
+                            n_head=4)
+    model = BloomPlanModel(cfg, batch=8, seq=32)
+    reg = MetricsRegistry(enabled=True)
+    candidates = [
+        Candidate(dp=8, tp=1),
+        Candidate(dp=4, tp=2),
+        Candidate(dp=4, tp=2, grad_comm="int8"),
+        Candidate(dp=1, tp=8),              # n_head-infeasible -> pruned
+    ]
+    report = run_plan(model, candidates, CostModel.for_device("cpu"),
+                      registry=reg)
+    return report, reg
+
+
+def test_e2e_ranks_and_prunes_with_reason(small_plan):
+    report, _ = small_plan
+    assert len(report.ranked) == 3 and len(report.pruned) == 1
+    scores = [c.score for c in report.ranked]
+    assert scores == sorted(scores, reverse=True)
+    assert "n_head" in report.pruned[0].prune_reason
+    # every ranked candidate embeds its full doctor report + breakdown
+    for c in report.ranked:
+        assert c.doctor is not None and c.doctor.cost_flops > 0
+        assert c.breakdown["hbm_peak_bytes"] > 0
+        assert c.breakdown["tokens_per_step"] == 256
+
+
+def test_e2e_tp_beats_pure_dp_and_int8_cuts_data_axis_time(small_plan):
+    report, _ = small_plan
+    by_name = {c.name: c for c in report.candidates}
+    # tp shrinks the gradient reduce-scatter payload: tp2 ranks above dp8
+    assert by_name["dp4xtp2"].score > by_name["dp8xtp1"].score
+    # the int8 wire format cuts data-axis comm time vs its fp32 twin
+    # (the reduce phase compresses ~4x; the ZeRO param all-gather stays
+    # fp32, so the whole-axis cut is smaller but must be real)
+    fp32 = by_name["dp4xtp2"].breakdown["comm_seconds_by_axes"]["data"]
+    int8 = by_name["dp4xtp2+int8"].breakdown["comm_seconds_by_axes"]["data"]
+    assert int8 < 0.8 * fp32
+
+
+def test_e2e_gauges_exported(small_plan):
+    _, reg = small_plan
+    assert reg.gauge("planner.candidates_evaluated").value == 4.0
+    assert reg.gauge("planner.pruned_infeasible").value == 1.0
+    assert reg.gauge("planner.top1_score").value > 0
+
+
+def test_e2e_report_round_trips_with_doctor(small_plan):
+    report, _ = small_plan
+    back = PlanReport.from_json(json.loads(json.dumps(report.to_json())))
+    assert back.top.name == report.top.name
+    assert back.top.doctor.sharding.n_devices == 8
+    assert back.top.score == pytest.approx(report.top.score)
+
+
+def test_pp_candidate_carries_analytic_bubble(devices):
+    from pipegoose_tpu.models import bloom
+
+    cfg = bloom.BloomConfig(vocab_size=128, hidden_size=64, n_layer=2,
+                            n_head=4)
+    model = BloomPlanModel(cfg, batch=8, seq=16)
+    cand = Candidate(dp=2, tp=2, pp=2, n_microbatches=2)
+    report = run_plan(model, [cand], CostModel.for_device("cpu"))
+    res = report.ranked[0]
+    # GPipe bubble (P-1)/(M+P-1) = 1/3 inflates the step
+    assert res.breakdown["bubble_fraction"] == pytest.approx(1 / 3)
+    assert res.doctor is not None
+
+
+def test_builder_validity_reasons():
+    from pipegoose_tpu.models import bloom
+
+    cfg = bloom.BloomConfig(vocab_size=128, hidden_size=64, n_layer=3,
+                            n_head=4)
+    m = BloomPlanModel(cfg, batch=8, seq=30)
+    assert "expert axis" in m.validity(Candidate(dp=4, ep=2))
+    assert "n_head" in m.validity(Candidate(dp=1, tp=8))
+    assert "batch" in m.validity(Candidate(dp=3, tp=1))
+    assert "seq % tp" in m.validity(
+        Candidate(dp=2, tp=4, overlap_tp=True))
+    assert "n_layer" in m.validity(Candidate(dp=4, tp=1, pp=2,
+                                             n_microbatches=2))
+    assert m.validity(Candidate(dp=4, tp=2)) is None
+
+
+def test_run_plan_survives_a_broken_candidate(small_plan, monkeypatch):
+    """One candidate whose build raises becomes a pruned row carrying
+    the exception; the search continues."""
+    from pipegoose_tpu.models import bloom
+
+    cfg = bloom.BloomConfig(vocab_size=128, hidden_size=64, n_layer=2,
+                            n_head=4)
+    model = BloomPlanModel(cfg, batch=8, seq=32)
+
+    import contextlib
+
+    @contextlib.contextmanager
+    def boom(c):
+        raise RuntimeError("synthetic build failure")
+        yield  # pragma: no cover
+
+    monkeypatch.setattr(model, "build", boom)
+    report = run_plan(model, [Candidate(dp=8)],
+                      CostModel.for_device("cpu"))
+    assert report.ranked == []
+    assert "synthetic build failure" in report.pruned[0].prune_reason

@@ -417,3 +417,145 @@ def test_flightrec_dump_includes_doctor(hybrid_setup, tmp_path):
     rec2 = FlightRecorder(str(tmp_path / "b"))
     rec2.set_doctor_report(rep)
     assert rec2.doctor_report is rep
+
+
+# -- forward-compatible deserialization (ISSUE 7 satellite) ----------------
+
+
+def test_doctor_from_json_ignores_unknown_keys():
+    """A doctor/plan artifact written by a NEWER version — extra fields
+    at every nesting level — must still load (the --check gates read
+    artifacts across versions)."""
+    d = _synthetic_report().to_json()
+    d["from_the_future"] = True
+    d["sharding"]["new_summary_stat"] = 42
+    d["sharding"]["buffers"][0]["new_buffer_flag"] = "x"
+    d["sharding"]["collectives"][0]["new_cost_field"] = 1.5
+    d["memory"]["new_budget"] = {"nested": [1, 2]}
+    back = D.DoctorReport.from_json(json.loads(json.dumps(d)))
+    assert back.sharding.resharding_bytes == 49152 + 256
+    assert back.memory.peak_bytes == 4 << 20
+    assert back.sharding.buffers[0].path == "params/blocks/attn/qkv/kernel"
+    # and BACKWARD: an artifact from before cost_flops existed loads too
+    old = _synthetic_report().to_json()
+    old.pop("cost_flops")
+    assert D.DoctorReport.from_json(old).cost_flops is None
+    # cost_flops round-trips when present
+    rep = _synthetic_report()
+    rep.cost_flops = 3.5e9
+    assert D.DoctorReport.from_json(rep.to_json()).cost_flops == 3.5e9
+
+
+# -- estimated_wire_bytes payload conventions (ISSUE 7 satellite) ----------
+#
+# Each collective reports DIFFERENT output-payload conventions in HLO
+# (a reduce-scatter reports its shard, an all-to-all the full local
+# array); estimated_wire_bytes normalizes them to per-device
+# TRANSMITTED bytes. Pinned here against hand-computed expectations on
+# two mesh shapes, from REAL compiled programs.
+
+
+def _compiled_collective(fn, mesh, in_spec, out_spec, x_sds, op):
+    from pipegoose_tpu.distributed.compat import shard_map
+
+    f = jax.jit(shard_map(fn, mesh=mesh, in_specs=(in_spec,),
+                          out_specs=out_spec, check_vma=False))
+    rep = D.diagnose(f, x_sds, mesh=mesh)
+    found = [c for c in rep.sharding.collectives if c.op == op]
+    assert len(found) == 1, (op, rep.sharding.collectives)
+    return found[0], rep.sharding.mesh_axes
+
+
+def test_wire_bytes_conventions_1d_mesh(devices):
+    """8-device ring, f32[8,16] (512B global): all five collectives,
+    each pinned to its hand-computed payload AND wire estimate."""
+    from jax import lax
+    from jax.sharding import Mesh
+
+    mesh = Mesh(np.asarray(devices[:8]), ("x",))
+    x = jax.ShapeDtypeStruct((8, 16), jnp.float32)
+
+    # all-gather: local (1,16) -> full (8,16) = 512B output payload;
+    # ring sends the own shard 7 times interleaved -> 512 * 7/8 = 448
+    c, ax = _compiled_collective(
+        lambda v: jax.lax.all_gather(v, "x", axis=0, tiled=True),
+        mesh, P("x"), P(), x, "all-gather")
+    assert c.bytes == 512 and c.mesh_axes == ("x",) and c.intentional
+    assert D.estimated_wire_bytes(c, ax) == 448
+
+    # reduce-scatter: full (8,16) in -> shard (1,16) = 64B payload;
+    # each device forwards a shard for 7 hops -> 64 * 7 = 448 — the
+    # SAME wire traffic as the all-gather above, which is the point of
+    # the normalization (raw payloads differ 8x)
+    c, ax = _compiled_collective(
+        lambda v: lax.psum_scatter(v, "x", scatter_dimension=0, tiled=True),
+        mesh, P(), P("x"), x, "reduce-scatter")
+    assert c.bytes == 64
+    assert D.estimated_wire_bytes(c, ax) == 448
+
+    # psum -> all-reduce on the local (1,16) shard = 64B payload;
+    # RS + AG -> 2 * 64 * 7/8 = 112
+    c, ax = _compiled_collective(
+        lambda v: lax.psum(v, "x"), mesh, P("x"), P(), x, "all-reduce")
+    assert c.bytes == 64
+    assert D.estimated_wire_bytes(c, ax) == 112
+
+    # all-to-all (this jax requires split-dim == axis size): f32[8,8]
+    # local (1,8) -> (8,1) = 32B full-local-array payload; keeps 1/8 ->
+    # 32 * 7/8 = 28
+    c, ax = _compiled_collective(
+        lambda v: lax.all_to_all(v, "x", split_axis=1, concat_axis=0),
+        mesh, P("x"), P("x", None),
+        jax.ShapeDtypeStruct((8, 8), jnp.float32), "all-to-all")
+    assert c.bytes == 32
+    assert D.estimated_wire_bytes(c, ax) == 28
+
+    # ppermute: one hop of the local (1,16) = 64B payload -> 64
+    c, ax = _compiled_collective(
+        lambda v: lax.ppermute(v, "x", [(i, (i + 1) % 8) for i in range(8)]),
+        mesh, P("x"), P("x"), x, "collective-permute")
+    assert c.bytes == 64
+    assert D.estimated_wire_bytes(c, ax) == 64
+
+
+def test_wire_bytes_conventions_2d_mesh(devices):
+    """data=4 x tensor=2 mesh: the group size comes from the axes the
+    collective actually spans, not the device count — and the doctor
+    attributes each collective to the right axis."""
+    from jax import lax
+    from jax.sharding import Mesh
+
+    mesh = Mesh(np.asarray(devices[:8]).reshape(4, 2), ("data", "tensor"))
+    x = jax.ShapeDtypeStruct((8, 8), jnp.float32)
+
+    # all-gather over tensor (g=2): local (8,4) -> (8,8) = 256B payload;
+    # wire 256 * 1/2 = 128
+    c, ax = _compiled_collective(
+        lambda v: jax.lax.all_gather(v, "tensor", axis=1, tiled=True),
+        mesh, P(None, "tensor"), P(), x, "all-gather")
+    assert c.bytes == 256 and c.mesh_axes == ("tensor",)
+    assert D.estimated_wire_bytes(c, ax) == 128
+
+    # reduce-scatter over data (g=4): full (8,8) -> (2,8) = 64B shard;
+    # wire 64 * 3 = 192
+    c, ax = _compiled_collective(
+        lambda v: lax.psum_scatter(v, "data", scatter_dimension=0,
+                                   tiled=True),
+        mesh, P(), P("data", None), x, "reduce-scatter")
+    assert c.bytes == 64 and c.mesh_axes == ("data",)
+    assert D.estimated_wire_bytes(c, ax) == 192
+
+    # psum over data (g=4): local (2,8) = 64B; 2 * 64 * 3/4 = 96
+    c, ax = _compiled_collective(
+        lambda v: lax.psum(v, "data"), mesh, P("data"), P(), x,
+        "all-reduce")
+    assert c.bytes == 64 and c.mesh_axes == ("data",)
+    assert D.estimated_wire_bytes(c, ax) == 96
+
+    # ppermute over tensor: one hop of local (8,4) = 128B
+    c, ax = _compiled_collective(
+        lambda v: lax.ppermute(v, "tensor", [(0, 1), (1, 0)]),
+        mesh, P(None, "tensor"), P(None, "tensor"), x,
+        "collective-permute")
+    assert c.bytes == 128 and c.mesh_axes == ("tensor",)
+    assert D.estimated_wire_bytes(c, ax) == 128

@@ -36,6 +36,7 @@ CASES = [
                              "--dp", "4"]),
     ("comm_overlap_demo.py", ["--fake-devices", "8", "--tp", "2",
                               "--dp", "4"]),
+    ("plan_parallelism_demo.py", ["--fake-devices", "8", "--top-k", "5"]),
 ]
 
 

@@ -32,3 +32,54 @@ def test_random_input_ids_deterministic():
     b = random_input_ids(100, (2, 5), seed=3)
     np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
     assert int(a.max()) < 100
+
+
+# -- fake_cluster (ISSUE 7 satellite: the ONE fake-device bootstrap) -------
+
+
+def test_set_fake_device_flags_override_semantics(monkeypatch):
+    import os
+
+    from pipegoose_tpu.testing import set_fake_device_flags
+
+    monkeypatch.setenv(
+        "XLA_FLAGS", "--foo --xla_force_host_platform_device_count=4"
+    )
+    # override=False keeps an operator-set count (the conftest contract)
+    set_fake_device_flags(16, override=False)
+    assert "device_count=4" in os.environ["XLA_FLAGS"]
+    # override=True replaces it, preserving unrelated flags
+    set_fake_device_flags(16)
+    flags = os.environ["XLA_FLAGS"]
+    assert "device_count=16" in flags and "--foo" in flags
+    assert "device_count=4" not in flags
+    # no prior flag: appended cleanly
+    monkeypatch.setenv("XLA_FLAGS", "")
+    set_fake_device_flags(8)
+    assert os.environ["XLA_FLAGS"] == \
+        "--xla_force_host_platform_device_count=8"
+
+
+def test_fake_cluster_returns_cpu_devices(devices):
+    from pipegoose_tpu.testing import fake_cluster, force_cpu_devices
+
+    devs = fake_cluster(8, require=True)
+    assert len(devs) >= 8
+    assert all(d.platform == "cpu" for d in devs)
+    # the back-compat alias bench/examples used still works
+    force_cpu_devices(8)
+
+
+def test_fake_cluster_require_raises_when_backend_has_fewer(
+    devices, monkeypatch
+):
+    import os
+
+    from pipegoose_tpu.testing import fake_cluster
+
+    # pin XLA_FLAGS for restoration — the call below rewrites the count
+    monkeypatch.setenv("XLA_FLAGS", os.environ.get("XLA_FLAGS", ""))
+    # the backend is already up with 8 devices; demanding more must
+    # raise loudly instead of silently planning on the wrong mesh
+    with pytest.raises(RuntimeError, match="fake_cluster"):
+        fake_cluster(64, require=True)
