@@ -8,7 +8,9 @@ events, the serving engine's per-step time series, and a final metrics
 snapshot (pipegoose_tpu/telemetry/, docs/observability.md) — plus a
 sibling Perfetto timeline (``BENCH_TRACE_JSON``, default
 ``bench_telemetry_trace.json``; open in ui.perfetto.dev) of the same
-run's spans.
+run's spans, and a request-trace artifact (``BENCH_REQTRACE_JSON``,
+default ``bench_request_trace.json``) whose per-arm latency attribution
+decomposes the prefix-replay TTFT deltas (telemetry/reqtrace.py).
 
 The reference publishes no throughput numbers (BASELINE.md) — its
 acceptance bar is convergence only. ``vs_baseline`` therefore reports
@@ -510,17 +512,47 @@ def run_bench(force_cpu: bool) -> None:
                              num_slots=2, num_pages=33, page_size=8,
                              max_context=64, prefill_chunk=16)
         sparams = bloom.init_params(scfg, jax.random.PRNGKey(1))
+        # request-trace artifact (BENCH_REQTRACE_JSON, default
+        # bench_request_trace.json; empty disables): one EXTRA traced
+        # replay per arm AFTER the measurement, whose per-arm latency
+        # attribution explains the cached-vs-baseline TTFT delta
+        # (ISSUE 8) — queue/prefill/decode/stall components per request
+        # plus the cache-savings share vs the prefill-token reduction.
+        reqtrace_path = os.environ.get(
+            "BENCH_REQTRACE_JSON", "bench_request_trace.json"
+        )
         was_enabled = reg.enabled
         reg.disable()
         try:
             res = serving_ab_benchmark(sparams, scfg, specs, **kw)
             res["prefix_replay"] = prefix_replay_benchmark(
                 sparams, scfg, seed=0, include_speculative=True,
-                **replay_kw,
+                trace=bool(reqtrace_path), **replay_kw,
             )
         finally:
             if was_enabled:
                 reg.enable()
+        if reqtrace_path and "request_trace" in res["prefix_replay"]:
+            from pipegoose_tpu.telemetry.exporters import (
+                atomic_write_text as _awt,
+                safe_json_dumps as _sjd,
+            )
+
+            # the per-request rows live in the sibling artifact, the
+            # stdout payload keeps only the cross-arm summary
+            rt = res["prefix_replay"].pop("request_trace")
+            _awt(reqtrace_path, _sjd({
+                "device": device_kind,
+                "replay": {k: v for k, v in replay_kw.items()},
+                "ttft_per_arm": {
+                    arm: {q: row[q] for q in ("ttft_p50_s", "ttft_p99_s")}
+                    for arm, row in res["prefix_replay"].items()
+                    if isinstance(row, dict) and "ttft_p50_s" in row
+                },
+                **rt,
+            }, indent=1))
+            res["prefix_replay"]["request_trace_summary"] = rt["summary"]
+            res["prefix_replay"]["request_trace_json"] = reqtrace_path
         if tel is not None:
             srng = np.random.RandomState(0)
             vocab = getattr(scfg, "valid_vocab_size", None) or scfg.vocab_size

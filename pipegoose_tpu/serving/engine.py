@@ -91,7 +91,7 @@ from pipegoose_tpu.serving.kv_pool import (
 )
 from pipegoose_tpu.serving.prefix_cache import PrefixCache
 from pipegoose_tpu.serving.scheduler import Request, Scheduler, Status
-from pipegoose_tpu.telemetry.registry import get_registry
+from pipegoose_tpu.telemetry.registry import Histogram, get_registry
 from pipegoose_tpu.telemetry.spans import span
 
 
@@ -133,14 +133,21 @@ class ServingEngine:
                  registry=None, recorder=None, stall_patience: int = 100,
                  prefix_cache: bool = False,
                  prefill_chunk: Optional[int] = None,
-                 speculative: Optional[Tuple[int, int]] = None):
+                 speculative: Optional[Tuple[int, int]] = None,
+                 tracer=None):
         """``recorder``: optional ``telemetry.FlightRecorder`` — every
         decode step lands in its ring, and the no-decode-progress
         watchdog dumps a black box through it before raising.
         ``stall_patience``: scheduler iterations that admit nothing,
         prefill nothing, and decode nothing before the watchdog declares
         a stall. ``speculative=(k, n)``: draft with the first ``k``
-        layers, propose up to ``n`` tokens per verification."""
+        layers, propose up to ``n`` tokens per verification.
+        ``tracer``: optional ``telemetry.reqtrace.RequestTracer`` —
+        records every request's lifecycle timeline (admit, prefill
+        chunks + cache hits, first token, decode ticks, spec cycles,
+        preemptions) and attributes its TTFT/e2e latency; default None
+        keeps the tick path at one attribute read + branch per hook
+        site (guard-tested < 5 µs)."""
         if max_context % page_size:
             raise ValueError("max_context must be a multiple of page_size")
         if stall_patience < 1:
@@ -156,6 +163,12 @@ class ServingEngine:
                 raise ValueError(f"speculative draft length {n} must be >= 1")
         self.recorder = recorder
         self.stall_patience = stall_patience
+        self.tracer = tracer
+        self.last_doctor_report = None   # refreshed by doctor()/doctor_chunk()
+        if recorder is not None and tracer is not None:
+            # a decode_stall (or any) black box then embeds the live
+            # request timelines: the dump NAMES the stuck request
+            recorder.set_request_tracer(tracer)
         self.registry = registry if registry is not None else get_registry()
         # resolve metric handles ONCE: inc/set/observe check the enabled
         # flag themselves, so the hot loop's disabled cost stays one
@@ -204,7 +217,8 @@ class ServingEngine:
         self.sched = Scheduler(num_slots, self.pool, max_context,
                                continuous=continuous,
                                prefix_cache=self.prefix_cache,
-                               chunk_tokens=prefill_chunk)
+                               chunk_tokens=prefill_chunk,
+                               tracer=tracer)
         # paged prefill path: required by the cache (the tail attends to
         # shared pages) and by chunking; the legacy monolithic
         # forward_cached + write_prompt_pages path stays the default
@@ -389,6 +403,7 @@ class ServingEngine:
             mesh=self.mesh, large_bytes=large_bytes,
         )
         set_doctor_gauges(report, registry=registry or self.registry)
+        self.last_doctor_report = report   # /debug/doctor serves this
         return report
 
     def doctor_chunk(self, large_bytes: int = 1 << 20, registry=None):
@@ -418,9 +433,36 @@ class ServingEngine:
             mesh=self.mesh, large_bytes=large_bytes,
         )
         set_doctor_gauges(report, registry=registry or self.registry)
+        self.last_doctor_report = report
         return report
 
     # -- internals ---------------------------------------------------------
+
+    def attach_tracer(self, tracer) -> None:
+        """Attach (or detach, with None) a ``RequestTracer`` after
+        construction — the engine and its scheduler share the handle,
+        and an attached flight recorder starts embedding the tracer's
+        timelines in black-box dumps. Post-hoc attachment exists so a
+        warm engine (compiled programs, seeded cache) can run one traced
+        replay without rebuilding."""
+        self.tracer = tracer
+        self.sched.tracer = tracer
+        if self.recorder is not None:
+            self.recorder.set_request_tracer(tracer)
+
+    def _observe_ttft(self, req: Request) -> None:
+        """Record TTFT into the histogram EXACTLY ONCE per request. Two
+        engine paths can complete a prefill (the monolithic
+        ``_prefill_request`` and the paged ``_prefill_chunk_tick``), and
+        a preempted-then-re-admitted request re-enters prefill with its
+        preserved ``t_first_token`` — the ``ttft_observed`` flag makes a
+        double observation structurally impossible regardless of which
+        path(s) a request crosses."""
+        if (req.ttft_observed or req.t_first_token is None
+                or req.t_submit is None):
+            return
+        req.ttft_observed = True
+        self._m_ttft.observe(req.t_first_token - req.t_submit)
 
     def _prefill_request(self, req: Request, now) -> None:
         """Legacy monolithic prefill: run the bucketed contiguous
@@ -432,6 +474,8 @@ class ServingEngine:
                 "prefill path — construct the engine with prefix_cache "
                 "and/or prefill_chunk"
             )
+        tr = self.tracer
+        t0 = now() if tr is not None else 0.0
         with span("serving.prefill", registry=self.registry):
             s = req.prompt_len
             bucket = self.pool.pages_for(s) * self.page_size
@@ -451,15 +495,18 @@ class ServingEngine:
             )
             # the token fetch syncs the device, so the span's wall time
             # covers the prefill's actual device work
-            self.sched.record_token(req, int(np.asarray(tok)[0]), now())
+            tok = int(np.asarray(tok)[0])  # host fetch syncs the device:
+            t1 = now()                     # span + chunk dur = device work
+            if tr is not None:
+                tr.on_prefill_chunk(req, t1, dur_s=t1 - t0, tokens=s)
+            self.sched.record_token(req, tok, t1)
         self._m_prefill_tok.inc(s)
         self._run_prefill_tokens += s
         self._m_prefills.inc()
         self._m_tokens.inc()  # the prefill's token
-        if req.t_first_token is not None and req.t_submit is not None:
-            self._m_ttft.observe(req.t_first_token - req.t_submit)
+        self._observe_ttft(req)
 
-    def _start_prefill(self, req: Request) -> None:
+    def _start_prefill(self, req: Request, now) -> None:
         """Paged-path admission follow-up: account the cache hit and run
         the pending copy-on-write duplication (the shared page whose
         mid-page tail this request will write gets a private copy; the
@@ -482,6 +529,8 @@ class ServingEngine:
             req.cow = None
             req.prefilled_len += m
             self._m_cow.inc()
+            if self.tracer is not None:
+                self.tracer.on_cow(req, now())
 
     def _prefill_chunk_tick(self, req: Request, now) -> None:
         """Advance one prefill chunk through the page tables; on
@@ -502,6 +551,8 @@ class ServingEngine:
         ids[0, :n] = req.tokens[begin:end]
         table = np.zeros((1, self.table_width), np.int32)
         table[0, :len(req.pages)] = req.pages
+        tr = self.tracer
+        t0 = now() if tr is not None else 0.0
         with span("serving.prefill", registry=self.registry):
             tok, self.k_pages, self.v_pages = self._chunk(
                 self.params, jnp.asarray(ids), self.k_pages, self.v_pages,
@@ -509,6 +560,9 @@ class ServingEngine:
                 jnp.asarray([n], jnp.int32),
             )
             tok = int(np.asarray(tok)[0])  # sync: span = device work
+        if tr is not None:
+            t1 = now()
+            tr.on_prefill_chunk(req, t1, dur_s=t1 - t0, tokens=n)
         req.prefilled_len = end
         self._m_chunks.inc()
         self._m_prefill_tok.inc(n)
@@ -529,11 +583,12 @@ class ServingEngine:
             # re-derive the pending token (greedy is deterministic);
             # nothing new to record — decode picks up where it left off
             req.status = Status.DECODE
+            if tr is not None:
+                tr.on_resume(req, now())
             return
         self.sched.record_token(req, tok, now())
         self._m_tokens.inc()
-        if req.t_first_token is not None and req.t_submit is not None:
-            self._m_ttft.observe(req.t_first_token - req.t_submit)
+        self._observe_ttft(req)
 
     def _spec_cycle(self, rows: List[Request], now, done: List[Request]):
         """One speculative decode cycle over the active batch: draft up
@@ -564,6 +619,8 @@ class ServingEngine:
         drafts: List[np.ndarray] = []
         cur = jnp.asarray(tok0)
         jtable = jnp.asarray(table)
+        tr = self.tracer
+        t_c0 = now() if tr is not None else 0.0
         # same span as the plain path: speculative mode must not make
         # the decode-step stream vanish from dashboards/Perfetto
         with span("serving.decode_step", registry=self.registry):
@@ -593,6 +650,9 @@ class ServingEngine:
             while m < g[i] and int(drafts[m][i]) == int(toks[i, m]):
                 m += 1
             accepted += m
+            if tr is not None:
+                tr.on_spec(r, t, dur_s=t - t_c0, drafted=int(g[i]),
+                           accepted=m)
             # the verified tokens ARE the full model's greedy stream:
             # m matched drafts + the correction/bonus token
             for j in range(m + 1):
@@ -606,6 +666,18 @@ class ServingEngine:
         self._m_spec_draft.inc(drafted)
         self._m_spec_acc.inc(accepted)
         return emitted, drafted, accepted, rows
+
+    def _trace_tick(self, active, t_step: float, t: float) -> None:
+        """Per-request decode-tick fan-out into the tracer (one bounded
+        event per active request). With tracing off (the default) the
+        cost is this one attribute read + branch — the disabled-path
+        guard test times exactly this call."""
+        tr = self.tracer
+        if tr is None:
+            return
+        dur = t - t_step
+        for req in active:
+            tr.on_decode_tick(req, t, dur_s=dur)
 
     def _stall(self, steps: int, wall_s: float) -> None:
         """No-decode-progress watchdog tripped: dump a black box (when a
@@ -652,6 +724,10 @@ class ServingEngine:
         reg = self.registry
         self._run_prefill_tokens = 0   # prompt tokens forwarded this run
         self._run_hit_tokens = 0       # prompt tokens served by the cache
+        if self.tracer is not None:
+            # one time domain: tracer-internal timestamps (e.g. preempt
+            # hooks) must come from the same clock as t_submit/t_done
+            self.tracer.set_clock(now)
         for r in requests:
             self.sched.submit(r, now())
         self._m_queue.set(len(self.sched.queue))
@@ -676,7 +752,7 @@ class ServingEngine:
             chunked_this_tick = 0
             if self._paged_prefill:
                 for req in admitted:
-                    self._start_prefill(req)
+                    self._start_prefill(req, now)
                 # one chunk per prefilling request per tick: the "mixed
                 # step" — prefill advances below, decode advances after,
                 # every tick
@@ -754,6 +830,7 @@ class ServingEngine:
                     nxt = np.asarray(nxt)  # host fetch syncs: span = work
                 t = now()
                 emitted = len(active)
+                self._trace_tick(active, t_step, t)
             if t_last_decode is not None:
                 gap = t_step - t_last_decode
                 self._m_gap.observe(gap)
@@ -918,14 +995,6 @@ def serving_ab_benchmark(params, config, request_specs, *, num_slots=4,
     return results
 
 
-def _percentile(values: List[float], q: float) -> float:
-    if not values:
-        return float("nan")
-    ordered = sorted(values)
-    idx = min(int(q * len(ordered)), len(ordered) - 1)
-    return ordered[idx]
-
-
 def make_skewed_replay(*, n_requests: int, n_prefixes: int, prefix_len: int,
                        suffix_lens: Sequence[int], max_new: int,
                        vocab: int, seed: int = 0, zipf_a: float = 1.2):
@@ -953,7 +1022,8 @@ def prefix_replay_benchmark(params, config, *, n_requests=12, n_prefixes=3,
                             seed=0, zipf_a=1.2, num_slots=4, num_pages=64,
                             page_size=8, max_context=64, prefill_chunk=None,
                             mesh=None, param_specs=None, tp_axis="tensor",
-                            include_speculative=False, speculative=(1, 3)):
+                            include_speculative=False, speculative=(1, 3),
+                            trace=False):
     """Measure the tentpole: the same skewed-prompt-reuse replay through
     (a) the PR 1 baseline engine (monolithic prefill, no sharing),
     (b) chunked prefill alone, (c) the prefix cache alone, (d) both, and
@@ -964,7 +1034,16 @@ def prefix_replay_benchmark(params, config, *, n_requests=12, n_prefixes=3,
     JSON-able. The ``summary`` block compares the pure-cache arm to the
     baseline: on prefill-compute-bound workloads (long shared prefixes
     — the production shape) the TTFT win tracks the hit rate; the
-    chunked arms trade a little TTFT for never stalling neighbors."""
+    chunked arms trade a little TTFT for never stalling neighbors.
+
+    ``trace=True`` additionally replays each arm ONCE MORE with a
+    ``RequestTracer`` attached — OUTSIDE the measured run, so the
+    measurement stays tracer-free — and returns a ``request_trace``
+    block: per-arm latency attribution (every request's additive
+    queue/prefill/decode/stall components, which sum to its measured
+    e2e) plus a cross-arm summary showing how much of the cached arm's
+    TTFT win the cache-savings share accounts for. This is what
+    bench.py writes to ``bench_request_trace.json``."""
     vocab = getattr(config, "valid_vocab_size", None) or config.vocab_size
     replay = make_skewed_replay(
         n_requests=n_requests, n_prefixes=n_prefixes, prefix_len=prefix_len,
@@ -988,6 +1067,7 @@ def prefix_replay_benchmark(params, config, *, n_requests=12, n_prefixes=3,
             "speculative": tuple(speculative),
         }
     results = {}
+    arm_traces = {}
     for label, kw in arms.items():
         engine = ServingEngine(
             params, config, num_slots=num_slots, num_pages=num_pages,
@@ -1001,14 +1081,30 @@ def prefix_replay_benchmark(params, config, *, n_requests=12, n_prefixes=3,
         engine.run(requests())
         engine.run(requests())
         outs, metrics = engine.run(requests())
-        ttfts = [o.ttft_s for o in outs]
+        # TTFT quantiles through the shared telemetry Histogram (the
+        # registry's single source of truth for percentile math — same
+        # sorted-reservoir index rule the exporters report)
+        h_ttft = Histogram(f"replay.{label}.ttft_seconds")  # standalone
+        for o in outs:
+            h_ttft.observe(o.ttft_s)
         row = {
             "decode_tokens_per_s": metrics["decode_tokens_per_s"],
-            "ttft_p50_s": round(_percentile(ttfts, 0.5), 6),
-            "ttft_p99_s": round(_percentile(ttfts, 0.99), 6),
+            "ttft_p50_s": round(h_ttft.quantile(0.5), 6),
+            "ttft_p99_s": round(h_ttft.quantile(0.99), 6),
             "decode_steps": metrics["decode_steps"],
             "wall_time_s": metrics["wall_time_s"],
         }
+        if trace:
+            # one EXTRA traced replay on the warm engine — attribution
+            # without perturbing the measured run above
+            from pipegoose_tpu.telemetry.reqtrace import RequestTracer
+
+            tracer = RequestTracer(registry=engine.registry,
+                                   keep_completed=max(n_requests, 1))
+            engine.attach_tracer(tracer)
+            engine.run(requests())
+            arm_traces[label] = tracer.attribution_summary()
+            engine.attach_tracer(None)
         # one basis for every arm: prompt tokens the engine actually
         # forwarded (metrics["prefill_tokens"]), so the cached arms'
         # reduction divides like-for-like against the baseline
@@ -1039,4 +1135,33 @@ def prefix_replay_benchmark(params, config, *, n_requests=12, n_prefixes=3,
             / max(base["decode_tokens_per_s"], 1e-9), 3,
         ),
     }
+    if trace:
+        bt, ct = arm_traces["baseline"], arm_traces["cached"]
+        b_ttft = bt["mean_ttft_s"] or 0.0
+        c_ttft = ct["mean_ttft_s"] or 0.0
+        b_pre = bt["mean_ttft_components"]["prefill_s"]
+        c_pre = ct["mean_ttft_components"]["prefill_s"]
+        results["request_trace"] = {
+            "arms": arm_traces,
+            # where did the cached arm's TTFT win come from? The queue
+            # and prefill components decompose it, and the cache-savings
+            # share (hit tokens / prompt tokens) must account for the
+            # prefill-side reduction — ≈ prefill_token_reduction by
+            # construction (both count the same hits)
+            "summary": {
+                "baseline_mean_ttft_s": b_ttft,
+                "cached_mean_ttft_s": c_ttft,
+                "ttft_improvement_s": b_ttft - c_ttft,
+                "baseline_prefill_component_s": b_pre,
+                "cached_prefill_component_s": c_pre,
+                "prefill_component_reduction_s": b_pre - c_pre,
+                "cache_hit_share": ct["cache_hit_share"],
+                "prefill_token_reduction": (
+                    results["summary"]["prefill_token_reduction"]
+                ),
+                "cached_mean_cache_saved_est_s": (
+                    ct["mean_cache_saved_est_s"]
+                ),
+            },
+        }
     return results

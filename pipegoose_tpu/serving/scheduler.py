@@ -78,10 +78,18 @@ class Request:
     hit_tokens: int = 0                # of those, tokens served by the cache
     cow: Optional[Tuple[int, int]] = None  # (src page, valid tokens) pending copy
     finish_reason: Optional[str] = None
+    # timestamp contract (attribution depends on it): t_submit and
+    # t_admit mark the FIRST submission/admission and survive
+    # preempt -> re-admit untouched, as does t_first_token — so
+    # queue_latency_s and ttft_s always measure the user-visible waits,
+    # never a requeue artifact. ttft_observed dedupes the engine's TTFT
+    # histogram observation (exactly once per request, whichever
+    # prefill path(s) the request crosses).
     t_submit: Optional[float] = None
     t_admit: Optional[float] = None
     t_first_token: Optional[float] = None
     t_done: Optional[float] = None
+    ttft_observed: bool = False
 
     @property
     def prompt_len(self) -> int:
@@ -114,7 +122,7 @@ class Request:
 class Scheduler:
     def __init__(self, num_slots: int, pool: PagePool, max_context: int,
                  continuous: bool = True, prefix_cache=None,
-                 chunk_tokens: Optional[int] = None):
+                 chunk_tokens: Optional[int] = None, tracer=None):
         if num_slots < 1:
             raise ValueError("need at least one decode slot")
         if chunk_tokens is not None and (
@@ -130,6 +138,11 @@ class Scheduler:
         self.continuous = continuous
         self.cache = prefix_cache
         self.chunk_tokens = chunk_tokens
+        # request-lifecycle observer (telemetry/reqtrace.py): the
+        # scheduler owns the lifecycle transitions, so it drives the
+        # tracer's submit/admit/preempt/first-token/done hooks; None
+        # (the default) costs one attribute read + branch per event
+        self.tracer = tracer
         self.slots: List[Optional[Request]] = [None] * num_slots
         self.queue: deque = deque()
         self._outstanding_total = 0
@@ -158,6 +171,8 @@ class Scheduler:
         req.t_submit = now
         req.status = Status.QUEUED
         self.queue.append(req)
+        if self.tracer is not None:
+            self.tracer.on_submit(req, now)
 
     def admit(self, now: float) -> List[Request]:
         """Move queued requests into free slots while the pool (plus
@@ -210,7 +225,11 @@ class Scheduler:
             req.slot = free_slots[0]
             self.slots[req.slot] = req
             req.status = Status.PREFILL
-            req.t_admit = now
+            if req.t_admit is None:
+                # FIRST admission only: a preempted request's re-admit
+                # must not rewrite queue_latency_s (the attribution
+                # layer books the requeue wait as stall time instead)
+                req.t_admit = now
             req.cow = None
             req.pages = []
             req.prefilled_len = req.hit_tokens = 0
@@ -230,6 +249,8 @@ class Scheduler:
             req.outstanding = need_new - n_now
             self._outstanding_total += req.outstanding
             admitted.append(req)
+            if self.tracer is not None:
+                self.tracer.on_admit(req, now)
         return admitted
 
     def preempt(self, req: Request) -> None:
@@ -259,6 +280,8 @@ class Scheduler:
                and self.queue[pos].uid < req.uid):
             pos += 1
         self.queue.insert(pos, req)
+        if self.tracer is not None:
+            self.tracer.on_preempt(req)
 
     def ensure_pages(self, req: Request, n_tokens: int) -> None:
         """Lazy growth to cover ``n_tokens`` cached positions (decode:
@@ -295,6 +318,8 @@ class Scheduler:
     def record_token(self, req: Request, token: int, now: float) -> None:
         if req.t_first_token is None:
             req.t_first_token = now
+            if self.tracer is not None:
+                self.tracer.on_first_token(req, now)
         req.status = Status.DECODE
         req.generated.append(int(token))
         if req.eos_token_id is not None and int(token) == req.eos_token_id:
@@ -342,6 +367,8 @@ class Scheduler:
         self._outstanding_total -= req.outstanding
         req.outstanding = 0
         self.slots[req.slot] = None
+        if self.tracer is not None:
+            self.tracer.on_done(req, now)
 
     # -- queries -----------------------------------------------------------
 

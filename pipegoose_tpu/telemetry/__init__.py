@@ -38,6 +38,17 @@ from pipegoose_tpu.telemetry.chrometrace import (
     span_events_to_trace,
     trace_from_jsonl,
 )
+from pipegoose_tpu.telemetry.opsserver import OpsServer, parse_prometheus_text
+from pipegoose_tpu.telemetry.reqtrace import (
+    RequestTimeline,
+    RequestTracer,
+    request_trace_events,
+)
+from pipegoose_tpu.telemetry.slo import (
+    SLOMonitor,
+    SLOTarget,
+    default_serving_slos,
+)
 from pipegoose_tpu.telemetry.derived import (
     HBM_BYTES,
     PEAK_DCI_BYTES,
@@ -97,10 +108,15 @@ __all__ = [
     "MemoryReport",
     "MetricsRegistry",
     "HBM_BYTES",
+    "OpsServer",
     "PEAK_DCI_BYTES",
     "PEAK_FLOPS",
     "PEAK_ICI_BYTES",
     "PrometheusTextfileExporter",
+    "RequestTimeline",
+    "RequestTracer",
+    "SLOMonitor",
+    "SLOTarget",
     "ShardingRegressionError",
     "ShardingReport",
     "TelemetryCallback",
@@ -111,6 +127,7 @@ __all__ = [
     "collective_bytes",
     "compiled_step_stats",
     "current_span_path",
+    "default_serving_slos",
     "diagnose",
     "disable",
     "enable",
@@ -120,9 +137,11 @@ __all__ = [
     "host_health",
     "iter_collectives",
     "mfu",
+    "parse_prometheus_text",
     "peak_flops_for",
     "pipeline_trace_events",
     "register_pipeline_gauges",
+    "request_trace_events",
     "set_doctor_gauges",
     "estimated_wire_bytes",
     "wire_bytes_by_axes",

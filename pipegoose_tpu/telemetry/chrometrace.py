@@ -38,6 +38,7 @@ from pipegoose_tpu.utils.procindex import RankFilter as _RankFilter
 # fixed pid per row family so multiple writers agree
 PID_HOST = 1        # host-side spans (trainer/serving/decode driver)
 PID_PIPELINE = 2    # theoretical pipeline clock timeline
+PID_REQUESTS = 3    # per-request serving timelines (telemetry/reqtrace.py)
 
 
 def span_events_to_trace(
@@ -248,6 +249,14 @@ class ChromeTraceExporter:
         """Attach a ``GPipeScheduler`` clock timeline's rows (see
         :func:`pipeline_trace_events`)."""
         self.add_events(pipeline_trace_events(scheduler, **kwargs))
+
+    def add_request_timelines(self, tracer: Any, **kwargs: Any) -> None:
+        """Attach a ``RequestTracer``'s per-slot request timelines (see
+        ``telemetry.reqtrace.request_trace_events``) as their own
+        process group next to the host spans and pipeline rows."""
+        from pipegoose_tpu.telemetry.reqtrace import request_trace_events
+
+        self.add_events(request_trace_events(tracer, **kwargs))
 
     def write(self, path: Optional[str] = None) -> Optional[str]:
         """Render and atomically write the trace JSON; returns the path

@@ -53,7 +53,8 @@ from pipegoose_tpu.trainer.callback import Callback, _host_scalar
 class TriggerEvent:
     """One fired anomaly trigger (and its black-box dump, if written)."""
 
-    name: str          # "nonfinite" | "loss_spike" | "grad_explosion" | "decode_stall"
+    name: str          # "nonfinite" | "loss_spike" | "grad_explosion" |
+    #                    "decode_stall" | "slo_burn" | custom (fire_trigger)
     reason: str        # human-readable; names the offending module group
     step: int
     details: Dict[str, Any] = dataclasses.field(default_factory=dict)
@@ -115,6 +116,10 @@ class FlightRecorder(Callback):
         # post-mortem sees the partitioning plan that produced the
         # anomaly (set at construction or via set_doctor_report)
         self.doctor_report = doctor_report
+        # request-lifecycle context (telemetry/reqtrace.py): when set, a
+        # black box embeds the in-flight + last-N completed request
+        # timelines, so a decode_stall dump NAMES the stuck request
+        self._req_tracer = None
         self.records: deque = deque(maxlen=capacity)
         self.dumps: List[str] = []
         self.last_trigger: Optional[TriggerEvent] = None
@@ -303,6 +308,26 @@ class FlightRecorder(Callback):
         right after construction, or a re-diagnosis after a recompile."""
         self.doctor_report = report
 
+    def set_request_tracer(self, tracer: Any) -> None:
+        """Attach a ``telemetry.reqtrace.RequestTracer`` whose in-flight
+        and recent completed timelines every subsequent black-box dump
+        embeds (``ServingEngine`` wires this when given both)."""
+        self._req_tracer = tracer
+
+    def fire_trigger(
+        self, name: str, reason: str, step: int,
+        context: Optional[dict] = None,
+        details: Optional[Dict[str, Any]] = None,
+    ) -> TriggerEvent:
+        """Fire a structured trigger by name (black-box dump + pending
+        ``last_trigger``) — the generic path custom monitors (e.g. the
+        SLO burn-rate monitor, telemetry/slo.py) raise through; the
+        built-in training/serving triggers are thin wrappers over it."""
+        trig = TriggerEvent(name, reason, step, dict(details or {}))
+        trig.dump_path = self.dump(trig, context=context)
+        self.last_trigger = trig
+        return trig
+
     def take_trigger(self) -> Optional[TriggerEvent]:
         """Consume the pending trigger (recovery's entry point)."""
         trig, self.last_trigger = self.last_trigger, None
@@ -327,10 +352,9 @@ class FlightRecorder(Callback):
         **details: Any,
     ) -> TriggerEvent:
         """Fire the serving watchdog trigger and dump the black box."""
-        trig = TriggerEvent("decode_stall", reason, step, details)
-        trig.dump_path = self.dump(trig, context=context)
-        self.last_trigger = trig
-        return trig
+        return self.fire_trigger(
+            "decode_stall", reason, step, context=context, details=details
+        )
 
     # -- dump --------------------------------------------------------------
 
@@ -405,6 +429,13 @@ class FlightRecorder(Callback):
         if self.doctor_report is not None:
             rep = self.doctor_report
             payload["doctor"] = rep.to_json() if hasattr(rep, "to_json") else rep
+        if self._req_tracer is not None:
+            try:
+                payload["request_timelines"] = (
+                    self._req_tracer.blackbox_payload()
+                )
+            except Exception:  # noqa: BLE001 - never let forensics crash
+                pass
         atomic_write_text(
             path, safe_json_dumps(payload, indent=1), suffix=".blackbox.tmp"
         )

@@ -1,0 +1,289 @@
+"""Live ops endpoint: /metrics, /healthz, /debug/requests, /debug/doctor.
+
+Every exporter so far writes FILES (JSONL, Prometheus textfile, trace
+JSON, black boxes) — fine for post-mortems, useless for "is the serving
+engine healthy RIGHT NOW?". :class:`OpsServer` is a stdlib-only
+(``http.server``; the container's jax 0.4.37 image gets no new deps)
+background HTTP endpoint over the same telemetry objects:
+
+- ``GET /metrics``        Prometheus text exposition rendered live from
+                          the registry — byte-identical to what
+                          ``PrometheusTextfileExporter`` would write
+                          for the same snapshot, so one scrape config
+                          covers both transports.
+- ``GET /healthz``        200 when healthy, 503 when degraded, with a
+                          JSON body naming WHY: an un-consumed flight-
+                          recorder trigger (decode stall, nonfinite,
+                          slo_burn, ...) and/or a breaching SLO target
+                          (the monitor is evaluated on every probe, so
+                          a blown burn rate flips the probe within one
+                          evaluation of the data showing it).
+- ``GET /debug/requests`` in-flight + recent request timelines from the
+                          ``RequestTracer`` as JSON — "which request is
+                          stuck and where is its latency going".
+- ``GET /debug/doctor``   the last mesh-doctor ``DoctorReport`` as JSON
+                          (the compiled program's sharding plan).
+
+Operational posture: rank-0-filtered (non-zero ranks never bind a
+socket — same ``RankFilter`` convention as the file exporters),
+``port=0`` binds an ephemeral port (tests and multi-tenant hosts),
+handlers snapshot shared state under the server lock and serialize
+with ``safe_json_dumps`` (non-finite floats land as strings, like
+every other telemetry artifact). The server runs on daemon threads and
+is explicitly ``stop()``-able; nothing starts unless the caller
+constructs one, so the engine's default hot path is untouched.
+"""
+from __future__ import annotations
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional, Tuple
+
+from pipegoose_tpu.telemetry.registry import MetricsRegistry, get_registry
+from pipegoose_tpu.utils.procindex import RankFilter as _RankFilter
+
+PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+class OpsServer:
+    """Background ops HTTP endpoint (see module docstring).
+
+    ``slo``: optional ``telemetry.slo.SLOMonitor`` (evaluated per
+    ``/healthz`` probe). ``recorder``: optional ``FlightRecorder`` —
+    a pending (un-consumed) trigger marks the process degraded.
+    ``tracer``: optional ``RequestTracer`` behind ``/debug/requests``.
+    ``doctor``: a ``DoctorReport`` or a zero-arg callable returning one
+    (e.g. ``lambda: engine.last_doctor_report``).
+    """
+
+    def __init__(
+        self,
+        registry: Optional[MetricsRegistry] = None,
+        *,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        rank: Optional[int] = 0,
+        slo: Optional[Any] = None,
+        recorder: Optional[Any] = None,
+        tracer: Optional[Any] = None,
+        doctor: Optional[Any] = None,
+    ):
+        self.registry = registry if registry is not None else get_registry()
+        self.host = host
+        self._requested_port = int(port)
+        self._rank_ok = _RankFilter(rank)
+        self.slo = slo
+        self.recorder = recorder
+        self.tracer = tracer
+        self._doctor = doctor
+        self._lock = threading.Lock()
+        # SLOMonitor mutates per-target state on evaluate(), so
+        # concurrent /healthz probes must serialize — but on its OWN
+        # lock: a breach transition fires a flight-recorder black-box
+        # dump (disk write) mid-evaluation, and holding the server lock
+        # through that would stall a concurrent /metrics scrape exactly
+        # when the system is degraded.
+        self._slo_lock = threading.Lock()
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    # -- wiring ------------------------------------------------------------
+
+    def set_doctor_report(self, report: Any) -> None:
+        """Attach (or replace) the report behind ``/debug/doctor``."""
+        with self._lock:
+            self._doctor = report
+
+    def _doctor_report(self) -> Optional[Any]:
+        with self._lock:
+            d = self._doctor
+        if callable(d) and not hasattr(d, "to_json"):
+            try:
+                return d()
+            except Exception:  # noqa: BLE001 - provider failure != 500 storm
+                return None
+        return d
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> Optional[str]:
+        """Bind + serve on a daemon thread; returns the base URL, or
+        None when rank-filtered out (non-zero ranks are no-ops so the
+        same construction code runs on every process)."""
+        if not self._rank_ok():
+            return None
+        if self._httpd is not None:
+            return self.url
+        handler = _make_handler(self)
+        self._httpd = ThreadingHTTPServer(
+            (self.host, self._requested_port), handler
+        )
+        self._httpd.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name="pipegoose-ops-server",
+            daemon=True,
+        )
+        self._thread.start()
+        return self.url
+
+    def stop(self) -> None:
+        if self._httpd is None:
+            return
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+        self._httpd = None
+        self._thread = None
+
+    @property
+    def port(self) -> Optional[int]:
+        return None if self._httpd is None else self._httpd.server_address[1]
+
+    @property
+    def url(self) -> Optional[str]:
+        p = self.port
+        return None if p is None else f"http://{self.host}:{p}"
+
+    def __enter__(self) -> "OpsServer":
+        self.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- endpoint payloads (snapshot-under-lock) ---------------------------
+
+    def render_metrics(self) -> str:
+        with self._lock:
+            return self.registry.to_prometheus()
+
+    def health(self) -> Tuple[int, Dict[str, Any]]:
+        """(status_code, body) for ``/healthz``: 200 iff no pending
+        flight-recorder trigger and no breaching SLO target."""
+        problems = []
+        trig = getattr(self.recorder, "last_trigger", None)
+        if trig is not None:
+            problems.append({
+                "kind": "flight_recorder_trigger",
+                "name": trig.name,
+                "reason": trig.reason,
+                "step": trig.step,
+                "dump_path": trig.dump_path,
+            })
+        slo_status = None
+        if self.slo is not None:
+            with self._slo_lock:
+                slo_status = self.slo.status()
+            if not slo_status.get("ok", True):
+                for name, t in slo_status.get("targets", {}).items():
+                    if t.get("breaching"):
+                        problems.append({
+                            "kind": "slo_burn",
+                            "name": name,
+                            "burn_fast": t.get("burn_fast"),
+                            "burn_slow": t.get("burn_slow"),
+                        })
+        body: Dict[str, Any] = {
+            "ok": not problems,
+            "problems": problems,
+        }
+        if slo_status is not None:
+            body["slo"] = slo_status
+        if self.tracer is not None:
+            # the tracer guards its own state; len() needs no ops lock
+            body["requests_in_flight"] = len(self.tracer.in_flight)
+        return (200 if not problems else 503), body
+
+    def debug_requests(self) -> Optional[Dict[str, Any]]:
+        if self.tracer is None:
+            return None
+        with self._lock:
+            return self.tracer.snapshot()
+
+
+def _make_handler(ops: OpsServer):
+    """Handler class closed over the server object (BaseHTTPRequestHandler
+    is instantiated per connection by ThreadingHTTPServer)."""
+    from pipegoose_tpu.telemetry.exporters import safe_json_dumps
+
+    class _OpsHandler(BaseHTTPRequestHandler):
+        server_version = "pipegoose-ops/1"
+
+        def log_message(self, fmt: str, *args: Any) -> None:
+            pass  # probes must not spam the serving process's stderr
+
+        def _send(self, code: int, body: bytes, content_type: str) -> None:
+            self.send_response(code)
+            self.send_header("Content-Type", content_type)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _send_json(self, code: int, payload: Any) -> None:
+            self._send(code, (safe_json_dumps(payload, indent=1) + "\n")
+                       .encode(), "application/json")
+
+        def do_GET(self) -> None:  # noqa: N802 - http.server API
+            path = self.path.split("?", 1)[0].rstrip("/") or "/"
+            try:
+                if path == "/metrics":
+                    self._send(200, ops.render_metrics().encode(),
+                               PROM_CONTENT_TYPE)
+                elif path == "/healthz":
+                    code, body = ops.health()
+                    self._send_json(code, body)
+                elif path == "/debug/requests":
+                    payload = ops.debug_requests()
+                    if payload is None:
+                        self._send_json(404, {"error": "no request tracer "
+                                              "attached"})
+                    else:
+                        self._send_json(200, payload)
+                elif path == "/debug/doctor":
+                    report = ops._doctor_report()
+                    if report is None:
+                        self._send_json(404, {"error": "no doctor report "
+                                              "attached"})
+                    else:
+                        payload = (report.to_json()
+                                   if hasattr(report, "to_json") else report)
+                        self._send_json(200, payload)
+                elif path == "/":
+                    self._send_json(200, {
+                        "endpoints": ["/metrics", "/healthz",
+                                      "/debug/requests", "/debug/doctor"],
+                    })
+                else:
+                    self._send_json(404, {"error": f"unknown path {path!r}"})
+            except (BrokenPipeError, ConnectionResetError):
+                pass  # probe hung up mid-response: not our problem
+            except Exception as e:  # noqa: BLE001 - a handler bug must
+                # surface as a 500 on THIS probe, not kill the thread pool
+                try:
+                    self._send_json(500, {"error": f"{type(e).__name__}: {e}"})
+                except Exception:  # noqa: BLE001
+                    pass
+
+    return _OpsHandler
+
+
+def parse_prometheus_text(text: str) -> Dict[str, float]:
+    """Minimal parser for the text exposition format: sample lines ->
+    {name_with_labels: value}. Raises ValueError on a malformed line —
+    what the CI smoke and tests use to assert ``/metrics`` parses."""
+    out: Dict[str, float] = {}
+    for i, line in enumerate(text.splitlines()):
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        parts = line.rsplit(" ", 1)
+        if len(parts) != 2:
+            raise ValueError(f"line {i + 1}: not '<name> <value>': {line!r}")
+        name, value = parts
+        key = name.split("{", 1)[0]
+        if not key or not (key[0].isalpha() or key[0] == "_"):
+            raise ValueError(f"line {i + 1}: bad metric name {name!r}")
+        out[name] = float(value)  # ValueError on a non-numeric sample
+    return out

@@ -63,6 +63,32 @@ python scripts/plan_parallelism.py --fake-devices 8 \
     --check --tp 4 --dp 2 --overlap --grad-comm int8 \
     --tolerance 0.3 --quiet
 
+# Ops-endpoint smoke (telemetry/opsserver.py, ISSUE 8): start the live
+# endpoint on an ephemeral port, scrape /metrics and /healthz, and
+# assert the exposition parses — the stdlib-only serving observability
+# surface must come up before any engine does.
+echo "== ops endpoint smoke =="
+python - <<'PY'
+import json
+from urllib.request import urlopen
+
+from pipegoose_tpu.telemetry.opsserver import OpsServer, parse_prometheus_text
+from pipegoose_tpu.telemetry.registry import MetricsRegistry
+
+reg = MetricsRegistry(enabled=True)
+reg.counter("smoke.requests_total").inc(3)
+reg.histogram("smoke.latency_seconds").observe(0.01)
+with OpsServer(registry=reg, port=0) as srv:
+    assert srv.url, "ops server refused to start"
+    body = urlopen(srv.url + "/metrics", timeout=5).read().decode()
+    parsed = parse_prometheus_text(body)
+    assert parsed["smoke_requests_total"] == 3.0, body
+    assert parsed["smoke_latency_seconds_count"] == 1.0, body
+    hz = urlopen(srv.url + "/healthz", timeout=5)
+    assert hz.status == 200 and json.loads(hz.read())["ok"] is True
+print("ops endpoint smoke OK")
+PY
+
 echo "== fast tier =="
 python -m pytest tests/ -q -m fast -p no:cacheprovider \
     --continue-on-collection-errors "$@"

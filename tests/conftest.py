@@ -62,6 +62,9 @@ FAST_FILES = {
     "tests/telemetry/test_exporters.py",        # JSONL / Prometheus / rank-0
     "tests/telemetry/test_flightrec.py",        # flight recorder (host-only)
     "tests/telemetry/test_chrometrace.py",      # Perfetto export + bubble
+    "tests/telemetry/test_reqtrace.py",         # request tracing + attribution
+    "tests/telemetry/test_slo.py",              # SLO burn-rate monitor
+    "tests/telemetry/test_opsserver.py",        # live ops endpoint
     "tests/trainer/test_logger.py",             # rank-0 logging (host-only)
     "tests/utils/test_profiler.py",             # cost analysis arithmetic
     "tests/test_lint_jit_safety.py",            # jit-safety AST lint gate
@@ -284,6 +287,39 @@ SLOW_TESTS = {
     "tests/nn/tensor_parallel/test_overlap.py::test_ring_matmul_reduce_scatter_matches_psum[4]",
     "tests/distributed/test_compressed.py::test_compressed_all_reduce_mean_shapes_and_values",
     "tests/test_examples.py::test_example_runs[comm_overlap_demo.py]",
+    # request tracing (ISSUE 8): tier-1 keeps the attribution sum pins,
+    # TTFT-once across both preempt paths, and the stall black box; the
+    # two heaviest redundant nodes move out — tracer-off token identity
+    # is already implied by every serving equivalence test plus the
+    # traced runs' own output checks, and the demo's stack (attribution
+    # + ops endpoint + injected stall) is covered by the fast-tier
+    # reqtrace/slo/opsserver suites (precedent: three other demos here)
+    "tests/serving/test_request_tracing.py::test_tracer_off_is_token_identical",
+    "tests/test_examples.py::test_example_runs[request_trace_demo.py]",
+    # second re-curation pass from measured durations (the full
+    # `not slow` run measured 898s vs the 870s wall on this box —
+    # ~100s of that is box drift vs the 844s measured days earlier):
+    # the heaviest redundant nodes move out, each keeping a cheaper
+    # tier-1 or fast-tier sibling —
+    # * int8 5-step parity: the 8-step 1% runs are already slow-tier
+    #   pins above, and tier-1 keeps the int8 round-trip bound (fast)
+    #   plus test_int8_reduction_payload_bytes_drop_3x
+    "tests/test_comm_hybrid.py::test_int8_grad_comm_short_run_tracks_fp32",
+    # * sharded health reference: the health MATH is fast-tier-pinned
+    #   single-device (test_health_stats_math_single_device + the
+    #   off-guard), and tier-1 keeps the sharded overflow-localization
+    #   node (test_injected_overflow_localizes_to_module_group)
+    "tests/telemetry/test_health.py::test_sharded_health_matches_single_device_reference",
+    # * demos whose subsystems have dedicated tier-1/fast suites
+    #   (precedent: four other demos above): flight recorder →
+    #   test_recovery's dump-names-module e2e + flightrec fast tier;
+    #   serving demo → test_engine token-identity + A/B nodes;
+    #   telemetry demo → callback/exporters suites; encoder MLM →
+    #   test_albert HF-parity + the pp/sp equivalence runs
+    "tests/test_examples.py::test_example_runs[flight_recorder_demo.py]",
+    "tests/test_examples.py::test_example_runs[serve_bloom.py]",
+    "tests/test_examples.py::test_example_runs[telemetry_demo.py]",
+    "tests/test_examples.py::test_example_runs[encoder_mlm.py]",
 }
 
 

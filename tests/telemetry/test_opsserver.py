@@ -1,0 +1,178 @@
+"""Ops endpoint against a real ephemeral-port HTTP server: /metrics
+parses and agrees with the textfile exporter, /healthz flips 200→503 on
+flight-recorder triggers and SLO burn, the debug endpoints serve the
+tracer and doctor payloads, and ranks other than 0 never bind."""
+import json
+from types import SimpleNamespace
+from urllib.error import HTTPError
+from urllib.request import urlopen
+
+import pytest
+
+from pipegoose_tpu.telemetry.exporters import PrometheusTextfileExporter
+from pipegoose_tpu.telemetry.flightrec import FlightRecorder
+from pipegoose_tpu.telemetry.opsserver import OpsServer, parse_prometheus_text
+from pipegoose_tpu.telemetry.registry import MetricsRegistry
+from pipegoose_tpu.telemetry.reqtrace import RequestTracer
+from pipegoose_tpu.telemetry.slo import SLOMonitor, SLOTarget
+
+
+def _get(url):
+    try:
+        r = urlopen(url, timeout=5)
+        return r.status, r.read().decode()
+    except HTTPError as e:  # 4xx/5xx still carry a JSON body
+        return e.code, e.read().decode()
+
+
+@pytest.fixture()
+def reg():
+    r = MetricsRegistry(enabled=True)
+    r.counter("serving.tokens_total", help="tokens").inc(42)
+    r.gauge("serving.queue_depth").set(3)
+    h = r.histogram("serving.ttft_seconds")
+    h.observe(0.02)
+    h.observe(0.2)
+    return r
+
+
+def test_metrics_parses_and_agrees_with_textfile_exporter(reg, tmp_path):
+    with OpsServer(registry=reg, port=0) as srv:
+        assert srv.url is not None and srv.port != 0  # ephemeral bind
+        code, live = _get(srv.url + "/metrics")
+    assert code == 200
+    parsed = parse_prometheus_text(live)
+    assert parsed["serving_tokens_total"] == 42.0
+    assert parsed["serving_queue_depth"] == 3.0
+    assert parsed["serving_ttft_seconds_count"] == 2.0
+    # one scrape config covers both transports: the live endpoint and
+    # the textfile exporter render the identical exposition
+    path = str(tmp_path / "snap.prom")
+    PrometheusTextfileExporter(path).write(reg)
+    assert open(path).read() == live
+
+
+def test_healthz_flips_on_flight_recorder_trigger(reg, tmp_path):
+    rec = FlightRecorder(str(tmp_path))
+    with OpsServer(registry=reg, port=0, recorder=rec) as srv:
+        code, body = _get(srv.url + "/healthz")
+        assert code == 200 and json.loads(body)["ok"] is True
+        rec.trigger_decode_stall(17, "no decode progress for 100 iterations")
+        code, body = _get(srv.url + "/healthz")
+        payload = json.loads(body)
+        assert code == 503 and payload["ok"] is False
+        (problem,) = payload["problems"]
+        assert problem["kind"] == "flight_recorder_trigger"
+        assert problem["name"] == "decode_stall"
+        assert "no decode progress" in problem["reason"]
+        # consuming the trigger (recovery) restores health
+        rec.take_trigger()
+        code, _ = _get(srv.url + "/healthz")
+        assert code == 200
+
+
+def test_healthz_flips_on_blown_slo_burn(reg):
+    clock = [0.0]
+    mon = SLOMonitor(
+        [SLOTarget(name="ttft", metric="serving.ttft_seconds",
+                   objective=0.1, target=0.9)],
+        registry=reg, fast_window_s=10, slow_window_s=100,
+        burn_threshold=2.0, clock=lambda: clock[0],
+    )
+    with OpsServer(registry=reg, port=0, slo=mon) as srv:
+        code, body = _get(srv.url + "/healthz")   # baseline evaluation
+        assert code == 200 and "slo" in json.loads(body)
+        for _ in range(30):
+            reg.metrics()["serving.ttft_seconds"].observe(9.0)
+        clock[0] = 5.0
+        # within ONE evaluation of the data showing the burn: the very
+        # next probe evaluates the windows and reports 503
+        code, body = _get(srv.url + "/healthz")
+        payload = json.loads(body)
+        assert code == 503
+        kinds = {p["kind"] for p in payload["problems"]}
+        assert "slo_burn" in kinds
+        assert payload["slo"]["targets"]["ttft"]["breaching"] is True
+
+
+def test_debug_requests_serves_tracer_snapshot(reg):
+    tracer = RequestTracer(registry=reg)
+    req = SimpleNamespace(uid=5, prompt_len=8, max_new_tokens=4, slot=None,
+                          hit_tokens=0, generated=[], finish_reason=None)
+    tracer.on_submit(req, 0.0)
+    req.slot = 1
+    tracer.on_admit(req, 1.0)
+    with OpsServer(registry=reg, port=0, tracer=tracer) as srv:
+        code, body = _get(srv.url + "/debug/requests")
+        payload = json.loads(body)
+        assert code == 200
+        assert [tl["uid"] for tl in payload["in_flight"]] == [5]
+        assert payload["in_flight"][0]["phase"] == "prefill"
+        # /healthz also reports the in-flight count
+        _, hz = _get(srv.url + "/healthz")
+        assert json.loads(hz)["requests_in_flight"] == 1
+
+
+def test_debug_requests_404_without_tracer(reg):
+    with OpsServer(registry=reg, port=0) as srv:
+        code, body = _get(srv.url + "/debug/requests")
+    assert code == 404 and "tracer" in json.loads(body)["error"]
+
+
+def test_debug_doctor_serves_last_report(reg):
+    with OpsServer(registry=reg, port=0) as srv:
+        code, _ = _get(srv.url + "/debug/doctor")
+        assert code == 404
+        srv.set_doctor_report({"collectives": [], "hbm_peak_bytes": 123})
+        code, body = _get(srv.url + "/debug/doctor")
+        assert code == 200
+        assert json.loads(body)["hbm_peak_bytes"] == 123
+
+    class FakeReport:
+        def to_json(self):
+            return {"mesh": "tp2xdp4"}
+
+    with OpsServer(registry=reg, port=0,
+                   doctor=lambda: FakeReport()) as srv:
+        code, body = _get(srv.url + "/debug/doctor")
+        assert code == 200 and json.loads(body)["mesh"] == "tp2xdp4"
+
+
+def test_unknown_path_404_and_root_lists_endpoints(reg):
+    with OpsServer(registry=reg, port=0) as srv:
+        code, _ = _get(srv.url + "/nope")
+        assert code == 404
+        code, body = _get(srv.url + "/")
+        assert code == 200
+        assert "/metrics" in json.loads(body)["endpoints"]
+
+
+def test_rank_filtered_server_never_binds(reg):
+    srv = OpsServer(registry=reg, port=0, rank=1)  # we are process 0
+    assert srv.start() is None
+    assert srv.port is None and srv.url is None
+    srv.stop()  # no-op, must not raise
+
+
+def test_stop_is_idempotent_and_start_after_stop_rebinds(reg):
+    srv = OpsServer(registry=reg, port=0)
+    url1 = srv.start()
+    assert srv.start() == url1  # second start: same server
+    srv.stop()
+    srv.stop()
+    url2 = srv.start()
+    assert url2 is not None
+    code, _ = _get(url2 + "/healthz")
+    assert code == 200
+    srv.stop()
+
+
+def test_parse_prometheus_text_rejects_malformed():
+    with pytest.raises(ValueError):
+        parse_prometheus_text("metric_one 1.0\nbroken line here extra\n")
+    with pytest.raises(ValueError):
+        parse_prometheus_text("metric notanumber\n")
+    out = parse_prometheus_text(
+        "# TYPE a counter\na 1.0\nb{le=\"0.5\"} 2\n\n"
+    )
+    assert out == {"a": 1.0, 'b{le="0.5"}': 2.0}

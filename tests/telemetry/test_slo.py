@@ -1,0 +1,195 @@
+"""SLO monitor units: hand-computed burn rates over fast/slow windows,
+conservative bucket accounting, breach-episode triggers through the
+flight recorder, and the ratio (error-rate) target kind."""
+import json
+
+import pytest
+
+from pipegoose_tpu.telemetry.flightrec import FlightRecorder
+from pipegoose_tpu.telemetry.registry import MetricsRegistry
+from pipegoose_tpu.telemetry.slo import (
+    SLOMonitor,
+    SLOTarget,
+    default_serving_slos,
+)
+
+
+@pytest.fixture()
+def reg():
+    return MetricsRegistry(enabled=True)
+
+
+def _monitor(reg, targets=None, **kw):
+    clock = [0.0]
+    kw.setdefault("fast_window_s", 10.0)
+    kw.setdefault("slow_window_s", 100.0)
+    kw.setdefault("burn_threshold", 2.0)
+    mon = SLOMonitor(
+        targets or [SLOTarget(name="ttft", metric="serving.ttft_seconds",
+                              objective=0.1, target=0.9)],
+        registry=reg, clock=lambda: clock[0], **kw,
+    )
+    return mon, clock
+
+
+def test_target_validation():
+    with pytest.raises(ValueError, match="target must be in"):
+        SLOTarget(name="x", metric="m", target=1.0)
+    with pytest.raises(ValueError, match="latency kind needs"):
+        SLOTarget(name="x")
+    with pytest.raises(ValueError, match="ratio kind needs"):
+        SLOTarget(name="x", kind="ratio")
+    with pytest.raises(ValueError, match="unknown kind"):
+        SLOTarget(name="x", metric="m", kind="mean")
+
+
+def test_monitor_validation(reg):
+    t = SLOTarget(name="a", metric="m")
+    with pytest.raises(ValueError, match="at least one"):
+        SLOMonitor([], registry=reg)
+    with pytest.raises(ValueError, match="windows"):
+        SLOMonitor([t], registry=reg, fast_window_s=60, slow_window_s=60)
+    with pytest.raises(ValueError, match="duplicate"):
+        SLOMonitor([t, t], registry=reg)
+
+
+def test_burn_rate_hand_computed(reg):
+    """target=0.9 -> 10% budget. 20 good then 5 bad out of 25 new
+    events in the window -> bad fraction 0.2 -> burn 2.0."""
+    h = reg.histogram("serving.ttft_seconds")
+    mon, clock = _monitor(reg)
+    mon.evaluate()                       # baseline sample at t=0
+    for _ in range(20):
+        h.observe(0.01)                  # good: <= 0.1
+    for _ in range(5):
+        h.observe(1.0)                   # bad
+    clock[0] = 5.0
+    st = mon.evaluate()
+    t = st["targets"]["ttft"]
+    assert t["bad_fraction_fast"] == pytest.approx(5 / 25)
+    assert t["burn_fast"] == pytest.approx((5 / 25) / 0.1)
+    assert t["breaching"] and not st["ok"]
+    # gauges exported next to the histograms they judge
+    snap = reg.snapshot()
+    assert snap["gauges"]["slo.ttft.burn_fast"] == pytest.approx(2.0)
+    assert snap["gauges"]["slo.breaching"] == 1.0
+    assert snap["counters"]["slo.alerts_total"] == 1.0
+
+
+def test_no_data_means_no_burn(reg):
+    mon, clock = _monitor(reg)
+    st = mon.evaluate()
+    assert st["ok"]
+    clock[0] = 50.0
+    st = mon.evaluate()                  # still no observations
+    assert st["ok"]
+    assert st["targets"]["ttft"]["events_fast"] == 0
+
+
+def test_objective_between_buckets_counts_conservatively(reg):
+    """An observation in the bucket straddling the objective counts as
+    BAD (only buckets whose upper bound <= objective are good) — the
+    monitor over-alerts rather than under-alerts."""
+    h = reg.histogram("x.seconds", buckets=(0.1, 1.0))
+    mon, clock = _monitor(
+        reg, [SLOTarget(name="x", metric="x.seconds", objective=0.5,
+                        target=0.5)],
+    )
+    mon.evaluate()
+    h.observe(0.3)   # truly meets the 0.5 objective, but lands in the
+    h.observe(0.05)  # (0.1, 1.0] bucket -> judged bad
+    clock[0] = 5.0
+    st = mon.evaluate()
+    assert st["targets"]["x"]["bad_fraction_fast"] == pytest.approx(0.5)
+
+
+def test_short_blip_does_not_page_when_slow_window_is_clean(reg):
+    """Multi-window behavior: a burst that blows the fast window while
+    the slow window still averages under threshold must NOT alert."""
+    h = reg.histogram("serving.ttft_seconds")
+    mon, clock = _monitor(reg)
+    # 200s of good history, sampled every 5s (beyond the slow window)
+    for i in range(41):
+        clock[0] = i * 5.0
+        for _ in range(10):
+            h.observe(0.01)
+        mon.evaluate()
+    # now a short 100%-bad burst inside the fast window only
+    clock[0] = 205.0
+    for _ in range(10):
+        h.observe(2.0)
+    st = mon.evaluate()
+    t = st["targets"]["ttft"]
+    assert t["burn_fast"] >= 2.0          # fast window is on fire...
+    assert t["burn_slow"] < 2.0           # ...slow window dilutes it
+    assert st["ok"]                       # -> no page
+
+
+def test_trigger_fires_once_per_breach_episode(reg, tmp_path):
+    h = reg.histogram("serving.ttft_seconds")
+    rec = FlightRecorder(str(tmp_path), registry=reg)
+    mon, clock = _monitor(reg, recorder=rec)
+    mon.evaluate()
+    for _ in range(30):
+        h.observe(5.0)
+    clock[0] = 5.0
+    st = mon.evaluate()
+    assert not st["ok"]
+    trig = rec.last_trigger
+    assert trig is not None and trig.name == "slo_burn"
+    assert "ttft" in trig.reason and "burning" in trig.reason
+    assert trig.dump_path is not None
+    blackbox = json.loads(open(trig.dump_path).read())
+    assert blackbox["trigger"]["name"] == "slo_burn"
+    assert blackbox["trigger"]["details"]["target"]["name"] == "ttft"
+    # still breaching on the next evaluation: no second dump
+    clock[0] = 8.0
+    mon.evaluate()
+    assert len(rec.dumps) == 1
+    assert mon.breaching == ["ttft"]
+    # recovery clears the breach state; a NEW episode re-fires
+    clock[0] = 200.0
+    for _ in range(500):
+        h.observe(0.01)
+    mon.evaluate()
+    clock[0] = 205.0
+    st = mon.evaluate()
+    assert st["targets"]["ttft"]["breaching"] is False
+
+
+def test_ratio_kind_uses_counters(reg):
+    bad = reg.counter("serving.errors_total")
+    tot = reg.counter("serving.requests_total")
+    mon, clock = _monitor(
+        reg,
+        [SLOTarget(name="errors", kind="ratio",
+                   bad_metric="serving.errors_total",
+                   total_metric="serving.requests_total", target=0.99)],
+    )
+    mon.evaluate()
+    tot.inc(100)
+    bad.inc(4)
+    clock[0] = 5.0
+    st = mon.evaluate()
+    t = st["targets"]["errors"]
+    assert t["bad_fraction_fast"] == pytest.approx(0.04)
+    assert t["burn_fast"] == pytest.approx(0.04 / 0.01)
+    assert t["breaching"]
+
+
+def test_status_is_evaluate(reg):
+    h = reg.histogram("serving.ttft_seconds")
+    mon, clock = _monitor(reg)
+    mon.evaluate()
+    for _ in range(10):
+        h.observe(9.0)
+    clock[0] = 5.0
+    # /healthz's entry point: one status() call sees the blown budget
+    assert mon.status()["ok"] is False
+
+
+def test_default_serving_slos_cover_ttft_and_decode_gap():
+    targets = default_serving_slos()
+    assert [t.name for t in targets] == ["ttft", "decode_gap"]
+    assert targets[0].metric == "serving.ttft_seconds"
+    assert targets[1].metric == "serving.decode_gap_seconds"

@@ -34,6 +34,8 @@ CASES = [
                                  "/tmp/pipegoose_flightrec_demo_test"]),
     ("mesh_doctor_demo.py", ["--fake-devices", "8", "--tp", "2",
                              "--dp", "4"]),
+    ("request_trace_demo.py", ["--fake-devices", "8", "--out-dir",
+                               "/tmp/pipegoose_reqtrace_demo_test"]),
     ("comm_overlap_demo.py", ["--fake-devices", "8", "--tp", "2",
                               "--dp", "4"]),
     ("plan_parallelism_demo.py", ["--fake-devices", "8", "--top-k", "5"]),
