@@ -1,5 +1,7 @@
 from pipegoose_tpu.parallel.auto import make_auto_train_step
 from pipegoose_tpu.parallel.hybrid import (
+    build_hybrid_train_step,
+    hybrid_build_config,
     hybrid_step_kwargs,
     make_hybrid_train_step,
     parallel_context_sizes,
@@ -9,6 +11,8 @@ from pipegoose_tpu.parallel.hybrid import (
 )
 
 __all__ = [
+    "build_hybrid_train_step",
+    "hybrid_build_config",
     "hybrid_step_kwargs",
     "make_hybrid_train_step",
     "make_auto_train_step",

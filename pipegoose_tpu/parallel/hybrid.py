@@ -139,6 +139,51 @@ def hybrid_step_kwargs(candidate: Any) -> dict:
     return kw
 
 
+def hybrid_build_config(
+    loss_fn: Callable[..., jax.Array],
+    param_specs: Any,
+    optimizer: DistributedOptimizer,
+    batch_spec: P = P("data"),
+    loss_axis: Any = "data",
+    grad_sync_axes: tuple = (),
+    with_rng: bool = False,
+    n_accum: int = 1,
+    with_health: bool = False,
+    grad_comm: Optional[str] = None,
+    overlap_tp: bool = False,
+) -> dict:
+    """Capture everything :func:`make_hybrid_train_step` needs EXCEPT
+    the ``ParallelContext`` — the step-rebuild hook. The trainer stores
+    this dict at construction; after an elastic mesh change
+    (``trainer/elastic.py``: device loss shrank the cluster), the SAME
+    config re-lowered through :func:`build_hybrid_train_step` on the
+    new context yields the recompiled step — one source of truth, no
+    drift between the original build and the rebuild."""
+    return dict(
+        loss_fn=loss_fn,
+        param_specs=param_specs,
+        optimizer=optimizer,
+        batch_spec=batch_spec,
+        loss_axis=loss_axis,
+        grad_sync_axes=grad_sync_axes,
+        with_rng=with_rng,
+        n_accum=n_accum,
+        with_health=with_health,
+        grad_comm=grad_comm,
+        overlap_tp=overlap_tp,
+    )
+
+
+def build_hybrid_train_step(config: dict, parallel_context: ParallelContext):
+    """(init_fn, make_step) for a stored :func:`hybrid_build_config` on
+    ``parallel_context`` — the other half of the rebuild hook."""
+    cfg = dict(config)
+    return make_hybrid_train_step(
+        cfg.pop("loss_fn"), cfg.pop("param_specs"), cfg.pop("optimizer"),
+        parallel_context, **cfg,
+    )
+
+
 def _set_comm_gauges(params, mesh, optimizer, comm_mode: str,
                      overlap_tp: bool, dp_axis: str) -> None:
     """Export the communication-engine config/savings next to the MFU

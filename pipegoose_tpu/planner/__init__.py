@@ -22,7 +22,9 @@ Docs: docs/planner.md.
 from pipegoose_tpu.planner.bloom_builder import BloomPlanModel
 from pipegoose_tpu.planner.cost import CostModel, hbm_check, score_breakdown
 from pipegoose_tpu.planner.planner import (
+    best_layout_at,
     evaluate_candidate,
+    plan_layout_at,
     run_plan,
     set_planner_gauges,
 )
@@ -41,9 +43,11 @@ __all__ = [
     "CandidateResult",
     "CostModel",
     "PlanReport",
+    "best_layout_at",
     "candidate_key",
     "enumerate_candidates",
     "evaluate_candidate",
+    "plan_layout_at",
     "find_candidate",
     "hbm_check",
     "mesh_factorizations",

@@ -29,7 +29,7 @@ from typing import Any, Iterable, Optional
 
 from pipegoose_tpu.planner.cost import CostModel, hbm_check, score_breakdown
 from pipegoose_tpu.planner.report import CandidateResult, PlanReport
-from pipegoose_tpu.planner.space import Candidate
+from pipegoose_tpu.planner.space import Candidate, enumerate_candidates
 from pipegoose_tpu.telemetry import doctor
 
 logger = logging.getLogger("pipegoose_tpu.planner")
@@ -152,3 +152,48 @@ def run_plan(
         logger.info("planner: pruned %s — %s", p.name, p.prune_reason)
     set_planner_gauges(report, registry=registry)
     return report
+
+
+def plan_layout_at(
+    builder: Any,
+    n_devices: int,
+    *,
+    pp_sizes: Any = (1,),
+    ep_sizes: Any = (1,),
+    grad_comms: Any = ("fp32",),
+    overlap: Any = (False,),
+    remat: Any = (True,),
+    n_microbatches: int = 2,
+    cost_model: Optional[CostModel] = None,
+    keep_doctor: bool = False,
+    registry: Any = None,
+    progress: Any = None,
+) -> PlanReport:
+    """Rank the layout space at an ARBITRARY device count — the
+    elasticity query: "a slice died, N devices survive; what is the
+    best feasible (dp, tp, pp) now?". Same machinery as a full plan
+    (every candidate is the real step, shape-only compiled and scored),
+    restricted by default to the recovery-relevant axes: fp32 wire, no
+    overlap/remat sweep — recovery wants ONE good layout fast, not an
+    exhaustive study. ``ElasticRecovery`` (trainer/elastic.py) calls
+    this through :func:`best_layout_at` with the run's own builder."""
+    cands = enumerate_candidates(
+        n_devices, pp_sizes=pp_sizes, ep_sizes=ep_sizes,
+        grad_comms=grad_comms, overlap=overlap, remat=remat,
+        n_microbatches=n_microbatches,
+    )
+    return run_plan(
+        builder, cands, cost_model=cost_model, keep_doctor=keep_doctor,
+        registry=registry, progress=progress,
+    )
+
+
+def best_layout_at(
+    builder: Any, n_devices: int, **plan_kwargs: Any
+) -> Optional[Candidate]:
+    """The winning :class:`Candidate` of :func:`plan_layout_at` (None
+    when NO layout at that device count is feasible — the caller must
+    surface that, not guess)."""
+    report = plan_layout_at(builder, n_devices, **plan_kwargs)
+    top = report.top
+    return top.candidate if top is not None else None

@@ -102,8 +102,12 @@ class RequestOutput:
     generated: np.ndarray
     finish_reason: str
     queue_latency_s: float
-    ttft_s: float
-    decode_tokens_per_s: float
+    # None when the request was never served (finish_reason="shed"):
+    # a 0.0 would read as an instant first token and drag aggregate
+    # TTFT DOWN exactly when the system is degraded — filter shed rows
+    # (or skip Nones) before aggregating
+    ttft_s: Optional[float]
+    decode_tokens_per_s: Optional[float]
     e2e_latency_s: float = 0.0  # submit -> done wall time
 
     @property
@@ -175,6 +179,11 @@ class ServingEngine:
         # branch per site (no per-step registry lock + name lookup)
         reg = self.registry
         self._m_tokens = reg.counter("serving.tokens_total")
+        self._m_requests = reg.counter("serving.requests_total")
+        # deadline shedding (graceful degradation): shed / requests is
+        # the degraded-mode ratio the default SLO set watches
+        # (telemetry/slo.py shed_fraction target)
+        self._m_shed = reg.counter("serving.shed_total")
         self._m_prefills = reg.counter("serving.prefills_total")
         self._m_steps = reg.counter("serving.decode_steps_total")
         self._m_ttft = reg.histogram("serving.ttft_seconds")
@@ -730,6 +739,7 @@ class ServingEngine:
             self.tracer.set_clock(now)
         for r in requests:
             self.sched.submit(r, now())
+        self._m_requests.inc(len(requests))
         self._m_queue.set(len(self.sched.queue))
         tok0 = self._m_tokens.value
         done: List[Request] = []
@@ -749,6 +759,13 @@ class ServingEngine:
             if tick_hook is not None:
                 tick_hook(self, tick)
             admitted = self.sched.admit(now())
+            shed_now = self.sched.drain_shed()
+            if shed_now:
+                # shedding IS the degraded-but-healthy mode: a counter
+                # and terminal outputs, never a watchdog trigger — the
+                # SLO shed-fraction target decides when it's too much
+                self._m_shed.inc(len(shed_now))
+                done.extend(shed_now)
             chunked_this_tick = 0
             if self._paged_prefill:
                 for req in admitted:
@@ -784,7 +801,8 @@ class ServingEngine:
                 # reservation the pool can never cover). The watchdog
                 # turns that silent livelock into a black-box dump + a
                 # loud error.
-                if admitted or chunked_this_tick:
+                if admitted or chunked_this_tick or shed_now:
+                    # shedding is progress: the queue shrank
                     stalled = 0
                 else:
                     stalled += 1
@@ -883,7 +901,36 @@ class ServingEngine:
 
         done.sort(key=lambda r: r.uid)
         outputs, per_request = [], []
+        shed_count = 0
         for r in done:
+            if r.finish_reason == "shed":
+                # terminal but never served: the whole life was queue
+                # (or requeue) wait; TTFT/decode are None (matching the
+                # per_request dict) and the latency histograms are NOT
+                # observed — a shed row must not flatter (or poison)
+                # the served tail
+                shed_count += 1
+                e2e = r.t_done - r.t_submit
+                outputs.append(RequestOutput(
+                    uid=r.uid, prompt=np.asarray(r.prompt),
+                    generated=np.asarray(r.generated, np.int64),
+                    finish_reason="shed",
+                    queue_latency_s=e2e,
+                    ttft_s=None,
+                    decode_tokens_per_s=None,
+                    e2e_latency_s=e2e,
+                ))
+                per_request.append({
+                    "uid": r.uid,
+                    "prompt_len": r.prompt_len,
+                    "new_tokens": len(r.generated),
+                    "finish_reason": "shed",
+                    "queue_latency_s": round(e2e, 6),
+                    "ttft_s": None,
+                    "e2e_latency_s": round(e2e, 6),
+                    "decode_tokens_per_s": None,
+                })
+                continue
             decode_s = max(r.t_done - r.t_admit, 1e-9)
             e2e = r.t_done - r.t_submit
             self._m_e2e.observe(e2e)
@@ -920,6 +967,8 @@ class ServingEngine:
             # FLOP meter every engine flavor reports on the same basis
             # (prompt tokens only, never decode; cache hits subtract)
             "prefill_tokens": self._run_prefill_tokens,
+            # deadline-shed terminal count (graceful degradation)
+            "shed_requests": shed_count,
         }
         if self._paged_prefill:
             metrics["prefill_chunks"] = chunks
@@ -1086,7 +1135,8 @@ def prefix_replay_benchmark(params, config, *, n_requests=12, n_prefixes=3,
         # sorted-reservoir index rule the exporters report)
         h_ttft = Histogram(f"replay.{label}.ttft_seconds")  # standalone
         for o in outs:
-            h_ttft.observe(o.ttft_s)
+            if o.ttft_s is not None:  # shed rows carry no TTFT
+                h_ttft.observe(o.ttft_s)
         row = {
             "decode_tokens_per_s": metrics["decode_tokens_per_s"],
             "ttft_p50_s": round(h_ttft.quantile(0.5), 6),

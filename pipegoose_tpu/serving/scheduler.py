@@ -67,6 +67,11 @@ class Request:
     prompt: np.ndarray                 # (S,) token ids
     max_new_tokens: int
     eos_token_id: Optional[int] = None
+    # graceful degradation: seconds from submit after which the request
+    # is SHED at admission time instead of admitted (the answer would
+    # arrive too late to matter, so spending prefill+decode on it only
+    # makes every other request later). None = never shed.
+    deadline_s: Optional[float] = None
 
     uid: Optional[int] = None
     status: Status = Status.QUEUED
@@ -145,6 +150,9 @@ class Scheduler:
         self.tracer = tracer
         self.slots: List[Optional[Request]] = [None] * num_slots
         self.queue: deque = deque()
+        # deadline-shed requests since the last drain_shed() — the
+        # engine drains these per tick to count them and emit outputs
+        self.shed: List[Request] = []
         self._outstanding_total = 0
         self._next_uid = 0
 
@@ -156,6 +164,10 @@ class Scheduler:
             raise ValueError("empty prompt")
         if req.max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
+        if req.deadline_s is not None and req.deadline_s < 0:
+            raise ValueError(
+                f"deadline_s must be >= 0, got {req.deadline_s}"
+            )
         if req.prompt_len + req.max_new_tokens > self.max_context:
             raise ValueError(
                 f"request needs {req.prompt_len + req.max_new_tokens} "
@@ -174,13 +186,51 @@ class Scheduler:
         if self.tracer is not None:
             self.tracer.on_submit(req, now)
 
+    def _shed_expired(self, now: float) -> None:
+        """Graceful degradation: drop QUEUED requests already past
+        their deadline — serving them would spend decode slots on
+        answers nobody is waiting for while fresh requests queue behind
+        them. Shedding is load-dependent but deterministic given the
+        same arrival times and schedule; shed requests land in
+        ``self.shed`` (terminal, finish_reason="shed") for the engine
+        to drain. Only the never-admitted QUEUE sheds: an admitted
+        request has paid its prefill and always runs to completion —
+        including one preempted back into the queue (``t_admit`` set),
+        which already holds generated tokens."""
+        if not any(r.deadline_s is not None for r in self.queue):
+            return
+        kept: deque = deque()
+        for req in self.queue:
+            if (req.deadline_s is not None
+                    and req.t_admit is None
+                    and req.t_submit is not None
+                    and now - req.t_submit > req.deadline_s):
+                req.status = Status.DONE
+                req.finish_reason = "shed"
+                req.t_done = now
+                self.shed.append(req)
+                if self.tracer is not None:
+                    self.tracer.on_shed(req, now)
+            else:
+                kept.append(req)
+        self.queue = kept
+
+    def drain_shed(self) -> List[Request]:
+        """Shed requests since the last drain (engine tick bookkeeping:
+        counter + terminal outputs)."""
+        out, self.shed = self.shed, []
+        return out
+
     def admit(self, now: float) -> List[Request]:
         """Move queued requests into free slots while the pool (plus
         evictable cache pages) can cover their worst case beyond all
         outstanding reservations. A prefix-cache hit shares the matched
-        pages and shrinks both the worst case and the prefill. Returns
-        the newly admitted requests (they still need a prefill for
-        their unique tail, possibly empty chunks at a time)."""
+        pages and shrinks both the worst case and the prefill. Queued
+        requests past their ``deadline_s`` are SHED first (admission is
+        the deadline checkpoint). Returns the newly admitted requests
+        (they still need a prefill for their unique tail, possibly
+        empty chunks at a time)."""
+        self._shed_expired(now)
         admitted: List[Request] = []
         if not self.continuous and any(s is not None for s in self.slots):
             return admitted  # naive padded batching: drain before refill

@@ -222,6 +222,9 @@ class NullRequestTracer:
     def on_done(self, req: Any, t: float) -> None:
         pass
 
+    def on_shed(self, req: Any, t: float) -> None:
+        pass
+
 
 #: Shared no-op instance — handy where an always-callable tracer is
 #: wanted instead of a ``None`` guard (the engine itself guards).
@@ -383,6 +386,30 @@ class RequestTracer(NullRequestTracer):
         self._h_stall.observe(c["stall_s"])
         self._h_saved.observe(tl.cache_saved_est_s)
         self._c_saved.inc(tl.cache_saved_est_s)
+        self._c_requests.inc()
+
+    def on_shed(self, req: Any, t: float) -> None:
+        """Deadline shed: the OTHER terminal transition (scheduler
+        dropped a queued request past its ``deadline_s``). The timeline
+        completes with ``finish_reason="shed"`` and its (entirely
+        queue-side) wall time books normally — so shed requests are
+        visible in ``/debug/requests``, black boxes, and the
+        attribution rows, distinguishable by finish reason rather than
+        silently absent. No latency histograms are observed: a shed
+        request has no serving latency, and polluting the TTFT/e2e
+        distributions with it would mask exactly the degradation
+        shedding is supposed to make visible."""
+        with self._lock:
+            tl = self.in_flight.pop(req.uid, None)
+            if tl is None:
+                return
+            tl.transition(None, t)
+            tl.t_done = t
+            tl.finish_reason = "shed"
+            if tl.t_submit is not None:
+                tl.e2e_s = t - tl.t_submit
+            tl.add_event("shed", t)
+            self.completed.append(tl)
         self._c_requests.inc()
 
     # -- work hooks (ServingEngine) ----------------------------------------
@@ -581,6 +608,13 @@ def request_trace_events(tracer: RequestTracer, *, pid: Optional[int] = None
                     slice_(f"req{uid} {phase}", f"request.{phase}",
                            t_open, t, tid, uid=uid,
                            finish_reason=ev.get("finish_reason"))
+                phase, t_open = None, t
+            elif kind == "shed":
+                if phase in ("queue", "stall"):
+                    slice_(f"req{uid} {phase}", f"request.{phase}",
+                           t_open, t, queue_tid, uid=uid,
+                           finish_reason="shed")
+                marker(f"req{uid} shed", t, queue_tid, uid=uid)
                 phase, t_open = None, t
             elif kind == "prefill_chunk":
                 dur = float(ev.get("dur_s", 0.0))

@@ -297,13 +297,22 @@ def default_serving_slos(
     ttft_objective_s: float = 0.5,
     decode_gap_objective_s: float = 0.25,
     decode_gap_p: float = 0.99,
+    shed_target: float = 0.95,
 ) -> List[SLOTarget]:
-    """A reasonable starting set over the engine's existing histograms:
-    TTFT and the inter-decode-step gap (the stall smell the watchdog
-    catches only at full livelock)."""
+    """A reasonable starting set over the engine's existing metrics:
+    TTFT, the inter-decode-step gap (the stall smell the watchdog
+    catches only at full livelock), and the deadline-shed fraction.
+    Shedding is the DESIGNED degraded mode — ``/healthz`` stays 200
+    while it happens — so the shed target is what turns "degraded" into
+    "page someone": with ``shed_target=0.95``, sustained shedding of
+    more than 5% of submitted requests burns the budget and breaches."""
     return [
         SLOTarget(name="ttft", metric="serving.ttft_seconds",
                   objective=ttft_objective_s, target=ttft_p),
         SLOTarget(name="decode_gap", metric="serving.decode_gap_seconds",
                   objective=decode_gap_objective_s, target=decode_gap_p),
+        SLOTarget(name="shed_fraction", kind="ratio",
+                  bad_metric="serving.shed_total",
+                  total_metric="serving.requests_total",
+                  target=shed_target),
     ]

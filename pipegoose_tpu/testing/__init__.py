@@ -12,12 +12,26 @@ from __future__ import annotations
 
 from typing import Any
 
+from pipegoose_tpu.testing.chaos import (  # noqa: F401
+    ChaosMonkey,
+    ChaosSchedule,
+    Injection,
+    TransientIOFault,
+    schedule_fingerprint,
+    tear_checkpoint,
+)
 from pipegoose_tpu.testing.fake_cluster import (  # noqa: F401
     fake_cluster,
     set_fake_device_flags,
 )
 
 __all__ = [
+    "ChaosMonkey",
+    "ChaosSchedule",
+    "Injection",
+    "TransientIOFault",
+    "schedule_fingerprint",
+    "tear_checkpoint",
     "fake_cluster",
     "set_fake_device_flags",
     "force_cpu_devices",

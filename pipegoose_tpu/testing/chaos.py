@@ -1,0 +1,494 @@
+"""Deterministic chaos harness: inject the failures that end real runs.
+
+Every large training/serving deployment eventually meets the same five
+killers: a slice loses devices (preemption, hardware fault), gradients
+go non-finite, a host stalls, a checkpoint write is torn by a kill, and
+storage throws transient I/O errors. Production code paths for
+surviving them exist in this repo (``AutoRecovery``/``ElasticRecovery``,
+the flight recorder, crash-atomic checkpoints, the serving stall
+watchdog) — but a recovery path that is never EXERCISED is a recovery
+path that is broken. This module is the exerciser:
+
+- :class:`ChaosSchedule` — a SEEDED, byte-reproducible injection plan:
+  the same seed always yields the identical list of
+  :class:`Injection` (step, kind, args), pinned by
+  ``to_json()`` equality in tests. Determinism is the whole point —
+  a chaos failure that cannot be replayed cannot be debugged.
+- :class:`ChaosMonkey` — the executor. As a trainer ``Callback`` it
+  applies training injections at their scheduled step; as a serving
+  ``tick_hook`` (:meth:`ChaosMonkey.tick_hook`) it applies serving
+  injections at their scheduled engine tick. Every application is
+  logged to the attached ``FlightRecorder`` ring (kind
+  ``chaos.injection``), so a post-mortem black box records what was
+  INJECTED next to what was DETECTED.
+
+Injection kinds (``KINDS``):
+
+``device_loss``      simulate losing ``n_lose`` devices of the current
+                     mesh (the fake-cluster analog of a slice
+                     preemption): fires a structured ``device_loss``
+                     flight-recorder trigger whose details name the
+                     lost and surviving device ids —
+                     ``ElasticRecovery`` (trainer/elastic.py) consumes
+                     it and reshards onto the survivors. Requires a
+                     recorder (the trigger IS the signal path).
+``nonfinite_grads``  overwrite one leaf of a named module group's
+                     params with ``inf`` before the step runs — the
+                     loss and gradients that step go non-finite, the
+                     health reduction/loss canary trips, and recovery
+                     rolls back (the checkpointed state is clean; the
+                     corruption never survives the restore).
+``host_stall``       ``time.sleep(stall_s)`` — a GC pause, a noisy
+                     neighbor, an NFS hiccup. Shows up in the fenced
+                     step time (flight recorder) and the serving
+                     ``decode_gap_seconds`` histogram the SLO monitor
+                     watches.
+``torn_checkpoint``  tear the NEWEST complete checkpoint under
+                     ``checkpoint_dir`` the way a kill mid-save would
+                     have before the atomic-rename contract: its
+                     contents are replaced by a partial stub, so
+                     ``latest_step`` still lists it but restore fails
+                     — exercising ``AutoRecovery``'s older-checkpoint
+                     fallback.
+``ckpt_io_error``    arm ``utils/checkpoint.py``'s save-attempt fault
+                     hook with ``fail_times`` transient ``OSError``s —
+                     the bounded-retry+backoff path must absorb them.
+
+Host-side by design (and jit-safety-allowlisted): injections run in
+callback/tick context, never inside compiled code.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+KINDS: Tuple[str, ...] = (
+    "device_loss",
+    "nonfinite_grads",
+    "host_stall",
+    "torn_checkpoint",
+    "ckpt_io_error",
+)
+
+#: kinds applied by the serving tick hook (matched on engine tick
+#: number); the rest are trainer-callback injections (matched on step)
+SERVING_KINDS: Tuple[str, ...] = ("host_stall",)
+
+
+@dataclasses.dataclass(frozen=True)
+class Injection:
+    """One scheduled fault: fires when the run reaches ``step`` (train
+    step for callback injections, engine tick for serving ones)."""
+
+    step: int
+    kind: str
+    args: Tuple[Tuple[str, Any], ...] = ()
+
+    def __post_init__(self):
+        if self.kind not in KINDS:
+            raise ValueError(f"unknown chaos kind {self.kind!r} "
+                             f"(expected one of {KINDS})")
+        if self.step < 1:
+            raise ValueError(f"injection step must be >= 1, got {self.step}")
+
+    @property
+    def kwargs(self) -> Dict[str, Any]:
+        return dict(self.args)
+
+    def to_json(self) -> dict:
+        return {"step": self.step, "kind": self.kind, "args": self.kwargs}
+
+
+def _args(**kw: Any) -> Tuple[Tuple[str, Any], ...]:
+    return tuple(sorted(kw.items()))
+
+
+class ChaosSchedule:
+    """An ordered, deterministic injection plan.
+
+    Build explicitly (``ChaosSchedule([Injection(...), ...])``) for
+    surgical tests, or via :meth:`seeded` for randomized-but-replayable
+    chaos: the same ``(seed, max_step, counts, params)`` always
+    produces the byte-identical plan (``to_json`` equality is the
+    test-pinned contract — NOT "similar", identical). Injections are
+    sorted by (step, kind) so even hand-built schedules iterate
+    deterministically.
+    """
+
+    def __init__(self, injections: Sequence[Injection], seed: Optional[int] = None,
+                 max_step: Optional[int] = None):
+        self.injections: List[Injection] = sorted(
+            injections, key=lambda i: (i.step, i.kind)
+        )
+        self.seed = seed
+        self.max_step = max_step
+        self._by_step: Dict[int, List[Injection]] = {}
+        for inj in self.injections:
+            self._by_step.setdefault(inj.step, []).append(inj)
+
+    @classmethod
+    def seeded(
+        cls,
+        seed: int,
+        max_step: int,
+        *,
+        device_loss: int = 0,
+        nonfinite_grads: int = 0,
+        host_stall: int = 0,
+        torn_checkpoint: int = 0,
+        ckpt_io_error: int = 0,
+        n_lose: int = 1,
+        module_groups: Sequence[str] = ("embed",),
+        stall_s: float = 0.05,
+        fail_times: int = 1,
+        min_step: int = 1,
+    ) -> "ChaosSchedule":
+        """Draw ``<kind>=count`` injections at distinct steps in
+        ``[min_step, max_step]`` from a seeded RNG. Draw ORDER is fixed
+        (the ``KINDS`` tuple order), so adding a kind to a schedule
+        never perturbs the steps of kinds drawn before it."""
+        if max_step < min_step:
+            raise ValueError(f"max_step {max_step} < min_step {min_step}")
+        rng = np.random.RandomState(seed)
+        counts = {
+            "device_loss": device_loss,
+            "nonfinite_grads": nonfinite_grads,
+            "host_stall": host_stall,
+            "torn_checkpoint": torn_checkpoint,
+            "ckpt_io_error": ckpt_io_error,
+        }
+        span = max_step - min_step + 1
+        total = sum(counts.values())
+        if total > span:
+            raise ValueError(
+                f"{total} injections do not fit in steps "
+                f"[{min_step}, {max_step}] (one per step)"
+            )
+        # distinct steps across ALL kinds: two injections on one step
+        # would make the application order (and thus the failure mode)
+        # depend on dict iteration instead of the schedule
+        steps = min_step + rng.choice(span, size=total, replace=False)
+        injections: List[Injection] = []
+        i = 0
+        for kind in KINDS:
+            for _ in range(counts[kind]):
+                step = int(steps[i])
+                i += 1
+                if kind == "device_loss":
+                    args = _args(n_lose=int(n_lose))
+                elif kind == "nonfinite_grads":
+                    group = module_groups[int(rng.randint(len(module_groups)))]
+                    args = _args(module_group=str(group))
+                elif kind == "host_stall":
+                    args = _args(stall_s=float(stall_s))
+                elif kind == "torn_checkpoint":
+                    args = _args()
+                else:  # ckpt_io_error
+                    args = _args(fail_times=int(fail_times))
+                injections.append(Injection(step, kind, args))
+        return cls(injections, seed=seed, max_step=max_step)
+
+    def at(self, step: int) -> List[Injection]:
+        return self._by_step.get(step, [])
+
+    def to_json(self) -> dict:
+        return {
+            "seed": self.seed,
+            "max_step": self.max_step,
+            "injections": [i.to_json() for i in self.injections],
+        }
+
+    def __eq__(self, other: Any) -> bool:
+        return isinstance(other, ChaosSchedule) and (
+            self.to_json() == other.to_json()
+        )
+
+    def __len__(self) -> int:
+        return len(self.injections)
+
+    def __repr__(self) -> str:
+        return (f"ChaosSchedule(seed={self.seed}, "
+                f"{len(self.injections)} injection(s))")
+
+
+class TransientIOFault:
+    """Save-attempt fault: raises ``OSError`` for the first ``times``
+    calls, then passes — what ``ckpt_io_error`` arms on
+    ``utils/checkpoint.py``'s :func:`~pipegoose_tpu.utils.checkpoint.
+    set_io_fault_hook` seam."""
+
+    def __init__(self, times: int):
+        self.remaining = int(times)
+        self.fired = 0
+
+    def __call__(self) -> None:
+        if self.remaining > 0:
+            self.remaining -= 1
+            self.fired += 1
+            raise OSError(
+                f"chaos: injected transient checkpoint I/O error "
+                f"({self.fired} so far)"
+            )
+
+
+def tear_checkpoint(directory: str) -> Optional[str]:
+    """Replace the newest COMPLETE checkpoint's contents with a partial
+    stub — the on-disk state a kill mid-save used to leave before the
+    atomic-rename contract. ``latest_step`` (which trusts the rename
+    commit point) still lists it, restore fails, and recovery must fall
+    back to the next-older checkpoint. Returns the torn path (None when
+    there is nothing to tear)."""
+    import shutil
+
+    from pipegoose_tpu.utils.checkpoint import available_steps
+
+    steps = available_steps(directory)
+    if not steps:
+        return None
+    path = os.path.join(os.path.abspath(directory), f"step_{steps[0]}")
+    shutil.rmtree(path)
+    os.makedirs(path)
+    with open(os.path.join(path, "TORN"), "w") as f:
+        f.write("chaos: simulated torn checkpoint write\n")
+    return path
+
+
+class ChaosMonkey:
+    """Apply a :class:`ChaosSchedule` to a live run.
+
+    Duck-typed trainer callback (the full ``trainer.Callback`` hook
+    surface, without inheriting it): this module must stay importable
+    through ``pipegoose_tpu.testing`` BEFORE the jax backend
+    initializes — the conftest imports the fake-cluster flags through
+    the same package — and the trainer package pulls in jax at import.
+
+    Trainer wiring: add to ``callbacks`` next to the ``FlightRecorder``
+    and the recovery callback — order -30 runs it before the recorder
+    (-20) records the step and before the detector (-10) reacts, so an
+    injection and its detection land in the same step's callback round.
+    Training injections match ``Injection.step`` against the step
+    number ``on_step_start`` receives (the step about to run).
+
+    Serving wiring: pass ``monkey.tick_hook`` as
+    ``ServingEngine.run(tick_hook=...)`` — serving-capable kinds
+    (``SERVING_KINDS``) match against the engine tick number instead.
+
+    ``recorder``: the ``FlightRecorder`` every application is logged to
+    (ring kind ``chaos.injection``) and through which ``device_loss``
+    fires its structured trigger. ``checkpoint_dir``: where
+    ``torn_checkpoint`` looks for its victim (defaults to nothing —
+    the injection is skipped with a logged record naming why).
+    """
+
+    order = -30  # before FlightRecorder (-20) and FailureDetector (-10)
+
+    def __init__(
+        self,
+        schedule: ChaosSchedule,
+        recorder: Optional[Any] = None,
+        checkpoint_dir: Optional[str] = None,
+    ):
+        self.schedule = schedule
+        self.recorder = recorder
+        self.checkpoint_dir = checkpoint_dir
+        self.applied: List[Injection] = []
+        self.io_faults: List[TransientIOFault] = []
+        # hook installed before our first arm — disarm restores it, so
+        # the monkey never clobbers an externally installed fault seam
+        self._prev_hook: Optional[Any] = None
+        self._armed = False
+        # fire-once bookkeeping: recovery REWINDS the step counter, so
+        # the steps after a rollback replay through the schedule again —
+        # re-injecting would make every recovery replay its own cause
+        # (and a device_loss would compound: 8→4→0). An injection is an
+        # EVENT, not a property of a step number.
+        self._done: set = set()
+
+    # -- logging -----------------------------------------------------------
+
+    def _log(self, inj: Injection, **extra: Any) -> None:
+        self.applied.append(inj)
+        if self.recorder is not None:
+            # the injection's kind rides as `injection` — `kind` is the
+            # ring record's own discriminator ("chaos.injection")
+            self.recorder.record(
+                "chaos.injection", step=inj.step, injection=inj.kind,
+                **inj.kwargs, **extra,
+            )
+
+    # -- trainer-side applications -----------------------------------------
+
+    def _apply_nonfinite(self, trainer: Any, inj: Injection) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        group = inj.kwargs["module_group"]
+        params = trainer.params
+        if group not in params:
+            raise KeyError(
+                f"chaos nonfinite_grads: no module group {group!r} in "
+                f"params (have {sorted(params)})"
+            )
+        sub = params[group]
+        leaves, treedef = jax.tree_util.tree_flatten(sub)
+        # one leaf is enough: the inf propagates to the loss and the
+        # whole grad tree within the step
+        leaves[0] = jnp.full_like(leaves[0], jnp.inf)
+        new_params = dict(params)
+        new_params[group] = jax.tree_util.tree_unflatten(treedef, leaves)
+        trainer.params = new_params
+        self._log(inj)
+
+    def _apply_device_loss(self, trainer: Any, inj: Injection) -> None:
+        if self.recorder is None:
+            raise RuntimeError(
+                "chaos device_loss needs a FlightRecorder: the "
+                "structured trigger it fires is how ElasticRecovery "
+                "learns WHICH devices died"
+            )
+        n_lose = int(inj.kwargs.get("n_lose", 1))
+        devices = list(trainer.parallel_context.mesh.devices.reshape(-1))
+        if n_lose >= len(devices):
+            raise ValueError(
+                f"chaos device_loss: n_lose={n_lose} would leave no "
+                f"survivors out of {len(devices)} devices"
+            )
+        # deterministic victim choice: the TRAILING devices — on the
+        # (pipe, data, ..., tensor) mesh order that is a whole trailing
+        # slab of the data axis, i.e. "a slice went away"
+        lost, surviving = devices[-n_lose:], devices[:-n_lose]
+        details = {
+            "lost_device_ids": [int(d.id) for d in lost],
+            "surviving_device_ids": [int(d.id) for d in surviving],
+            "n_lost": n_lose,
+            "n_surviving": len(surviving),
+        }
+        self._log(inj, **details)
+        self.recorder.fire_trigger(
+            "device_loss",
+            f"lost {n_lose} of {len(devices)} devices "
+            f"(ids {details['lost_device_ids']}); "
+            f"{len(surviving)} surviving",
+            inj.step,
+            details=details,
+        )
+
+    def _apply_torn_checkpoint(self, inj: Injection) -> None:
+        if self.checkpoint_dir is None:
+            self._log(inj, skipped="no checkpoint_dir configured")
+            return
+        torn = tear_checkpoint(self.checkpoint_dir)
+        if torn is None:
+            self._log(inj, skipped="no complete checkpoint to tear")
+            return
+        self._log(inj, torn_path=torn)
+
+    def _apply_ckpt_io_error(self, inj: Injection) -> None:
+        from pipegoose_tpu.utils.checkpoint import set_io_fault_hook
+
+        fault = TransientIOFault(int(inj.kwargs.get("fail_times", 1)))
+        self.io_faults.append(fault)
+        prev = set_io_fault_hook(fault)
+        if not self._armed:  # remember only the EXTERNAL hook
+            self._prev_hook = prev
+            self._armed = True
+        self._log(inj)
+
+    def _apply_host_stall(self, inj: Injection) -> None:
+        time.sleep(float(inj.kwargs.get("stall_s", 0.05)))
+        self._log(inj)
+
+    # -- trainer callback interface (duck-typed, see class docstring) ------
+
+    def on_fit_start(self, trainer: Any) -> None:
+        pass
+
+    def on_checkpoint(self, trainer: Any, step: int, path: str) -> None:
+        pass
+
+    def _take(self, step: int, kinds: Tuple[str, ...]) -> List[Injection]:
+        """Injections of ``kinds`` due at ``step`` that have not fired
+        yet, marked fired (fire-once: steps replayed after a recovery
+        rewind must not re-inject). ``kinds`` scopes the claim to what
+        the calling hook actually applies — claiming a kind another
+        hook owns would silently swallow it."""
+        due = [i for i in self.schedule.at(step)
+               if i.kind in kinds and id(i) not in self._done]
+        self._done.update(id(i) for i in due)
+        return due
+
+    def on_step_start(self, trainer: Any, step: int) -> None:
+        # step numbering: on_step_start receives trainer.state.step (the
+        # 0-based count of COMPLETED steps); Injection.step is 1-based
+        # "the N-th step about to run", matching the step number
+        # on_step_end and the flight recorder see for the same step
+        for inj in self._take(step + 1, ("nonfinite_grads", "host_stall",
+                                         "torn_checkpoint", "ckpt_io_error")):
+            if inj.kind == "nonfinite_grads":
+                self._apply_nonfinite(trainer, inj)
+            elif inj.kind == "host_stall":
+                self._apply_host_stall(inj)
+            elif inj.kind == "torn_checkpoint":
+                self._apply_torn_checkpoint(inj)
+            else:  # ckpt_io_error
+                self._apply_ckpt_io_error(inj)
+            # device_loss fires at step END (below): the step in flight
+            # when the slice dies still runs — and is then rolled back,
+            # exactly like the real event
+
+    def on_step_end(self, trainer: Any, step: int, loss: Any) -> None:
+        for inj in self._take(step, ("device_loss",)):
+            self._apply_device_loss(trainer, inj)
+
+    def on_fit_end(self, trainer: Any) -> None:
+        self.disarm()
+
+    def on_fit_abort(self, trainer: Any, exc: BaseException) -> None:
+        # fit raising (budget exhaustion, a non-recoverable injection)
+        # must not leak an armed fault into the NEXT run in the process
+        self.disarm()
+
+    def disarm(self) -> None:
+        """Restore the pre-arm checkpoint I/O fault hook (idempotent) —
+        a schedule's faults cannot outlive the run that armed them, and
+        an externally installed hook is put back, not clobbered."""
+        from pipegoose_tpu.utils.checkpoint import set_io_fault_hook
+
+        if self._armed:
+            set_io_fault_hook(self._prev_hook)
+            self._prev_hook = None
+            self._armed = False
+
+    # -- serving tick hook -------------------------------------------------
+
+    def tick_hook(self, engine: Any, tick: int) -> None:
+        """``ServingEngine.run(tick_hook=...)`` seam: apply
+        serving-capable injections whose ``step`` matches the engine
+        tick. One method instead of a lambda so tests can pass the
+        monkey around whole."""
+        for inj in self._take(tick, SERVING_KINDS):
+            if inj.kind == "host_stall":
+                self._apply_host_stall(inj)
+
+    # -- forensics ---------------------------------------------------------
+
+    def applied_json(self) -> List[dict]:
+        """The applications so far, JSON-able — what trajectory-
+        determinism tests compare across replayed runs."""
+        return [i.to_json() for i in self.applied]
+
+    def __repr__(self) -> str:
+        return (f"ChaosMonkey({self.schedule!r}, "
+                f"{len(self.applied)} applied)")
+
+
+def schedule_fingerprint(schedule: ChaosSchedule) -> str:
+    """Canonical JSON string of a schedule — the byte-reproducibility
+    pin: ``schedule_fingerprint(a) == schedule_fingerprint(b)`` iff the
+    two schedules inject identically."""
+    return json.dumps(schedule.to_json(), sort_keys=True)

@@ -1,4 +1,10 @@
 from pipegoose_tpu.trainer.callback import Callback, CheckpointCallback, LossLoggerCallback
+from pipegoose_tpu.trainer.elastic import (
+    ElasticRecovery,
+    NoFeasibleLayout,
+    planner_layout_fn,
+    shrink_layout,
+)
 from pipegoose_tpu.trainer.logger import DistributedLogger
 from pipegoose_tpu.trainer.recovery import (
     AutoRecovery,
@@ -18,5 +24,9 @@ __all__ = [
     "TrainerStatus",
     "FailureDetector",
     "AutoRecovery",
+    "ElasticRecovery",
+    "NoFeasibleLayout",
     "TrainingDiverged",
+    "planner_layout_fn",
+    "shrink_layout",
 ]

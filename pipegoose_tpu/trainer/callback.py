@@ -33,6 +33,13 @@ class Callback:
 
     def on_fit_end(self, trainer: Any) -> None: ...
 
+    # teardown on the FAILURE path: on_fit_end only runs when fit
+    # finishes, so process-global state a callback armed (e.g. the
+    # chaos checkpoint-fault seam) needs a hook that fires when fit
+    # raises. Called best-effort; exceptions here never mask the
+    # original one.
+    def on_fit_abort(self, trainer: Any, exc: BaseException) -> None: ...
+
     def on_step_start(self, trainer: Any, step: int) -> None: ...
 
     def on_step_end(self, trainer: Any, step: int, loss: float) -> None: ...
@@ -81,7 +88,19 @@ class CheckpointCallback(Callback):
         import jax
         import jax.numpy as jnp
 
-        from pipegoose_tpu.utils.checkpoint import save_train_state
+        from pipegoose_tpu.utils.checkpoint import (
+            available_steps,
+            save_train_state,
+        )
+
+        # a COMPLETE checkpoint for this step already on disk means the
+        # state came FROM it (recovery rolled back and restored it —
+        # the only path that revisits a step number): re-saving would
+        # hit save_pretrained's exists-check and kill the run. Quick
+        # dir listing, only on steps that passed the `every` gate.
+        if step in available_steps(self.directory):
+            self._last_saved = max(self._last_saved, step)
+            return
 
         # persisting non-finite params would poison every later restore
         # (AutoRecovery would loop restoring the poisoned checkpoint

@@ -1,0 +1,287 @@
+"""Elastic recovery: survive device loss by resharding onto the
+survivors and resuming — no manual restart.
+
+``AutoRecovery`` (trainer/recovery.py) survives one failure shape:
+numerical divergence, restored onto the SAME mesh. The failure that
+actually ends long multi-slice runs is the mesh itself changing under
+the job — a preempted slice, a failed chip — and recovering from that
+needs four moves the same-mesh path never makes ("On Optimizing the
+Communication of Model Parallelism", arxiv 2211.05322, treats the
+cross-mesh reshard at the center of this as a first-class op):
+
+1. **replan**: ask the compile-time parallelism planner
+   (``pipegoose_tpu/planner/``) for the best FEASIBLE (dp, tp, pp)
+   layout at the surviving device count — the same static search that
+   ranks layouts before a run ranks them again at recovery time;
+2. **rebuild**: construct a fresh ``ParallelContext`` over exactly the
+   surviving devices and re-lower the hybrid train step on it through
+   the trainer's stored build config (``Trainer.rebuild``, the
+   ``parallel/hybrid.py`` rebuild hook);
+3. **cross-mesh restore**: ``restore_train_state`` reads the orbax
+   checkpoint — written layout-independent — sharded directly onto the
+   NEW mesh (the thing the reference's per-(tp,pp)-file checkpoints
+   could never do);
+4. **verify + resume**: optionally diff the rebuilt compiled step with
+   the mesh doctor (zero partitioner-inserted resharding on the new
+   mesh), dump an ``elastic_resume`` black box naming the lost
+   devices, the chosen layout, and the rewind step, and let ``fit``
+   continue — the SAME Python loop, now driving the new program.
+
+The device-loss signal arrives as a structured ``device_loss``
+flight-recorder trigger (fired in production by a cluster watcher; in
+tests by the chaos harness, ``testing/chaos.py``) whose details carry
+the surviving device ids. Everything else — divergence, loss spikes —
+falls through to ``AutoRecovery``'s same-mesh restore untouched.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Sequence
+
+from pipegoose_tpu.trainer.recovery import AutoRecovery, TrainingDiverged
+
+
+class NoFeasibleLayout(TrainingDiverged):
+    """No layout fits the surviving device count — elastic recovery is
+    impossible and the failure must surface to the operator."""
+
+
+def shrink_layout(trainer: Any, n_devices: int) -> Any:
+    """Planner-free fallback layout: keep the model axes (tp, pp, ep —
+    changing them needs model-divisibility knowledge this function
+    doesn't have) and shrink dp to what the survivors allow. Raises
+    :class:`NoFeasibleLayout` when the survivors can't hold even dp=1.
+
+    The planner-backed :func:`planner_layout_fn` is strictly better
+    when a builder for the model exists — this is the floor that works
+    for any model the trainer can hold."""
+    from pipegoose_tpu.planner.space import Candidate
+
+    ctx = trainer.parallel_context
+    fixed = (ctx.tensor_parallel_size * ctx.pipeline_parallel_size
+             * ctx.expert_parallel_size * ctx.sequence_parallel_size
+             * ctx.diloco_parallel_size)
+    dp = n_devices // fixed
+    if dp < 1:
+        raise NoFeasibleLayout(
+            f"{n_devices} surviving device(s) cannot hold the current "
+            f"non-data axes (tp*pp*ep*sp*diloco = {fixed}); pass a "
+            f"planner-backed layout_fn that may also change tp/pp"
+        )
+    return Candidate(
+        dp=dp, tp=ctx.tensor_parallel_size, pp=ctx.pipeline_parallel_size,
+        ep=ctx.expert_parallel_size,
+    )
+
+
+def planner_layout_fn(
+    builder: Any, **plan_kwargs: Any
+) -> Callable[[Any, int], Any]:
+    """``layout_fn`` backed by the parallelism planner: at recovery
+    time, rank every feasible layout at the surviving count through
+    ``planner.best_layout_at`` (real steps, shape-only compiles) and
+    return the winner. ``builder`` is the run's plan model (e.g.
+    ``planner.BloomPlanModel`` at the run's batch/seq)."""
+
+    def layout_fn(trainer: Any, n_devices: int) -> Any:
+        from pipegoose_tpu.planner import best_layout_at
+
+        cand = best_layout_at(builder, n_devices, **plan_kwargs)
+        if cand is None:
+            raise NoFeasibleLayout(
+                f"planner found no feasible layout at {n_devices} "
+                f"surviving device(s)"
+            )
+        return cand
+
+    return layout_fn
+
+
+class ElasticRecovery(AutoRecovery):
+    """``AutoRecovery`` that additionally survives DEVICE LOSS by
+    replanning, rebuilding, and cross-mesh-restoring (module
+    docstring). Non-device-loss failures take the inherited same-mesh
+    path, including the older-checkpoint fallback.
+
+    ``layout_fn(trainer, n_devices) -> layout`` chooses the new
+    (dp, tp, pp[, ep]) — any object with those attributes, normally a
+    ``planner.Candidate``. Default: :func:`planner_layout_fn` when
+    ``planner_builder`` is given, else :func:`shrink_layout` (keep
+    tp/pp, shrink dp). ``min_devices`` refuses recovery below a floor
+    (a 1-device "recovery" of a 256-chip run is usually worse than
+    paging someone). ``verify_doctor``: after the rebuild, diff the
+    recompiled step with the mesh doctor and raise on
+    partitioner-inserted resharding — a recovery onto a slow program
+    is a silent outage.
+
+    Each elastic recovery consumes one restore budget (shared with the
+    divergence path: a flapping cluster must exhaust loudly)."""
+
+    def __init__(
+        self,
+        directory: str,
+        max_restores: int = 3,
+        check_every: int = 1,
+        spike_factor: Optional[float] = None,
+        window: int = 50,
+        recorder: Optional[Any] = None,
+        layout_fn: Optional[Callable[[Any, int], Any]] = None,
+        planner_builder: Optional[Any] = None,
+        min_devices: int = 1,
+        verify_doctor: bool = True,
+    ):
+        super().__init__(directory, max_restores, check_every,
+                         spike_factor, window, recorder)
+        if layout_fn is not None and planner_builder is not None:
+            raise ValueError(
+                "pass layout_fn OR planner_builder, not both"
+            )
+        if planner_builder is not None:
+            layout_fn = planner_layout_fn(planner_builder)
+        self.layout_fn = layout_fn
+        self.min_devices = min_devices
+        self.verify_doctor = verify_doctor
+        # forensics: one record per elastic recovery, in order
+        self.resumes: List[dict] = []
+
+    # -- dispatch ----------------------------------------------------------
+
+    def handle_failure(self, trainer: Any, step: int, reason: str) -> None:
+        trig = self.active_trigger
+        if trig is not None and getattr(trig, "name", None) == "device_loss":
+            self._handle_device_loss(trainer, step, reason, trig)
+            return
+        super().handle_failure(trainer, step, reason)
+
+    # -- the elastic path --------------------------------------------------
+
+    def _surviving_devices(self, trig: Any) -> Sequence[Any]:
+        import jax
+
+        ids = trig.details.get("surviving_device_ids")
+        if not ids:
+            raise TrainingDiverged(
+                f"device_loss trigger at step {trig.step} names no "
+                f"surviving devices (details keys: "
+                f"{sorted(trig.details)}) — cannot reshard"
+            )
+        by_id = {int(d.id): d for d in jax.devices()}
+        missing = [i for i in ids if int(i) not in by_id]
+        if missing:
+            raise TrainingDiverged(
+                f"surviving device ids {missing} not present in the "
+                f"backend's device list — cannot reshard"
+            )
+        return [by_id[int(i)] for i in ids]
+
+    def _handle_device_loss(
+        self, trainer: Any, step: int, reason: str, trig: Any
+    ) -> None:
+        if self.restores >= self.max_restores:
+            raise TrainingDiverged(
+                f"step {step}: {reason} — {self.restores} restores already "
+                "spent; the cluster is flapping, aborting"
+            )
+        surviving = self._surviving_devices(trig)
+        n = len(surviving)
+        if n < self.min_devices:
+            raise TrainingDiverged(
+                f"step {step}: {reason} — only {n} device(s) survive, "
+                f"below the elastic floor min_devices={self.min_devices}"
+            )
+        trainer.logger.warning(
+            f"step {step}: {reason} — elastic recovery onto {n} "
+            f"surviving device(s)"
+        )
+        # 1) replan: the best feasible layout at the surviving count
+        layout_fn = self.layout_fn or shrink_layout
+        layout = layout_fn(trainer, n)
+        layout_desc = {
+            "dp": int(getattr(layout, "dp", 1)),
+            "tp": int(getattr(layout, "tp", 1)),
+            "pp": int(getattr(layout, "pp", 1)),
+            "ep": int(getattr(layout, "ep", 1)),
+        }
+        world = 1
+        for v in layout_desc.values():
+            world *= v
+        if world > n:
+            raise TrainingDiverged(
+                f"step {step}: layout_fn chose {layout_desc} needing "
+                f"{world} devices but only {n} survive"
+            )
+        trainer.logger.info(
+            f"elastic: chosen layout dp={layout_desc['dp']} "
+            f"tp={layout_desc['tp']} pp={layout_desc['pp']} "
+            f"ep={layout_desc['ep']} on {n} device(s)"
+        )
+        # 2) rebuild: fresh context over EXACTLY the survivors + the
+        # hybrid step re-lowered through the trainer's stored config
+        from pipegoose_tpu.distributed.parallel_context import ParallelContext
+        from pipegoose_tpu.parallel.hybrid import parallel_context_sizes
+
+        new_ctx = ParallelContext(
+            **parallel_context_sizes(layout), devices=list(surviving)
+        )
+        trainer.rebuild(new_ctx)
+        # 3) cross-mesh restore (orbax reshards onto the new mesh),
+        # with the inherited older-checkpoint fallback — a device loss
+        # colliding with a torn newest checkpoint is exactly when
+        # recovery must not give up
+        restored_step = self._restore_with_fallback(trainer, step, reason)
+        # 4) verify: the recompiled step must be clean on the new mesh
+        doctor_ok = None
+        if self.verify_doctor and trainer.last_batch is not None:
+            doctor_ok = self._doctor_check(trainer)
+        self._after_restore(trainer, step, restored_step)
+        record = {
+            "step": step,
+            "restored_step": restored_step,
+            "lost_device_ids": trig.details.get("lost_device_ids"),
+            "surviving_device_ids": [int(d.id) for d in surviving],
+            "layout": layout_desc,
+            "n_devices": n,
+            "doctor_zero_resharding": doctor_ok,
+        }
+        self.resumes.append(record)
+        if self.recorder is not None:
+            # the acceptance black box: names the lost devices, the
+            # chosen layout, and the rewind step in ONE artifact.
+            # recorder.dump (not fire_trigger) — a pending trigger
+            # would be consumed next round as a fresh failure
+            from pipegoose_tpu.telemetry.flightrec import TriggerEvent
+
+            ev = TriggerEvent(
+                "elastic_resume",
+                f"lost device(s) {record['lost_device_ids']}; resumed "
+                f"from step {restored_step} on {n} device(s) as "
+                f"dp={layout_desc['dp']} tp={layout_desc['tp']} "
+                f"pp={layout_desc['pp']}",
+                step,
+                dict(record),
+            )
+            ev.dump_path = self.recorder.dump(
+                ev, context={"mesh_axes": {
+                    k: int(v) for k, v in dict(new_ctx.mesh.shape).items()
+                }},
+            )
+            record["dump_path"] = ev.dump_path
+        trainer.logger.info(
+            f"elastic: resumed at step {restored_step} on {n} device(s) "
+            f"({self.restores}/{self.max_restores} restores spent)"
+        )
+
+    def _doctor_check(self, trainer: Any) -> bool:
+        """Shape-only doctor diff of the REBUILT step (batch shapes from
+        the in-flight batch); raises ``ShardingRegressionError`` on
+        partitioner-inserted resharding when ``verify_doctor``."""
+        import jax
+
+        from pipegoose_tpu.telemetry.doctor import assert_no_resharding
+
+        batch_sds = jax.tree_util.tree_map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
+            trainer.last_batch,
+        )
+        report = trainer.doctor(batch_sds)
+        assert_no_resharding(report)
+        return True

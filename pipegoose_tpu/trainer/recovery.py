@@ -78,6 +78,11 @@ class FailureDetector(Callback):
         self.window = window
         self.recorder = recorder
         self._history: deque = deque(maxlen=window)
+        # the structured trigger being handled RIGHT NOW (set for the
+        # duration of a handle_failure call driven by the recorder):
+        # subclasses that react to WHICH signal fired (ElasticRecovery's
+        # device_loss path) read it instead of parsing the reason string
+        self.active_trigger: Optional[Any] = None
 
     def _is_divergent(self, loss: float) -> Optional[str]:
         if not math.isfinite(loss):
@@ -99,9 +104,13 @@ class FailureDetector(Callback):
                 where = (
                     f" (black box: {trig.dump_path})" if trig.dump_path else ""
                 )
-                self.handle_failure(
-                    trainer, step, f"{trig.name}: {trig.reason}{where}"
-                )
+                self.active_trigger = trig
+                try:
+                    self.handle_failure(
+                        trainer, step, f"{trig.name}: {trig.reason}{where}"
+                    )
+                finally:
+                    self.active_trigger = None
                 return
         if step % self.check_every:
             return
@@ -119,7 +128,14 @@ class AutoRecovery(FailureDetector):
     """FailureDetector that restores the last checkpoint instead of
     aborting. ``directory`` must be the ``CheckpointCallback`` target (or
     any directory ``save_train_state`` wrote). If no checkpoint exists
-    yet when divergence hits, there is nothing to restore — raises."""
+    yet when divergence hits, there is nothing to restore — raises.
+
+    A newest checkpoint that FAILS to restore (corrupt or partial —
+    torn writes predating the atomic-rename contract, storage rot) is
+    skipped with a logged warning and the next-older one is tried;
+    every attempt, failed or successful, consumes one of
+    ``max_restores`` so a directory of corrupt checkpoints exhausts
+    loudly instead of looping."""
 
     def __init__(
         self,
@@ -142,14 +158,74 @@ class AutoRecovery(FailureDetector):
                 "spent; divergence is persistent (check lr/data), aborting"
             )
         trainer.logger.warning(f"step {step}: {reason} — restoring last checkpoint")
-        try:
-            restored_step = trainer.restore_from(self.directory)
-        except FileNotFoundError as e:
+        restored_step = self._restore_with_fallback(trainer, step, reason)
+        self._after_restore(trainer, step, restored_step)
+
+    def _restore_with_fallback(
+        self, trainer: Any, step: int, reason: str
+    ) -> int:
+        """Restore the newest COMPLETE checkpoint, falling back to the
+        next-older one when a restore fails (corrupt/partial newest —
+        e.g. a torn write from before the atomic-rename contract, or
+        storage bit rot). Every attempt, failed or not, consumes one
+        restore budget: a directory full of corrupt checkpoints must
+        exhaust and surface, not loop. A checkpoint that failed to
+        restore is quarantined (renamed ``step_N.corrupt``) so it stops
+        shadowing the step: training replays forward after the fallback
+        and must be able to RE-save ``step_N`` — against a lingering
+        dir, ``save_pretrained``'s exists-check would kill the run at
+        the exact step recovery meant to heal. Returns the restored
+        step."""
+        from pipegoose_tpu.utils.checkpoint import available_steps
+
+        steps = available_steps(self.directory)
+        if not steps:
             raise TrainingDiverged(
                 f"step {step}: {reason} — and no checkpoint under "
                 f"{self.directory!r} to restore from"
-            ) from e
-        self.restores += 1
+            )
+        for cand in steps:  # newest -> oldest
+            if self.restores >= self.max_restores:
+                raise TrainingDiverged(
+                    f"step {step}: {reason} — {self.restores} restores "
+                    "already spent; divergence is persistent (check "
+                    "lr/data), aborting"
+                )
+            try:
+                restored_step = trainer.restore_from(self.directory, cand)
+            except Exception as e:  # noqa: BLE001 - any restore failure
+                # falls back; only the budget bounds the walk
+                self.restores += 1
+                import os
+
+                skipped = os.path.join(self.directory, f"step_{cand}")
+                quarantine = skipped + ".corrupt"
+                n = 1
+                while os.path.exists(quarantine):
+                    quarantine = f"{skipped}.corrupt{n}"
+                    n += 1
+                try:
+                    os.replace(skipped, quarantine)
+                    where = f"quarantined to {quarantine!r}"
+                except OSError:
+                    where = "quarantine rename failed; left in place"
+                trainer.logger.warning(
+                    f"checkpoint {skipped!r} failed to restore "
+                    f"({type(e).__name__}: {e}) — {where}; falling back "
+                    f"to the next-older checkpoint "
+                    f"({self.restores}/{self.max_restores} budget spent)"
+                )
+                continue
+            self.restores += 1
+            return restored_step
+        raise TrainingDiverged(
+            f"step {step}: {reason} — every checkpoint under "
+            f"{self.directory!r} failed to restore"
+        )
+
+    def _after_restore(
+        self, trainer: Any, step: int, restored_step: int
+    ) -> None:
         self._history.clear()
         if self.recorder is not None:
             # the spike/explosion baselines span the rolled-back steps;

@@ -15,7 +15,6 @@ from jax.sharding import PartitionSpec as P
 
 from pipegoose_tpu.distributed.parallel_context import ParallelContext
 from pipegoose_tpu.optim.zero import DistributedOptimizer
-from pipegoose_tpu.parallel.hybrid import make_hybrid_train_step
 from pipegoose_tpu.telemetry.spans import span
 from pipegoose_tpu.trainer.callback import Callback
 from pipegoose_tpu.trainer.logger import DistributedLogger
@@ -55,11 +54,19 @@ class Trainer:
         # never pins a batch past its step
         self.last_batch: Any = None
 
-        init_fn, make_step = make_hybrid_train_step(
+        from pipegoose_tpu.parallel.hybrid import (
+            build_hybrid_train_step,
+            hybrid_build_config,
+        )
+
+        # the step-rebuild hook (parallel/hybrid.py): everything the
+        # compiled step was built from, minus the context — an elastic
+        # mesh change (trainer/elastic.py) re-lowers the SAME config on
+        # the surviving-device context via rebuild()
+        self._hybrid_config = hybrid_build_config(
             loss_fn,
             param_specs,
             optimizer,
-            self.parallel_context,
             batch_spec=batch_spec,
             loss_axis=loss_axis,
             grad_sync_axes=grad_sync_axes,
@@ -67,6 +74,10 @@ class Trainer:
             n_accum=n_accum,
             with_health=with_health,
         )
+        init_fn, make_step = build_hybrid_train_step(
+            self._hybrid_config, self.parallel_context
+        )
+        self._init_fn = init_fn
         self.param_specs = param_specs
         self.optimizer = optimizer
         # place params on the mesh in FRESH buffers: the jitted step
@@ -129,7 +140,11 @@ class Trainer:
             step = latest_step(directory)
         if step is None:
             raise FileNotFoundError(f"no checkpoint under {directory!r}")
-        self._restore(directory, step, self.opt_state)
+        # shapes from the CURRENT init_fn, not the live opt_state: after
+        # an elastic rebuild() the live state still has the OLD mesh's
+        # ZeRO padding (global dim0 = ceil(d/dp)*dp depends on dp), and
+        # the restore must target what the rebuilt step expects
+        self._restore(directory, step, jax.eval_shape(self._init_fn, self.params))
         return step
 
     def _restore(self, directory: str, step: int, opt_state_like) -> None:
@@ -153,6 +168,30 @@ class Trainer:
         self.params = restored["params"]
         self.opt_state = restored["opt_state"]
         self.state.step = step
+
+    def rebuild(self, parallel_context: ParallelContext) -> None:
+        """Recompile the hybrid train step on a NEW ``ParallelContext``
+        — the elastic-recovery entry point (``trainer/elastic.py``):
+        after a device loss shrinks the cluster, the same stored build
+        config (``parallel/hybrid.py`` ``hybrid_build_config``) is
+        re-lowered on the surviving-device mesh. Params and optimizer
+        state are NOT migrated here (they still live on the old mesh's
+        buffers); follow with :meth:`restore_from`, whose cross-mesh
+        orbax restore places the checkpointed state sharded onto the
+        new mesh."""
+        from pipegoose_tpu.parallel.hybrid import build_hybrid_train_step
+
+        self.parallel_context = parallel_context
+        init_fn, make_step = build_hybrid_train_step(
+            self._hybrid_config, parallel_context
+        )
+        self._init_fn = init_fn
+        # current params serve as a shape/dtype source only: make_step
+        # reads them through eval_shape (state specs) and size
+        # arithmetic (comm gauges) — planner precedent, bloom_builder
+        # passes pure SDS trees through the same path
+        self._step_fn = make_step(self.params)
+        self._eval_fn = None  # compiled for the OLD mesh; rebuild lazily
 
     def evaluate(
         self,
@@ -272,6 +311,25 @@ class Trainer:
                 return self._fit(batches, max_steps, rng)
         return self._fit(batches, max_steps, rng)
 
+    def _fire_fit_abort(self, exc: BaseException) -> None:
+        """Teardown hooks for the failure path — a callback holding
+        process-global state (the chaos checkpoint-fault seam) must get
+        a chance to release it when fit raises. Best-effort and
+        getattr-guarded: duck-typed callbacks predating the hook keep
+        working, and a teardown error never masks the original."""
+        for cb in self.callbacks:
+            hook = getattr(cb, "on_fit_abort", None)
+            if hook is None:
+                continue
+            try:
+                hook(self, exc)
+            except Exception as cleanup_err:  # noqa: BLE001
+                self.logger.warning(
+                    f"on_fit_abort of {type(cb).__name__} raised "
+                    f"{type(cleanup_err).__name__}: {cleanup_err} "
+                    "(suppressed; original error propagates)"
+                )
+
     def _fit(
         self,
         batches: Iterable[Any],
@@ -329,15 +387,17 @@ class Trainer:
                 for cb in self.callbacks:
                     cb.on_step_end(self, self.state.step, loss)
                 self.last_batch = None  # don't pin the batch past its step
-        except KeyboardInterrupt:
+        except KeyboardInterrupt as e:
             self.state.status = TrainerStatus.INTERRUPTED
             self.logger.warning("interrupted")
+            self._fire_fit_abort(e)
             raise
-        except Exception:
+        except Exception as e:
             # a divergence abort (TrainingDiverged from a callback) or any
             # other mid-fit error must not leave state.status at RUNNING —
             # callers inspect trainer.state after fit() raises
             self.state.status = TrainerStatus.FAILED
+            self._fire_fit_abort(e)
             raise
         finally:
             # the per-iteration clear misses aborted steps (an OOM raise

@@ -1,4 +1,4 @@
-"""Sharded, mesh-aware checkpointing.
+"""Sharded, mesh-aware, crash-atomic checkpointing.
 
 TPU-native analog of the reference's checkpoint utils
 (pipegoose/nn/utils.py:11-50), which write one torch state_dict file per
@@ -10,16 +10,57 @@ layout-independent format, and restore RESHARDS onto whatever mesh the
 current run uses (different tp/pp/dp than the run that saved — the thing
 the reference's per-coordinate files cannot do). Optimizer state and
 step counters ride along in the same tree.
+
+Crash-atomicity contract (the elasticity stack depends on it):
+
+- every save writes to a ``<final>.tmp`` SIBLING and ``os.rename``s to
+  the final name only after orbax finishes — a kill at any point leaves
+  either the previous state or a ``.tmp`` directory, never a torn
+  directory under a valid ``step_N`` name;
+- transient I/O errors (``OSError``) are retried with exponential
+  backoff up to ``retries`` times before surfacing — a blip on a
+  network filesystem must not lose a checkpoint cadence slot;
+- :func:`latest_step` / :func:`available_steps` list only COMPLETE
+  checkpoints: ``.tmp`` siblings and empty directories (a crashed
+  rename-less writer) are skipped, so a resume or an
+  ``AutoRecovery`` restore never points at a torn newest checkpoint.
+
+Fault injection for tests and the chaos harness
+(``pipegoose_tpu/testing/chaos.py``): :func:`set_io_fault_hook`
+installs a callable invoked at the start of every save ATTEMPT; raising
+``OSError`` from it simulates a transient storage failure and exercises
+the retry path without monkeypatching orbax.
 """
 from __future__ import annotations
 
 import os
-from typing import Any, Optional
+import shutil
+import time
+from typing import Any, Callable, List, Optional
 
 import jax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from pipegoose_tpu.distributed.parallel_context import ParallelContext
+
+#: suffix of the in-progress sibling a save writes before the atomic
+#: rename; anything carrying it is by definition incomplete
+TMP_SUFFIX = ".tmp"
+
+# test/chaos seam: called at the start of every save attempt; raising
+# OSError simulates a transient storage failure (the retry loop below
+# absorbs up to `retries` of them)
+_IO_FAULT_HOOK: Optional[Callable[[], None]] = None
+
+
+def set_io_fault_hook(
+    hook: Optional[Callable[[], None]]
+) -> Optional[Callable[[], None]]:
+    """Install (or clear, with None) the save-attempt fault hook;
+    returns the previous hook so tests can restore it."""
+    global _IO_FAULT_HOOK
+    prev, _IO_FAULT_HOOK = _IO_FAULT_HOOK, hook
+    return prev
 
 
 def _checkpointer():
@@ -28,17 +69,52 @@ def _checkpointer():
     return ocp.StandardCheckpointer()
 
 
-def save_pretrained(params: Any, path: str, step: Optional[int] = None) -> str:
+def save_pretrained(
+    params: Any,
+    path: str,
+    step: Optional[int] = None,
+    retries: int = 3,
+    backoff_s: float = 0.05,
+) -> str:
     """Write a sharded checkpoint (reference save_pretrained,
     nn/utils.py:11-28). Directory layout is orbax-standard; ``step``
-    creates a numbered subdirectory for resumable training runs."""
+    creates a numbered subdirectory for resumable training runs.
+
+    Crash-atomic: the tree lands in ``<final>.tmp`` first and is
+    renamed into place only after orbax reports the write finished, so
+    a kill mid-save never leaves a torn directory under the final
+    name. Transient ``OSError``s retry with exponential backoff
+    (``retries`` attempts beyond the first); persistent ones surface.
+    """
     path = os.path.abspath(path)
     if step is not None:
         path = os.path.join(path, f"step_{step}")
-    ckpt = _checkpointer()
-    ckpt.save(path, params)
-    ckpt.wait_until_finished()
-    return path
+    if os.path.exists(path):
+        # mirrors orbax's own exists check, but BEFORE the tmp write so
+        # a doomed save doesn't burn I/O (and the rename can't clobber)
+        raise ValueError(f"checkpoint already exists: {path}")
+    tmp = path + TMP_SUFFIX
+    last_err: Optional[BaseException] = None
+    for attempt in range(retries + 1):
+        try:
+            if _IO_FAULT_HOOK is not None:
+                _IO_FAULT_HOOK()
+            if os.path.isdir(tmp):
+                # stale sibling from a crashed/failed earlier attempt
+                shutil.rmtree(tmp)
+            ckpt = _checkpointer()
+            ckpt.save(tmp, params)
+            ckpt.wait_until_finished()
+            os.rename(tmp, path)  # the commit point: atomic on one fs
+            return path
+        except OSError as e:  # transient I/O: retry with backoff
+            last_err = e
+            if attempt >= retries:
+                raise
+            time.sleep(backoff_s * (2 ** attempt))
+    raise RuntimeError(  # pragma: no cover - loop always returns/raises
+        f"checkpoint save failed after {retries + 1} attempts: {last_err}"
+    )
 
 
 def from_pretrained(
@@ -76,26 +152,61 @@ def from_pretrained(
     return _checkpointer().restore(path, target)
 
 
-def latest_step(path: str) -> Optional[int]:
-    """Largest ``step_N`` subdirectory, for resume."""
+def _complete_step(path: str, name: str) -> Optional[int]:
+    """``step_N`` -> N for a COMPLETE checkpoint directory, else None.
+
+    Complete means: the canonical name (no ``.tmp`` sibling suffix — a
+    writer that died before its atomic rename), parseable step number,
+    a real directory, and non-empty (an empty dir is a writer that died
+    between mkdir and content)."""
+    if not name.startswith("step_") or name.endswith(TMP_SUFFIX):
+        return None
+    try:
+        n = int(name.split("_", 1)[1])
+    except ValueError:
+        return None
+    full = os.path.join(path, name)
+    if not os.path.isdir(full):
+        return None
+    try:
+        if not os.listdir(full):
+            return None
+    except OSError:
+        return None
+    return n
+
+
+def available_steps(path: str) -> List[int]:
+    """Steps of every COMPLETE ``step_N`` checkpoint under ``path``,
+    newest first — the fallback order ``AutoRecovery`` walks when the
+    newest checkpoint fails to restore."""
     path = os.path.abspath(path)
     if not os.path.isdir(path):
-        return None
+        return []
     steps = []
     for name in os.listdir(path):
-        if name.startswith("step_"):
-            try:
-                steps.append(int(name.split("_", 1)[1]))
-            except ValueError:
-                continue
-    return max(steps) if steps else None
+        n = _complete_step(path, name)
+        if n is not None:
+            steps.append(n)
+    return sorted(steps, reverse=True)
+
+
+def latest_step(path: str) -> Optional[int]:
+    """Largest COMPLETE ``step_N`` subdirectory, for resume. ``.tmp``
+    siblings and empty directories (torn writes) are skipped — a kill
+    mid-save must not leave a newest checkpoint that resume or
+    recovery would then fail (or worse, half-succeed) to restore."""
+    steps = available_steps(path)
+    return steps[0] if steps else None
 
 
 def save_train_state(
     path: str, step: int, params: Any, opt_state: Any = None, extra: Any = None
 ) -> str:
     """Checkpoint the full training state (params + optimizer shards +
-    counters) — absent from the reference entirely (SURVEY.md §5)."""
+    counters) — absent from the reference entirely (SURVEY.md §5).
+    Inherits :func:`save_pretrained`'s crash-atomic tmp+rename and
+    transient-retry behavior."""
     tree = {"params": params}
     if opt_state is not None:
         tree["opt_state"] = opt_state

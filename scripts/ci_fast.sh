@@ -89,6 +89,66 @@ with OpsServer(registry=reg, port=0) as srv:
 print("ops endpoint smoke OK")
 PY
 
+# Chaos smoke (testing/chaos.py + trainer recovery, ISSUE 9): a SEEDED
+# nonfinite-gradient bomb mid-run must be detected, black-boxed, and
+# rolled back to the last checkpoint, and the run must finish with
+# finite losses — the recovery path stays exercised on every CI run,
+# not just when the robustness suites rotate through the fast tier.
+echo "== chaos smoke (seeded nonfinite bomb -> recovery) =="
+python - <<'PY'
+import shutil
+import tempfile
+
+from pipegoose_tpu.testing import ChaosMonkey, ChaosSchedule, force_cpu_devices
+
+force_cpu_devices(1)
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from pipegoose_tpu.distributed import ParallelContext
+from pipegoose_tpu.models import bloom
+from pipegoose_tpu.optim.zero import DistributedOptimizer
+from pipegoose_tpu.telemetry import FlightRecorder
+from pipegoose_tpu.trainer import AutoRecovery, CheckpointCallback, Trainer
+
+cfg = bloom.BloomConfig(vocab_size=64, hidden_size=32, n_layer=2, n_head=2)
+params = bloom.init_params(cfg, jax.random.PRNGKey(0))
+out = tempfile.mkdtemp(prefix="chaos_smoke_")
+try:
+    schedule = ChaosSchedule.seeded(1234, max_step=4, min_step=2,
+                                    nonfinite_grads=1)
+    recorder = FlightRecorder(out + "/bb", capacity=16)
+    monkey = ChaosMonkey(schedule, recorder=recorder,
+                         checkpoint_dir=out + "/ckpt")
+    recovery = AutoRecovery(out + "/ckpt", max_restores=2,
+                            recorder=recorder)
+    ctx = ParallelContext()
+    trainer = Trainer(
+        lambda p, ids: bloom.loss_fn(p, ids, None, ids, cfg,
+                                     tp_axis="tensor"),
+        params, bloom.tp_specs(params),
+        DistributedOptimizer(optax.adam(1e-3), axis_name="data"), ctx,
+        callbacks=[monkey, CheckpointCallback(out + "/ckpt", every=1),
+                   recorder, recovery],
+    )
+    rng = np.random.RandomState(0)
+    state = trainer.fit(
+        jnp.asarray(rng.randint(1, cfg.vocab_size, (4, 8)))
+        for _ in range(6)
+    )
+    assert len(monkey.applied) == 1, monkey.applied_json()
+    assert recovery.restores == 1, recovery.restores
+    assert state.losses and all(
+        np.isfinite(float(l)) for l in state.losses
+    ), state.losses
+finally:
+    shutil.rmtree(out, ignore_errors=True)
+print("chaos smoke OK: injected nonfinite bomb recovered, losses finite")
+PY
+
 echo "== fast tier =="
 python -m pytest tests/ -q -m fast -p no:cacheprovider \
     --continue-on-collection-errors "$@"

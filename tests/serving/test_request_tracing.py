@@ -227,3 +227,67 @@ def test_tracer_off_is_token_identical(setup):
     for a, b in zip(outs_a, outs_b):
         np.testing.assert_array_equal(a.generated, b.generated)
         assert a.finish_reason == b.finish_reason
+
+
+# -- deadline shedding (graceful degradation, ISSUE 9) ---------------------
+
+
+def test_deadline_shed_counter_output_and_tracer_contract(setup):
+    """The shed contract end to end: a queued request past deadline
+    terminates with finish_reason="shed", rides ``serving.shed_total``
+    (against ``serving.requests_total`` — the SLO shed-fraction ratio),
+    completes its tracer timeline with a ``shed`` terminal event, and
+    stays OUT of the served-latency histograms."""
+    cfg, params, prompts = setup
+    reg = MetricsRegistry(enabled=True)
+    tracer = RequestTracer(registry=reg)
+    eng = ServingEngine(params, cfg, num_slots=2, num_pages=32,
+                        page_size=4, max_context=64, registry=reg,
+                        tracer=tracer)
+    served = Request(prompt=prompts[0], max_new_tokens=4)
+    stale = Request(prompt=prompts[1], max_new_tokens=4, deadline_s=0.0)
+    outs, metrics = eng.run([served, stale])
+
+    assert reg.counter("serving.requests_total").value == 2
+    assert reg.counter("serving.shed_total").value == 1
+    assert metrics["shed_requests"] == 1
+    by_reason = {o.finish_reason: o for o in outs}
+    shed_out = by_reason["shed"]
+    assert shed_out.uid == stale.uid
+    assert list(shed_out.generated) == []
+    # never served: None (matching per_request), NOT 0.0 — a zero would
+    # read as an instant first token in any unfiltered aggregation
+    assert shed_out.ttft_s is None and shed_out.decode_tokens_per_s is None
+    assert shed_out.e2e_latency_s == shed_out.queue_latency_s > 0
+    # the served request is untouched by its neighbor's shedding
+    assert len(by_reason["length"].generated) == 4
+
+    # tracer: terminal `shed` event, finish reason on the timeline,
+    # and the served-latency histograms only saw the SERVED request
+    tl = {t.uid: t for t in tracer.completed}[stale.uid]
+    assert tl.finish_reason == "shed"
+    assert [e["kind"] for e in tl.events][-1] == "shed"
+    assert reg.histogram("serving.ttft_seconds")._count == 1
+    assert reg.histogram("serving.e2e_latency_seconds")._count == 1
+
+
+def test_all_requests_shed_is_not_a_stall(setup):
+    """Shedding IS progress (the queue shrank): a run whose every
+    request sheds must terminate cleanly — no stall-watchdog trigger,
+    no livelock — and /healthz semantics follow (shedding never fires
+    a flight-recorder trigger, so health stays 200)."""
+    from pipegoose_tpu.telemetry import FlightRecorder
+
+    cfg, params, prompts = setup
+    recorder = FlightRecorder("/tmp/unused_bb_shed", capacity=8)
+    eng = ServingEngine(params, cfg, num_slots=2, num_pages=32,
+                        page_size=4, max_context=64, recorder=recorder,
+                        stall_patience=3)
+    outs, metrics = eng.run([
+        Request(prompt=p, max_new_tokens=4, deadline_s=0.0)
+        for p in prompts[:3]
+    ])
+    assert [o.finish_reason for o in outs] == ["shed"] * 3
+    assert metrics["shed_requests"] == 3
+    # the degraded-but-healthy contract: no trigger fired, no dump
+    assert recorder.last_trigger is None and recorder.dumps == []

@@ -193,3 +193,89 @@ def test_fifo_head_of_line_is_deterministic():
     admitted = sched.admit(now=0.0)
     assert [r.uid for r in admitted] == [0]
     assert [r.uid for r in sched.queue] == [1, 2]
+
+
+# -- deadline shedding (graceful degradation, ISSUE 9) ---------------------
+
+
+def test_deadline_shed_at_admission():
+    """A queued request past its ``deadline_s`` is shed at the
+    admission checkpoint — terminal finish_reason="shed", drained via
+    ``drain_shed`` — while in-deadline requests admit normally."""
+    sched = Scheduler(2, PagePool(33, 4), max_context=32)
+    stale = Request(prompt=np.arange(1, 5, dtype=np.int64),
+                    max_new_tokens=4, deadline_s=0.5)
+    fresh = _req(4, 4)
+    sched.submit(stale, now=0.0)
+    sched.submit(fresh, now=0.0)
+    admitted = sched.admit(now=1.0)   # 1.0 - 0.0 > 0.5: stale expired
+    assert [r.uid for r in admitted] == [fresh.uid]
+    shed = sched.drain_shed()
+    assert shed == [stale]
+    assert stale.status is Status.DONE
+    assert stale.finish_reason == "shed"
+    assert stale.t_done == 1.0 and stale.generated == []
+    assert sched.drain_shed() == []   # drained exactly once
+
+
+def test_admitted_requests_never_shed():
+    """Admission is the ONLY deadline checkpoint: an admitted request
+    has paid its prefill and runs to completion even past deadline."""
+    sched = Scheduler(1, PagePool(33, 4), max_context=32)
+    r = Request(prompt=np.arange(1, 5, dtype=np.int64),
+                max_new_tokens=2, deadline_s=0.5)
+    sched.submit(r, now=0.0)
+    sched.admit(now=0.1)
+    assert r.status is Status.PREFILL
+    sched.admit(now=99.0)             # way past deadline, already in
+    assert r.status is Status.PREFILL and sched.drain_shed() == []
+    sched.ensure_page(r)
+    sched.record_token(r, 7, now=100.0)
+    sched.ensure_page(r)
+    sched.record_token(r, 7, now=101.0)
+    assert r.finish_reason == "length"
+
+
+def test_preempted_request_never_shed_on_readmission():
+    """A preempted request is back in the queue but HAS been admitted
+    (t_admit set) and holds paid-for prefill + generated tokens — the
+    shed scan must skip it even past deadline, or preemption under
+    memory pressure silently discards completed work."""
+    sched = Scheduler(1, PagePool(33, 4), max_context=32)
+    r = Request(prompt=np.arange(1, 5, dtype=np.int64),
+                max_new_tokens=4, deadline_s=0.5)
+    sched.submit(r, now=0.0)
+    sched.admit(now=0.1)
+    sched.ensure_page(r)
+    sched.record_token(r, 7, now=0.2)      # paid prefill, one token out
+    sched.preempt(r)
+    assert r.status is Status.QUEUED and r.t_admit == 0.1
+    (readmitted,) = sched.admit(now=99.0)  # way past deadline
+    assert readmitted is r and sched.drain_shed() == []
+    assert r.generated == [7]
+
+
+def test_shed_fires_tracer_terminal_hook():
+    calls = []
+
+    class SpyTracer:
+        def on_submit(self, req, t):
+            calls.append(("submit", req.uid))
+
+        def on_shed(self, req, t):
+            calls.append(("shed", req.uid, t))
+
+    sched = Scheduler(1, PagePool(33, 4), max_context=32,
+                      tracer=SpyTracer())
+    r = Request(prompt=np.arange(1, 5, dtype=np.int64),
+                max_new_tokens=4, deadline_s=0.0)
+    sched.submit(r, now=0.0)
+    sched.admit(now=1.0)
+    assert calls == [("submit", 0), ("shed", 0, 1.0)]
+
+
+def test_negative_deadline_rejected():
+    sched = Scheduler(1, PagePool(33, 4), max_context=32)
+    with pytest.raises(ValueError, match="deadline_s"):
+        sched.submit(Request(prompt=np.arange(1, 5, dtype=np.int64),
+                             max_new_tokens=4, deadline_s=-1.0), now=0.0)

@@ -190,6 +190,12 @@ def test_status_is_evaluate(reg):
 
 def test_default_serving_slos_cover_ttft_and_decode_gap():
     targets = default_serving_slos()
-    assert [t.name for t in targets] == ["ttft", "decode_gap"]
+    assert [t.name for t in targets] == ["ttft", "decode_gap",
+                                         "shed_fraction"]
     assert targets[0].metric == "serving.ttft_seconds"
     assert targets[1].metric == "serving.decode_gap_seconds"
+    # graceful degradation: shed / submitted as a ratio-kind target —
+    # /healthz stays 200 under shedding until the budget burns
+    assert targets[2].kind == "ratio"
+    assert targets[2].bad_metric == "serving.shed_total"
+    assert targets[2].total_metric == "serving.requests_total"

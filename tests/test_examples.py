@@ -39,6 +39,9 @@ CASES = [
     ("comm_overlap_demo.py", ["--fake-devices", "8", "--tp", "2",
                               "--dp", "4"]),
     ("plan_parallelism_demo.py", ["--fake-devices", "8", "--top-k", "5"]),
+    ("elastic_training_demo.py", ["--fake-devices", "8", "--tp", "2",
+                                  "--dp", "4", "--out-dir",
+                                  "/tmp/pipegoose_elastic_demo_test"]),
 ]
 
 

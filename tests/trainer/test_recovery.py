@@ -230,6 +230,116 @@ def test_flight_recorder_dump_names_module_and_recovery_continues(
     assert "restore" in kinds
 
 
+def test_torn_newest_checkpoint_falls_back_to_older(parts, tmp_path):
+    """ISSUE 9 acceptance: a kill-mid-save-style torn NEWEST checkpoint
+    (listed by ``latest_step`` but failing to restore) must not end the
+    run — recovery skips it, logs the path, and restores the next-older
+    one; the failed attempt consumes one restore budget."""
+    from pipegoose_tpu.testing import tear_checkpoint
+
+    cfg, params, ctx = parts
+    run_dir = str(tmp_path / "run")
+    rec = AutoRecovery(run_dir, max_restores=3)
+    trainer = _trainer(
+        cfg, params, ctx, [CheckpointCallback(run_dir, every=1), rec]
+    )
+    # steps 1-2, checkpoints at both; tear the newest the way a torn
+    # write would have left it (still listed, unrestorable)
+    trainer.fit([_batch(cfg, 1), _batch(cfg, 2)])
+    torn = tear_checkpoint(run_dir)
+    assert torn.endswith("step_2")
+    state = trainer.fit([_batch(cfg, 3, poison=True), _batch(cfg, 4)])
+    # one budget burned on the torn step_2, one on the good step_1
+    assert rec.restores == 2
+    # rolled back to step 1, then the last batch advanced to step 2
+    assert state.step == 2
+    assert np.isfinite(float(state.last_loss))
+    # the unrestorable step_2 was quarantined out of the step namespace
+    # (forensics kept), so nothing shadows a replayed step-2 save
+    from pipegoose_tpu.utils.checkpoint import available_steps
+
+    assert (tmp_path / "run" / "step_2.corrupt").is_dir()
+    assert not (tmp_path / "run" / "step_2").exists()
+    assert 2 not in available_steps(run_dir)
+
+
+def test_checkpoint_callback_skips_step_already_on_disk(tmp_path):
+    """Cheap pin for the rollback-resave contract (the fresh-callback
+    e2e below is slow-tier): a step already COMPLETE on disk is never
+    re-saved — the only path revisiting a step number is a rollback
+    that restored FROM that checkpoint, and a re-save would hit
+    save_pretrained's exists-check."""
+    import logging
+    from types import SimpleNamespace
+
+    from pipegoose_tpu.trainer import CheckpointCallback
+    from pipegoose_tpu.utils.checkpoint import available_steps
+
+    import jax.numpy as jnp
+
+    trainer = SimpleNamespace(
+        state=SimpleNamespace(step=1, last_loss=None),
+        params={"w": jnp.ones((4,))}, opt_state={"m": jnp.zeros((4,))},
+        logger=logging.getLogger("test-ckpt-skip"), callbacks=[],
+    )
+    cb = CheckpointCallback(str(tmp_path), every=1)
+    cb.on_step_end(trainer, 1, 0.0)
+    assert available_steps(str(tmp_path)) == [1]
+    fresh = CheckpointCallback(str(tmp_path), every=1)  # restart shape
+    fresh.on_step_end(trainer, 1, 0.0)   # must skip, not ValueError
+    assert fresh._last_saved == 1
+    assert available_steps(str(tmp_path)) == [1]
+
+
+def test_quarantined_step_can_be_resaved_by_fresh_callback(parts, tmp_path):
+    """Process-restart shape of the torn-newest story: the replacement
+    CheckpointCallback has no ``_last_saved`` memory, so after the
+    fallback restore the replayed run RE-saves the torn step — which
+    must land cleanly where the quarantine freed the name (a lingering
+    ``step_2`` would hit save_pretrained's exists-check and kill the
+    run at the exact step recovery healed)."""
+    from pipegoose_tpu.testing import tear_checkpoint
+    from pipegoose_tpu.utils.checkpoint import available_steps
+
+    cfg, params, ctx = parts
+    run_dir = str(tmp_path / "run")
+    trainer = _trainer(
+        cfg, params, ctx,
+        [CheckpointCallback(run_dir, every=1), AutoRecovery(run_dir)],
+    )
+    trainer.fit([_batch(cfg, 1), _batch(cfg, 2)])
+    tear_checkpoint(run_dir)
+    # "restarted" process: fresh callbacks, same directory
+    rec = AutoRecovery(run_dir, max_restores=3)
+    trainer2 = _trainer(
+        cfg, trainer.params, ctx,
+        [CheckpointCallback(run_dir, every=1), rec],
+    )
+    state = trainer2.fit([_batch(cfg, 3, poison=True), _batch(cfg, 4)])
+    assert rec.restores == 2      # torn step_2 skipped, step_1 restored
+    assert state.step == 2
+    assert available_steps(run_dir) == [2, 1]   # step_2 RE-saved cleanly
+
+
+def test_torn_newest_with_exhausted_budget_surfaces(parts, tmp_path):
+    """The fallback walk is budget-bounded: with max_restores=1 the
+    failed attempt on the torn newest consumes the whole budget and the
+    run aborts loudly instead of silently restoring ever-older state."""
+    from pipegoose_tpu.testing import tear_checkpoint
+
+    cfg, params, ctx = parts
+    run_dir = str(tmp_path / "run")
+    rec = AutoRecovery(run_dir, max_restores=1)
+    trainer = _trainer(
+        cfg, params, ctx, [CheckpointCallback(run_dir, every=1), rec]
+    )
+    trainer.fit([_batch(cfg, 1), _batch(cfg, 2)])
+    tear_checkpoint(run_dir)
+    with pytest.raises(TrainingDiverged, match="restores"):
+        trainer.fit([_batch(cfg, 3, poison=True)])
+    assert rec.restores == 1
+
+
 def test_checkpoint_refuses_nonfinite_state(parts, tmp_path):
     """A detector with check_every > 1 lets divergence slip past a check
     boundary; the checkpoint callback must NOT persist state whose last

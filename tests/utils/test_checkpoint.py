@@ -80,3 +80,75 @@ def test_missing_checkpoint_raises(tmp_path, cfg_params, devices):
     cfg, params = cfg_params
     with pytest.raises(FileNotFoundError):
         ckpt.restore_train_state(str(tmp_path / "nope"), None, {"params": params})
+
+
+# -- crash-atomicity contract (ISSUE 9) ------------------------------------
+
+
+def _tiny():
+    return {"w": jnp.arange(4, dtype=jnp.float32)}
+
+
+def test_latest_step_skips_tmp_and_empty_directories(tmp_path):
+    """A kill mid-save leaves a ``.tmp`` sibling (writer died before
+    its atomic rename) or an empty directory — neither may ever be the
+    checkpoint resume or recovery points at."""
+    import os
+
+    run = tmp_path / "run"
+    ckpt.save_train_state(str(run), 2, _tiny())
+    os.makedirs(run / "step_9.tmp")
+    (run / "step_9.tmp" / "partial").write_text("torn")
+    os.makedirs(run / "step_7")  # mkdir happened, content never landed
+    (run / "step_junk").mkdir()  # unparseable step number
+    assert ckpt.available_steps(str(run)) == [2]
+    assert ckpt.latest_step(str(run)) == 2
+    restored = ckpt.restore_train_state(
+        str(run), None, {"params": _tiny()})
+    np.testing.assert_array_equal(
+        np.asarray(restored["params"]["w"]), np.arange(4))
+
+
+def test_save_is_committed_by_rename(tmp_path):
+    """The ``.tmp`` sibling must be gone after a successful save — the
+    rename IS the commit point, and a stale sibling from a failed
+    earlier attempt is cleaned up on retry."""
+    import os
+
+    path = ckpt.save_train_state(str(tmp_path / "run"), 3, _tiny())
+    assert os.path.isdir(path) and not os.path.exists(path + ckpt.TMP_SUFFIX)
+
+
+def test_save_retries_transient_io_errors(tmp_path):
+    from pipegoose_tpu.testing import TransientIOFault
+
+    fault = TransientIOFault(2)
+    prev = ckpt.set_io_fault_hook(fault)
+    try:
+        ckpt.save_train_state(str(tmp_path / "run"), 1, _tiny())
+    finally:
+        ckpt.set_io_fault_hook(prev)
+    assert fault.fired == 2  # two transient failures absorbed
+    assert ckpt.latest_step(str(tmp_path / "run")) == 1
+    restored = ckpt.restore_train_state(
+        str(tmp_path / "run"), 1, {"params": _tiny()})
+    np.testing.assert_array_equal(
+        np.asarray(restored["params"]["w"]), np.arange(4))
+
+
+def test_save_surfaces_persistent_io_errors(tmp_path):
+    from pipegoose_tpu.testing import TransientIOFault
+
+    prev = ckpt.set_io_fault_hook(TransientIOFault(99))
+    try:
+        with pytest.raises(OSError, match="chaos"):
+            ckpt.save_pretrained(_tiny(), str(tmp_path / "m"),
+                                 retries=2, backoff_s=0.0)
+    finally:
+        ckpt.set_io_fault_hook(prev)
+
+
+def test_save_refuses_existing_checkpoint(tmp_path):
+    ckpt.save_train_state(str(tmp_path / "run"), 1, _tiny())
+    with pytest.raises(ValueError, match="already exists"):
+        ckpt.save_train_state(str(tmp_path / "run"), 1, _tiny())
