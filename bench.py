@@ -524,10 +524,14 @@ def run_bench(force_cpu: bool) -> None:
         was_enabled = reg.enabled
         reg.disable()
         try:
-            res = serving_ab_benchmark(sparams, scfg, specs, **kw)
+            # quant arms (ISSUE 10): fp/int8w/int8kv/int8w+int8kv rows —
+            # tokens/s + TTFT + the measured HBM/page-capacity ratios —
+            # land in the same serving artifact every bench run
+            res = serving_ab_benchmark(sparams, scfg, specs,
+                                       quant_arms=True, **kw)
             res["prefix_replay"] = prefix_replay_benchmark(
                 sparams, scfg, seed=0, include_speculative=True,
-                trace=bool(reqtrace_path), **replay_kw,
+                include_quant=True, trace=bool(reqtrace_path), **replay_kw,
             )
         finally:
             if was_enabled:

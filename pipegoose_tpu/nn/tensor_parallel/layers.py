@@ -29,6 +29,24 @@ from pipegoose_tpu.distributed.functional import (
 )
 
 
+def _kernel_matmul(params: dict, x: jax.Array) -> jax.Array:
+    """The local matmul both parallel linears share, dispatching on the
+    leaf layout: ``{"kernel": fp}`` runs the plain dot; a quantized
+    leaf ``{"q", "scale"}`` (quant/weights.py) runs the dequant-fused
+    matmul so the fp kernel never materializes in HBM. Bias and the
+    surrounding collectives are identical either way, which is what
+    lets ``quantize_params`` drop into every serving forward —
+    prefill, paged decode, and generate() references alike — without
+    touching a call site."""
+    if "q" in params:
+        from pipegoose_tpu.quant.matmul import quantized_matmul
+
+        y = quantized_matmul(x, params["q"], params["scale"])
+    else:
+        y = jnp.dot(x, params["kernel"], preferred_element_type=jnp.float32)
+    return y.astype(x.dtype)
+
+
 def column_parallel_linear(
     params: dict,
     x: jax.Array,
@@ -56,14 +74,18 @@ def column_parallel_linear(
                 "column_parallel_linear(overlap=True) keeps the output "
                 "OUT-sharded; gather_output is not supported"
             )
+        if "q" in params:
+            raise ValueError(
+                "overlap=True is a training-path option; quantized "
+                "(serving) kernels use the monolithic dequant matmul"
+            )
         from pipegoose_tpu.nn.tensor_parallel.overlap import (
             column_parallel_linear_overlap,
         )
 
         return column_parallel_linear_overlap(params, x, axis_name)
     x = copy_to_tensor_group(x, axis_name) if axis_name else x
-    y = jnp.dot(x, params["kernel"], preferred_element_type=jnp.float32)
-    y = y.astype(x.dtype)
+    y = _kernel_matmul(params, x)
     if "bias" in params and params["bias"] is not None:
         y = y + params["bias"]
     if gather_output and axis_name:
@@ -96,6 +118,11 @@ def row_parallel_linear(
                 "row_parallel_linear(overlap=True) requires the input "
                 "already feature-sharded (input_is_parallel=True)"
             )
+        if "q" in params:
+            raise ValueError(
+                "overlap=True is a training-path option; quantized "
+                "(serving) kernels use the monolithic dequant matmul"
+            )
         from pipegoose_tpu.nn.tensor_parallel.overlap import (
             row_parallel_linear_overlap,
         )
@@ -103,8 +130,7 @@ def row_parallel_linear(
         return row_parallel_linear_overlap(params, x, axis_name)
     if axis_name and not input_is_parallel:
         x = scatter_to_tensor_group(x, axis_name, dim=-1)
-    y = jnp.dot(x, params["kernel"], preferred_element_type=jnp.float32)
-    y = y.astype(x.dtype)
+    y = _kernel_matmul(params, x)
     if axis_name:
         y = reduce_from_tensor_group(y, axis_name)
     if "bias" in params and params["bias"] is not None:

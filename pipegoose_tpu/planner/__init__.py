@@ -29,6 +29,12 @@ from pipegoose_tpu.planner.planner import (
     set_planner_gauges,
 )
 from pipegoose_tpu.planner.report import CandidateResult, PlanReport
+from pipegoose_tpu.planner.serving import (
+    ServingCandidate,
+    evaluate_serving_candidate,
+    format_serving_plan,
+    plan_serving_decode,
+)
 from pipegoose_tpu.planner.space import (
     Candidate,
     candidate_key,
@@ -43,7 +49,11 @@ __all__ = [
     "CandidateResult",
     "CostModel",
     "PlanReport",
+    "ServingCandidate",
     "best_layout_at",
+    "evaluate_serving_candidate",
+    "format_serving_plan",
+    "plan_serving_decode",
     "candidate_key",
     "enumerate_candidates",
     "evaluate_candidate",

@@ -1,0 +1,226 @@
+"""Serving decode-layout planning: (tp, weight_dtype, kv_dtype) x HBM.
+
+ROADMAP item 3's stated headroom ("plan serving decode layouts next to
+train steps") meets item 4's quantization axis: for a decode engine the
+layout question is not FLOPs — a one-token-per-slot step is HBM-BOUND —
+but *what fits* and *how many bytes the step must stream*. So the
+serving planner is ANALYTIC: per-device resident bytes (weights at
+their wire precision + the KV pool at its page dtype + the fp
+embedding) against the chip budget for feasibility, and
+(weights + KV-read) / HBM bandwidth for the step-time score. No
+compile: every number comes from shapes and the spec tables
+(telemetry/derived.py), so a capacity question ("does bloom-560m at
+fp32 KV fit a v5e slice with 4096 pages?") answers in microseconds.
+
+Candidates carry ``weight_dtype``/``kv_dtype`` as first-class pruning/
+cost axes. EVERY row keeps both sides of the HBM comparison in its
+``reason`` string — an fp layout that is infeasible shows
+"HBM-infeasible: peak X > budget Y" while its int8 twin shows a
+feasible "HBM ok: peak X' <= budget Y" — so the ~2x quantization
+headroom is visible as rows flipping from pruned to feasible with the
+numbers that flipped them, not as silently disappearing configs.
+
+Byte model (per device; mirrors quant/weights.py + serving/kv_pool.py
+exactly — the engine's ``memory_report()`` is the measured twin):
+
+- block kernels: 12 L h^2 elements, sharded 1/tp; fp at config dtype,
+  int8 at 1 byte + out-channel scales, int4 at 1/2 byte + grouped
+  scales; biases/lns fp.
+- embedding: v h fp (never quantized — it is also the lm head),
+  vocab-sharded 1/tp.
+- KV pool: 2 banks x L x pages x page_size x (nh/tp) x hd at the page
+  dtype; int8 adds the fp32 per-(slot, head) scale plane.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from pipegoose_tpu.planner.cost import CostModel
+from pipegoose_tpu.planner.space import divisors
+from pipegoose_tpu.telemetry.derived import hbm_bw_bytes_per_s_for
+from pipegoose_tpu.telemetry.doctor import _fmt_bytes
+
+SERVING_WEIGHT_DTYPES = ("fp", "int8", "int4")
+SERVING_KV_DTYPES = ("fp", "int8")
+
+
+@dataclasses.dataclass(frozen=True)
+class ServingCandidate:
+    """One decode layout: tensor-parallel degree + wire precisions."""
+
+    tp: int = 1
+    weight_dtype: str = "fp"
+    kv_dtype: str = "fp"
+
+    def __post_init__(self):
+        if self.tp < 1:
+            raise ValueError(f"tp must be >= 1, got {self.tp}")
+        if self.weight_dtype not in SERVING_WEIGHT_DTYPES:
+            raise ValueError(
+                f"weight_dtype must be one of {SERVING_WEIGHT_DTYPES}, "
+                f"got {self.weight_dtype!r}"
+            )
+        if self.kv_dtype not in SERVING_KV_DTYPES:
+            raise ValueError(
+                f"kv_dtype must be one of {SERVING_KV_DTYPES}, got "
+                f"{self.kv_dtype!r}"
+            )
+
+    @property
+    def name(self) -> str:
+        return f"tp{self.tp}+w:{self.weight_dtype}+kv:{self.kv_dtype}"
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def serving_weight_bytes(config: Any, cand: ServingCandidate,
+                         group_size: int = 32) -> int:
+    """Per-device resident weight bytes at the candidate's precision."""
+    h, v, L = config.hidden_size, config.vocab_size, config.n_layer
+    itemsize = int(np.dtype(config.dtype).itemsize)
+    kernel_elems = 12 * L * h * h // cand.tp
+    # per-kernel out dims (column shards out/tp, row keeps out whole):
+    # qkv 3h/tp + up 4h/tp (column) + out h + down h (row), per layer
+    scale_out = L * (3 * h // cand.tp + 4 * h // cand.tp + 2 * h)
+    if cand.weight_dtype == "fp":
+        kernels = kernel_elems * itemsize
+    elif cand.weight_dtype == "int8":
+        kernels = kernel_elems + 4 * scale_out
+    else:  # int4: half a byte per element + grouped scales
+        kernels = kernel_elems // 2 + 4 * (kernel_elems // group_size)
+    embed = v * h * itemsize // cand.tp          # vocab-sharded, fp
+    biases = L * (3 * h // cand.tp + 4 * h // cand.tp + 2 * h) * itemsize
+    # 2 per block (ln_1, ln_2) + embed_ln + ln_f, scale+bias each
+    lns = (2 * L + 2) * 2 * h * itemsize
+    return int(kernels + embed + biases + lns)
+
+
+def serving_kv_bytes(config: Any, cand: ServingCandidate, num_pages: int,
+                     page_size: int) -> int:
+    """Per-device KV pool bytes at the candidate's page dtype."""
+    L, nh, hd = config.n_layer, config.n_head, config.head_dim
+    slots = 2 * L * num_pages * page_size * (nh // cand.tp)
+    if cand.kv_dtype == "fp":
+        return int(slots * hd * np.dtype(config.dtype).itemsize)
+    return int(slots * (hd + 4))   # int8 values + fp32 scale plane
+
+
+def evaluate_serving_candidate(
+    config: Any,
+    cand: ServingCandidate,
+    cost_model: CostModel,
+    *,
+    num_pages: int,
+    page_size: int,
+    num_slots: int,
+    group_size: int = 32,
+) -> Dict[str, Any]:
+    """One row: resident-byte breakdown, feasibility WITH the numbers
+    in the reason either way, the page headroom the budget leaves, and
+    the bandwidth-bound tokens/s score."""
+    if config.n_head % cand.tp:
+        return {
+            "candidate": cand.to_json(), "name": cand.name,
+            "feasible": False,
+            "reason": (f"n_head={config.n_head} not divisible by "
+                       f"tp={cand.tp}"),
+        }
+    weights = serving_weight_bytes(config, cand, group_size)
+    kv = serving_kv_bytes(config, cand, num_pages, page_size)
+    peak = weights + kv
+    budget = float(cost_model.hbm_bytes)
+    feasible = peak <= budget
+    cmp = "<=" if feasible else ">"
+    reason = (
+        f"{'HBM ok' if feasible else 'HBM-infeasible'}: peak "
+        f"{_fmt_bytes(int(peak))} (weights {_fmt_bytes(weights)} + kv "
+        f"{_fmt_bytes(kv)}) {cmp} budget {_fmt_bytes(int(budget))} "
+        f"({cost_model.device_kind})"
+    )
+    # pages the leftover budget could hold at this kv dtype: the
+    # concurrent-capacity axis the bench's capacity ratio measures
+    per_page = max(serving_kv_bytes(config, cand, 1, page_size), 1)
+    capacity_pages = int(max(budget - weights, 0.0) // per_page)
+    row: Dict[str, Any] = {
+        "candidate": cand.to_json(), "name": cand.name,
+        "feasible": feasible, "reason": reason,
+        "weights_bytes": weights, "kv_bytes": kv, "hbm_peak_bytes": peak,
+        "hbm_budget_bytes": int(budget), "capacity_pages": capacity_pages,
+    }
+    if feasible:
+        # memory-bound decode floor: every step streams the resident
+        # weights once plus the active KV once (upper bound: full pool)
+        bw = hbm_bw_bytes_per_s_for(cost_model.device_kind)
+        step_s = (weights + kv) / bw
+        row["step_seconds_floor"] = step_s
+        row["score"] = num_slots / step_s if step_s > 0 else 0.0
+    return row
+
+
+def plan_serving_decode(
+    config: Any,
+    n_devices: int,
+    *,
+    num_pages: int = 1024,
+    page_size: int = 16,
+    num_slots: int = 8,
+    cost_model: Optional[CostModel] = None,
+    weight_dtypes: Sequence[str] = SERVING_WEIGHT_DTYPES,
+    kv_dtypes: Sequence[str] = SERVING_KV_DTYPES,
+    group_size: int = 32,
+) -> Dict[str, Any]:
+    """Rank every (tp | n_devices) x weight_dtype x kv_dtype decode
+    layout. Returns a JSON-able artifact: feasible rows sorted by score
+    (bandwidth-bound tokens/s, descending), pruned rows kept WITH their
+    reasons — the planner's never-silently-drop contract."""
+    cost_model = cost_model or CostModel.for_device()
+    rows = [
+        evaluate_serving_candidate(
+            config, ServingCandidate(tp=tp, weight_dtype=w, kv_dtype=kv),
+            cost_model, num_pages=num_pages, page_size=page_size,
+            num_slots=num_slots, group_size=group_size,
+        )
+        for tp in divisors(n_devices)
+        for w in weight_dtypes
+        for kv in kv_dtypes
+    ]
+    feasible = sorted((r for r in rows if r["feasible"]),
+                      key=lambda r: -r["score"])
+    pruned = [r for r in rows if not r["feasible"]]
+    return {
+        "device_kind": cost_model.device_kind,
+        "n_devices": int(n_devices),
+        "num_pages": int(num_pages), "page_size": int(page_size),
+        "num_slots": int(num_slots),
+        "model": {
+            "hidden_size": config.hidden_size, "n_layer": config.n_layer,
+            "n_head": config.n_head, "vocab_size": config.vocab_size,
+            "dtype": str(np.dtype(config.dtype)),
+        },
+        "rows": feasible + pruned,
+        "n_feasible": len(feasible),
+        "n_pruned": len(pruned),
+        "top": feasible[0]["name"] if feasible else None,
+    }
+
+
+def format_serving_plan(plan: Dict[str, Any], max_rows: int = 24) -> str:
+    """Human table of a :func:`plan_serving_decode` artifact."""
+    lines = [
+        f"serving decode layouts on {plan['n_devices']} x "
+        f"{plan['device_kind']} (pool {plan['num_pages']} pages x "
+        f"{plan['page_size']} tokens): {plan['n_feasible']} feasible, "
+        f"{plan['n_pruned']} pruned"
+    ]
+    for r in plan["rows"][:max_rows]:
+        mark = "ok  " if r["feasible"] else "PRUNE"
+        cap = r.get("capacity_pages")
+        extra = f"  capacity={cap}p" if cap is not None else ""
+        lines.append(f"  [{mark}] {r['name']:<24} {r['reason']}{extra}")
+    if len(plan["rows"]) > max_rows:
+        lines.append(f"  ... {len(plan['rows']) - max_rows} more rows")
+    return "\n".join(lines)

@@ -1,0 +1,45 @@
+"""Quantized inference: int8/int4 weights + the dequant-fused matmul.
+
+ROADMAP item 4's serving half. The gradient wire already runs int8
+(distributed/compressed.py, EQuARX); this package brings the same
+byte-halving to the WEIGHTS a serving engine holds resident and — via
+serving/kv_pool.py's ``kv_dtype`` — to the paged KV pool, so HBM stops
+capping concurrent users before compute does.
+
+- :mod:`pipegoose_tpu.quant.weights` — ``quantize_params`` turns a
+  Bloom param tree's block kernels into ``{"q", "scale", "bias"}``
+  leaves (per-channel symmetric int8, or grouped int4 packed two
+  nibbles per int8byte) that the tensor-parallel layers dispatch on
+  transparently; ``quantize_param_specs`` derives the matching
+  PartitionSpec tree so tp=2 serving needs no new sharding knowledge.
+- :mod:`pipegoose_tpu.quant.matmul` — ``quantized_matmul``: the Pallas
+  dequant-fused kernel in ops/fused_ce.py's tiling idiom (weights stay
+  int8 in HBM; dequant happens per-tile on the way through VMEM) with
+  a numerically identical XLA reference that CPU tier-1 runs.
+
+Everything defaults OFF: an engine without ``weight_dtype``/``kv_dtype``
+never imports a kernel from here and stays byte-identical to PR 1/6.
+"""
+from pipegoose_tpu.quant.matmul import (
+    dequantize_weight,
+    quantized_matmul,
+    unpack_int4,
+)
+from pipegoose_tpu.quant.weights import (
+    QuantSpec,
+    dequantize_params,
+    quantize_param_specs,
+    quantize_params,
+    quantized_weight_bytes,
+)
+
+__all__ = [
+    "QuantSpec",
+    "dequantize_params",
+    "dequantize_weight",
+    "quantize_param_specs",
+    "quantize_params",
+    "quantized_matmul",
+    "quantized_weight_bytes",
+    "unpack_int4",
+]

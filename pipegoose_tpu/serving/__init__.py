@@ -3,9 +3,12 @@
 The layer above the model stack that the per-call ``generate()`` /
 ``generate_tp()`` paths cannot provide: request multiplexing, plus the
 opt-in serving-perf modes — content-addressed copy-on-write prefix
-caching, chunked prefill, and self-speculative decoding. See
-docs/serving.md for the request lifecycle, page-table layout, and the
-prefix-cache / COW / eviction semantics.
+caching, chunked prefill, self-speculative decoding, and quantized
+inference (``weight_dtype``/``kv_dtype``: int8/int4 weights through the
+dequant-fused matmul, int8 KV pages with per-page scale planes). See
+docs/serving.md for the request lifecycle, page-table layout, the
+prefix-cache / COW / eviction semantics, and the quantization accuracy
+contract.
 """
 from pipegoose_tpu.serving.engine import (
     RequestOutput,
@@ -18,10 +21,12 @@ from pipegoose_tpu.serving.kv_pool import (
     NULL_PAGE,
     PagePool,
     copy_page,
+    dequantize_kv,
     gather_pages,
     init_pages,
     paged_decode_step,
     paged_prefill_chunk,
+    quantize_kv,
     write_prompt_pages,
 )
 from pipegoose_tpu.serving.prefix_cache import PrefixCache, PrefixHit
@@ -38,9 +43,11 @@ __all__ = [
     "ServingEngine",
     "Status",
     "copy_page",
+    "dequantize_kv",
     "gather_pages",
     "init_pages",
     "make_skewed_replay",
+    "quantize_kv",
     "paged_decode_step",
     "paged_prefill_chunk",
     "prefix_replay_benchmark",

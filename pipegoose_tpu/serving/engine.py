@@ -83,6 +83,7 @@ from pipegoose_tpu.models._decode import (
 from pipegoose_tpu.models.generate import forward_cached, init_cache
 from pipegoose_tpu.serving.kv_pool import (
     PagePool,
+    check_kv_dtype,
     copy_page,
     init_pages,
     paged_decode_step,
@@ -138,7 +139,10 @@ class ServingEngine:
                  prefix_cache: bool = False,
                  prefill_chunk: Optional[int] = None,
                  speculative: Optional[Tuple[int, int]] = None,
-                 tracer=None):
+                 tracer=None,
+                 weight_dtype: Optional[str] = None,
+                 kv_dtype: Optional[str] = None,
+                 weight_group_size: int = 32):
         """``recorder``: optional ``telemetry.FlightRecorder`` — every
         decode step lands in its ring, and the no-decode-progress
         watchdog dumps a black box through it before raising.
@@ -151,7 +155,18 @@ class ServingEngine:
         chunks + cache hits, first token, decode ticks, spec cycles,
         preemptions) and attributes its TTFT/e2e latency; default None
         keeps the tick path at one attribute read + branch per hook
-        site (guard-tested < 5 µs)."""
+        site (guard-tested < 5 µs).
+
+        ``weight_dtype`` ("int8" | "int4", default None; "fp" is an
+        accepted alias for None, matching kv_dtype): quantize
+        the block kernels at construction (quant/quantize_params) — the
+        TP layers dispatch to the dequant-fused matmul, halving (or
+        quartering) resident weight HBM. ``kv_dtype`` ("int8", default
+        None=fp): int8 KV pages with a per-page scale plane —
+        quantize-on-write, dequantize-in-gather (serving/kv_pool.py).
+        ``weight_group_size``: int4 contraction-group width. Both
+        default OFF: a default-constructed engine builds the exact
+        PR 1/6 programs, byte for byte."""
         if max_context % page_size:
             raise ValueError("max_context must be a multiple of page_size")
         if stall_patience < 1:
@@ -220,6 +235,34 @@ class ServingEngine:
         tp = mesh.shape[tp_axis] if mesh is not None else 1
         if config.n_head % tp:
             raise ValueError(f"n_head={config.n_head} not divisible by tp={tp}")
+        # quantized inference knobs (ROADMAP item 4) — both default OFF.
+        # "fp" is the explicit no-quantization alias both knobs accept
+        # (check_kv_dtype does the same for kv_dtype), so a planner row's
+        # candidate dict feeds straight back into the constructor
+        if weight_dtype == "fp":
+            weight_dtype = None
+        self.weight_dtype = weight_dtype
+        self.kv_dtype = check_kv_dtype(kv_dtype)
+        self.quant_spec = None
+        if weight_dtype is not None:
+            from pipegoose_tpu.quant import (
+                QuantSpec,
+                quantize_param_specs,
+                quantize_params,
+            )
+            from pipegoose_tpu.quant.weights import validate_tp_compat
+
+            self.quant_spec = QuantSpec(weight_dtype, weight_group_size)
+            validate_tp_compat(config, tp, self.quant_spec)
+            if mesh is not None and param_specs is not None:
+                # derive the q/scale PartitionSpecs from the fp tree
+                # BEFORE the params change shape underneath them
+                param_specs = quantize_param_specs(
+                    param_specs, params, self.quant_spec
+                )
+            params = quantize_params(params, self.quant_spec)
+            self.params = params
+            self.param_specs = param_specs
         self.pool = PagePool(num_pages, page_size)
         self._run_prefill_tokens = self._run_hit_tokens = 0  # set per run()
         self.prefix_cache = PrefixCache(self.pool) if prefix_cache else None
@@ -232,7 +275,9 @@ class ServingEngine:
         # shared pages) and by chunking; the legacy monolithic
         # forward_cached + write_prompt_pages path stays the default
         self._paged_prefill = prefix_cache or prefill_chunk is not None
-        self.k_pages, self.v_pages = init_pages(config, num_pages, page_size)
+        self.k_pages, self.v_pages = init_pages(
+            config, num_pages, page_size, kv_dtype=self.kv_dtype
+        )
         valid = getattr(config, "valid_vocab_size", None)
         mask_fn = vocab_mask_for(config)
         spec_k = speculative[0] if speculative else None
@@ -288,8 +333,15 @@ class ServingEngine:
             self._draft = jax.jit(_draft, donate_argnums=(2, 3))
             self._verify = jax.jit(_verify, donate_argnums=(2, 3))
         else:
-            pspec = P(None, None, None, tp_axis, None)   # pages: head-sharded
-            cspec = {"k": pspec, "v": pspec}             # cache: same layout
+            vspec = P(None, None, None, tp_axis, None)   # pages: head-sharded
+            # int8 pools are {"q", "scale"} pytrees: the scale plane has
+            # no head_dim, so its spec drops the trailing entry — the
+            # per-head scales shard WITH their heads
+            pspec = (
+                {"q": vspec, "scale": P(None, None, None, tp_axis)}
+                if self.kv_dtype == "int8" else vspec
+            )
+            cspec = {"k": vspec, "v": vspec}             # fp prefill cache
 
             def _prefill_body(params, ids, mask):
                 cache = init_cache(config, 1, ids.shape[1], tp)
@@ -380,7 +432,10 @@ class ServingEngine:
                 in_specs=(param_specs, P(), pspec, pspec, P(), P(), P()),
                 out_specs=(P(), pspec, pspec), check_vma=False,
             ), donate_argnums=(2, 3))
-            sharding = NamedSharding(mesh, pspec)
+            sharding = jax.tree_util.tree_map(
+                lambda s: NamedSharding(mesh, s), pspec,
+                is_leaf=lambda x: isinstance(x, P),
+            )
             self.k_pages = jax.device_put(self.k_pages, sharding)
             self.v_pages = jax.device_put(self.v_pages, sharding)
             self._pspec = pspec
@@ -443,6 +498,61 @@ class ServingEngine:
         )
         set_doctor_gauges(report, registry=registry or self.registry)
         self.last_doctor_report = report
+        return report
+
+    def memory_report(self, registry=None) -> dict:
+        """Host-side HBM census of the engine's RESIDENT state — the
+        serving view of the doctor's memory budget, grouped by dtype so
+        a quantized engine's ~2x drop is a number, not a vibe. Weights
+        come from the live param tree (quantized leaves count their
+        int8/int4+scale bytes), KV from the live pool arrays (values +
+        scale planes). ``page_capacity_ratio`` is the measured
+        bytes-per-page multiplier vs an fp pool of the same geometry:
+        how many times more pages the same KV HBM holds at this
+        ``kv_dtype`` (the >= 1.8x acceptance meter). Sets the
+        ``serving.hbm.weights_bytes`` / ``serving.hbm.kv_bytes`` gauge
+        pair next to ``doctor.hbm_peak_bytes``."""
+        from pipegoose_tpu.quant.weights import quantized_weight_bytes
+
+        weights = quantized_weight_bytes(self.params)
+        kv_by: dict = {}
+        for leaf in jax.tree_util.tree_leaves((self.k_pages, self.v_pages)):
+            nbytes = int(leaf.size) * int(np.dtype(leaf.dtype).itemsize)
+            key = str(leaf.dtype)
+            kv_by[key] = kv_by.get(key, 0) + nbytes
+        kv_total = int(sum(kv_by.values()))
+        cfg = self.config
+        num_pages = self.pool.num_pages
+        fp_total = (2 * cfg.n_layer * num_pages * self.page_size
+                    * cfg.n_head * cfg.head_dim
+                    * int(np.dtype(cfg.dtype).itemsize))
+        report = {
+            "weight_dtype": self.weight_dtype or "fp",
+            "kv_dtype": self.kv_dtype or "fp",
+            "weights": weights,
+            "kv": {
+                "bytes_by_dtype": kv_by,
+                "total_bytes": kv_total,
+                "num_pages": num_pages,
+                "bytes_per_page": kv_total // num_pages,
+                "fp_bytes_per_page": fp_total // num_pages,
+                "page_capacity_ratio": round(fp_total / max(kv_total, 1), 4),
+            },
+        }
+        reg = registry if registry is not None else self.registry
+        reg.gauge(
+            "serving.hbm.weights_bytes",
+            help="resident model weight bytes (quantized leaves counted "
+                 "at their wire size)",
+        ).set(float(weights["total_bytes"]))
+        reg.gauge(
+            "serving.hbm.kv_bytes",
+            help="resident KV page-pool bytes (values + scale planes)",
+        ).set(float(kv_total))
+        reg.gauge(
+            "serving.hbm.kv_page_capacity_ratio",
+            help="pages the same HBM holds vs an fp pool (1.0 = fp)",
+        ).set(float(report["kv"]["page_capacity_ratio"]))
         return report
 
     # -- internals ---------------------------------------------------------
@@ -993,10 +1103,40 @@ class ServingEngine:
         return outputs, metrics
 
 
+QUANT_BENCH_ARMS = {
+    "fp": {},
+    "int8w": {"weight_dtype": "int8"},
+    "int8kv": {"kv_dtype": "int8"},
+    "int8w+int8kv": {"weight_dtype": "int8", "kv_dtype": "int8"},
+}
+
+
+def _quant_arm_row(engine, outs, metrics):
+    """One quant-arm bench row: throughput, TTFT quantiles through the
+    shared telemetry Histogram, and the memory-report capacity numbers
+    — every arm reports the same fields so fp-vs-int8 divides
+    like-for-like."""
+    h_ttft = Histogram("quant_arm.ttft_seconds")  # standalone reservoir
+    for o in outs:
+        if o.ttft_s is not None:
+            h_ttft.observe(o.ttft_s)
+    mem = engine.memory_report()
+    return {
+        "decode_tokens_per_s": metrics["decode_tokens_per_s"],
+        "ttft_p50_s": round(h_ttft.quantile(0.5), 6),
+        "ttft_p99_s": round(h_ttft.quantile(0.99), 6),
+        "decode_steps": metrics["decode_steps"],
+        "wall_time_s": metrics["wall_time_s"],
+        "weights_bytes": mem["weights"]["total_bytes"],
+        "kv_bytes": mem["kv"]["total_bytes"],
+        "page_capacity_ratio": mem["kv"]["page_capacity_ratio"],
+    }
+
+
 def serving_ab_benchmark(params, config, request_specs, *, num_slots=4,
                          num_pages=64, page_size=16, max_context=256,
                          mesh=None, param_specs=None, tp_axis="tensor",
-                         seed=0, **engine_kwargs):
+                         seed=0, quant_arms=False, **engine_kwargs):
     """A/B the continuous-batching scheduler against naive padded
     batching on ONE model + request mix; returns a JSON-able dict.
 
@@ -1006,6 +1146,12 @@ def serving_ab_benchmark(params, config, request_specs, *, num_slots=4,
     and is then measured on a fresh copy of the workload. Extra
     ``engine_kwargs`` (prefix_cache, prefill_chunk, speculative) apply
     to BOTH arms.
+
+    ``quant_arms=True`` adds a ``quant`` block measuring the SAME
+    workload through continuous engines at fp / int8w / int8kv /
+    int8w+int8kv (ROADMAP item 4): tokens/s, TTFT p50/p99, and the
+    HBM + page-capacity numbers from ``memory_report()``, each pinned
+    against the fp row of the same run.
     """
     rng = np.random.RandomState(seed)
     vocab = getattr(config, "valid_vocab_size", None) or config.vocab_size
@@ -1019,6 +1165,7 @@ def serving_ab_benchmark(params, config, request_specs, *, num_slots=4,
         ]
 
     results = {}
+    fp_arm = None            # (engine, outs, metrics) of the continuous arm
     for label, continuous in (("continuous", True), ("static", False)):
         engine = ServingEngine(
             params, config, num_slots=num_slots, num_pages=num_pages,
@@ -1027,7 +1174,9 @@ def serving_ab_benchmark(params, config, request_specs, *, num_slots=4,
             **engine_kwargs,
         )
         engine.run(make_requests())          # warmup: compile every bucket
-        _, metrics = engine.run(make_requests())
+        outs, metrics = engine.run(make_requests())
+        if continuous:
+            fp_arm = (engine, outs, metrics)
         results[label] = {
             "decode_tokens_per_s": metrics["decode_tokens_per_s"],
             "decode_steps": metrics["decode_steps"],
@@ -1041,6 +1190,40 @@ def serving_ab_benchmark(params, config, request_specs, *, num_slots=4,
     )
     results["num_slots"] = num_slots
     results["requests"] = len(request_specs)
+    if quant_arms:
+        quant = {}
+        for label, qkw in QUANT_BENCH_ARMS.items():
+            if not qkw:
+                # the fp row IS the continuous arm measured above —
+                # same engine kwargs, same workload; don't re-jit and
+                # re-serve the whole thing a third time
+                quant[label] = _quant_arm_row(*fp_arm)
+                continue
+            engine = ServingEngine(
+                params, config, num_slots=num_slots, num_pages=num_pages,
+                page_size=page_size, max_context=max_context, mesh=mesh,
+                param_specs=param_specs, tp_axis=tp_axis, continuous=True,
+                **engine_kwargs, **qkw,
+            )
+            engine.run(make_requests())
+            outs, metrics = engine.run(make_requests())
+            quant[label] = _quant_arm_row(engine, outs, metrics)
+        fp = quant["fp"]
+        quant["summary"] = {
+            "tokens_per_s_vs_fp": {
+                k: round(v["decode_tokens_per_s"]
+                         / max(fp["decode_tokens_per_s"], 1e-9), 3)
+                for k, v in quant.items() if k != "fp"
+            },
+            "kv_capacity_ratio_int8": (
+                quant["int8kv"]["page_capacity_ratio"]
+            ),
+            "weight_bytes_ratio_int8": round(
+                fp["weights_bytes"]
+                / max(quant["int8w"]["weights_bytes"], 1), 3,
+            ),
+        }
+        results["quant"] = quant
     return results
 
 
@@ -1072,7 +1255,7 @@ def prefix_replay_benchmark(params, config, *, n_requests=12, n_prefixes=3,
                             page_size=8, max_context=64, prefill_chunk=None,
                             mesh=None, param_specs=None, tp_axis="tensor",
                             include_speculative=False, speculative=(1, 3),
-                            trace=False):
+                            trace=False, include_quant=False):
     """Measure the tentpole: the same skewed-prompt-reuse replay through
     (a) the PR 1 baseline engine (monolithic prefill, no sharing),
     (b) chunked prefill alone, (c) the prefix cache alone, (d) both, and
@@ -1092,7 +1275,14 @@ def prefix_replay_benchmark(params, config, *, n_requests=12, n_prefixes=3,
     queue/prefill/decode/stall components, which sum to its measured
     e2e) plus a cross-arm summary showing how much of the cached arm's
     TTFT win the cache-savings share accounts for. This is what
-    bench.py writes to ``bench_request_trace.json``."""
+    bench.py writes to ``bench_request_trace.json``.
+
+    ``include_quant=True`` adds ``int8w`` / ``int8kv`` /
+    ``int8w+int8kv`` arms — the cached+chunked engine with ROADMAP
+    item 4's quantization knobs — each carrying its HBM bytes and
+    page-capacity ratio next to the usual tokens/s and TTFT columns,
+    and a ``summary.quant`` block pinning them against the fp
+    cached+chunked arm of the same run."""
     vocab = getattr(config, "valid_vocab_size", None) or config.vocab_size
     replay = make_skewed_replay(
         n_requests=n_requests, n_prefixes=n_prefixes, prefix_len=prefix_len,
@@ -1115,6 +1305,19 @@ def prefix_replay_benchmark(params, config, *, n_requests=12, n_prefixes=3,
             "prefill_chunk": chunk, "prefix_cache": True,
             "speculative": tuple(speculative),
         }
+    quant_labels = set()
+    if include_quant:
+        # quant arms ride the full cached+chunked configuration — the
+        # production shape — so the int8 rows answer "what does
+        # quantization cost/buy ON TOP of the PR 6 engine", and the
+        # shared-page/COW paths run quantized in the same breath
+        for qlabel, qkw in (("int8w", {"weight_dtype": "int8"}),
+                            ("int8kv", {"kv_dtype": "int8"}),
+                            ("int8w+int8kv", {"weight_dtype": "int8",
+                                              "kv_dtype": "int8"})):
+            arms[qlabel] = {"prefill_chunk": chunk, "prefix_cache": True,
+                            **qkw}
+            quant_labels.add(qlabel)
     results = {}
     arm_traces = {}
     for label, kw in arms.items():
@@ -1159,6 +1362,11 @@ def prefix_replay_benchmark(params, config, *, n_requests=12, n_prefixes=3,
         # forwarded (metrics["prefill_tokens"]), so the cached arms'
         # reduction divides like-for-like against the baseline
         row["prefill_tokens"] = metrics["prefill_tokens"]
+        if label in quant_labels:
+            mem = engine.memory_report()
+            row["weights_bytes"] = mem["weights"]["total_bytes"]
+            row["kv_bytes"] = mem["kv"]["total_bytes"]
+            row["page_capacity_ratio"] = mem["kv"]["page_capacity_ratio"]
         if "max_decode_gap_s" in metrics:
             row["max_decode_gap_s"] = metrics["max_decode_gap_s"]
         if "prefix_cache" in metrics:
@@ -1185,6 +1393,22 @@ def prefix_replay_benchmark(params, config, *, n_requests=12, n_prefixes=3,
             / max(base["decode_tokens_per_s"], 1e-9), 3,
         ),
     }
+    if include_quant:
+        both = results["int8w+int8kv"]
+        cc = results["cached+chunked"]
+        results["summary"]["quant"] = {
+            # the acceptance meters: HBM multiplier of the int8 pool and
+            # the throughput ratio vs the same engine at fp — both from
+            # THIS run's rows, not a spec sheet
+            "kv_page_capacity_ratio": both["page_capacity_ratio"],
+            "tokens_per_s_vs_fp_cached": round(
+                both["decode_tokens_per_s"]
+                / max(cc["decode_tokens_per_s"], 1e-9), 3,
+            ),
+            "ttft_p99_vs_fp_cached": round(
+                both["ttft_p99_s"] / max(cc["ttft_p99_s"], 1e-9), 3,
+            ),
+        }
     if trace:
         bt, ct = arm_traces["baseline"], arm_traces["cached"]
         b_ttft = bt["mean_ttft_s"] or 0.0

@@ -37,6 +37,13 @@ Under TP every function sees the LOCAL head subset (call inside
 shard_map with the pool's head dim sharded over the tensor axis), and
 the engine pairs the local logits with ``global_greedy_pick`` exactly
 like models/_decode.py's sharded driver.
+
+``init_pages(kv_dtype="int8")`` swaps each bank for an int8 pytree with
+a per-page scale plane (one fp32 per layer/page-slot/head): writes
+quantize (:func:`quantize_kv`), the attention gather dequantizes
+(:func:`gather_pages`), ``copy_page`` COW-copies values and scales
+together, and every signature stays identical — the quantized pool is
+a drop-in for the fp one at ~``hd/(hd+4)``x fewer KV bytes per page.
 """
 from __future__ import annotations
 
@@ -56,6 +63,44 @@ from pipegoose_tpu.nn.tensor_parallel.layers import (
 )
 
 NULL_PAGE = 0
+
+KV_DTYPES = (None, "fp", "int8")
+
+_KV_INT8_MAX = 127.0
+
+
+def check_kv_dtype(kv_dtype: Optional[str]) -> Optional[str]:
+    if kv_dtype not in KV_DTYPES:
+        raise ValueError(f"kv_dtype must be one of {KV_DTYPES}, got "
+                         f"{kv_dtype!r}")
+    return None if kv_dtype == "fp" else kv_dtype
+
+
+def quantize_kv(x):
+    """fp (..., hd) -> (int8 (..., hd), f32 scale (...,)): symmetric
+    max-abs per POSITION per HEAD over the head dim — the quantize-on-
+    write half of the int8 pool. Per-(position, head) granularity keeps
+    the write shard-local under TP head sharding and makes every write
+    deterministic in the token values alone, which is what lets prefix
+    sharing, COW, and evict->re-admit stay token-exact under int8."""
+    x32 = x.astype(jnp.float32)
+    scale = jnp.maximum(
+        jnp.max(jnp.abs(x32), axis=-1) / _KV_INT8_MAX,
+        jnp.finfo(jnp.float32).tiny,
+    )
+    q = jnp.clip(
+        jnp.round(x32 / scale[..., None]), -_KV_INT8_MAX, _KV_INT8_MAX
+    ).astype(jnp.int8)
+    return q, scale
+
+
+def dequantize_kv(q, scale):
+    """The dequantize-on-read half (inside the attention gather)."""
+    return q.astype(jnp.float32) * scale[..., None]
+
+
+def _is_quantized(pages) -> bool:
+    return isinstance(pages, dict)
 
 
 class PagePool:
@@ -178,12 +223,30 @@ class PagePool:
     free = release
 
 
-def init_pages(config, num_pages: int, page_size: int, tp: int = 1):
+def init_pages(config, num_pages: int, page_size: int, tp: int = 1,
+               kv_dtype: Optional[str] = None):
     """The pool's device buffers; under TP each shard holds nh/tp heads
-    (create the GLOBAL array and shard dim 3 over the tensor axis)."""
+    (create the GLOBAL array and shard dim 3 over the tensor axis).
+
+    ``kv_dtype=None`` (or "fp") keeps the fp pool: a bare array pair in
+    ``config.dtype``. ``"int8"`` stores each bank as a PYTREE
+    ``{"q": int8 (L, P, ps, nh, hd), "scale": f32 (L, P, ps, nh)}`` —
+    the per-page scale plane rides one fp32 scalar per (layer, page
+    slot, head), ~hd x 4 bytes lighter than the values it scales. Every
+    pool function below dispatches on the structure, so the engine's
+    jitted programs, donation, and shard_map specs carry the pair as
+    one value either way."""
     L, nh, hd = config.n_layer, config.n_head, config.head_dim
+    kv_dtype = check_kv_dtype(kv_dtype)
     shape = (L, num_pages, page_size, nh // tp, hd)
-    return jnp.zeros(shape, config.dtype), jnp.zeros(shape, config.dtype)
+    if kv_dtype is None:
+        return jnp.zeros(shape, config.dtype), jnp.zeros(shape, config.dtype)
+
+    def bank():
+        return {"q": jnp.zeros(shape, jnp.int8),
+                "scale": jnp.zeros(shape[:-1], jnp.float32)}
+
+    return bank(), bank()
 
 
 def write_prompt_pages(k_pages, v_pages, cache, phys_pages, pad, page_size):
@@ -205,20 +268,58 @@ def write_prompt_pages(k_pages, v_pages, cache, phys_pages, pad, page_size):
     lclip = jnp.where(valid, logical, 0)
     dest_page = jnp.where(valid, phys_pages[lclip // page_size], NULL_PAGE)
     dest_off = jnp.where(valid, lclip % page_size, 0)
-    k_pages = k_pages.at[:, dest_page, dest_off].set(k_seq.astype(k_pages.dtype))
-    v_pages = v_pages.at[:, dest_page, dest_off].set(v_seq.astype(v_pages.dtype))
-    return k_pages, v_pages
+
+    def scatter(pages, seq):
+        if _is_quantized(pages):
+            q, s = quantize_kv(seq)
+            return {"q": pages["q"].at[:, dest_page, dest_off].set(q),
+                    "scale": pages["scale"].at[:, dest_page, dest_off].set(s)}
+        return pages.at[:, dest_page, dest_off].set(seq.astype(pages.dtype))
+
+    return scatter(k_pages, k_seq), scatter(v_pages, v_seq)
+
+
+def _gather(arr, page_table, trailing: int):
+    """Page-table gather over an array whose page dim sits ``trailing``
+    dims from the end-plus-one: take inserts the (B, W) table dims,
+    then W and the page_size dim merge into the contiguous view."""
+    b, w = page_table.shape
+    ps = arr.shape[-trailing]
+    view = jnp.take(arr, page_table, axis=-(trailing + 1))
+    return view.reshape(
+        view.shape[:-(trailing + 1)] + (w * ps,) + view.shape[-(trailing - 1):]
+    )
 
 
 def gather_pages(pages, page_table):
     """Read the pool through a page table: (B, W) int32 -> the per-slot
     contiguous view (B, W * page_size, nh, hd). The read path of the
-    paged attention; exposed for the reconstruction tests."""
-    b, w = page_table.shape
-    ps = pages.shape[-3]
-    view = jnp.take(pages, page_table, axis=-4)
-    # (.., B, W, ps, nh, hd) -> (.., B, W * ps, nh, hd)
-    return view.reshape(view.shape[:-4] + (w * ps,) + view.shape[-2:])
+    paged attention; exposed for the reconstruction tests. An int8 bank
+    dequantizes HERE — inside the gather, per (position, head) — so the
+    attention core sees fp values and the pool keeps 1-byte pages."""
+    if _is_quantized(pages):
+        q = _gather(pages["q"], page_table, trailing=3)
+        s = _gather(pages["scale"], page_table, trailing=2)
+        return dequantize_kv(q, s)
+    return _gather(pages, page_table, trailing=3)
+
+
+def page_size_of(pages) -> int:
+    """Static page_size of a bank, fp or int8 (dim 2 past the layer and
+    page dims; the scale plane shares it)."""
+    leaf = pages["q"] if _is_quantized(pages) else pages
+    return leaf.shape[-3]
+
+
+def _write_kv(pages, page_idx, off_idx, val):
+    """Scatter fp values ``val`` at (page_idx, off_idx) of one LAYER's
+    bank (leading layer dim already scanned away) — quantizing on write
+    when the bank is int8, value and scale plane in lockstep."""
+    if _is_quantized(pages):
+        q, s = quantize_kv(val)
+        return {"q": pages["q"].at[page_idx, off_idx].set(q),
+                "scale": pages["scale"].at[page_idx, off_idx].set(s)}
+    return pages.at[page_idx, off_idx].set(val.astype(pages.dtype))
 
 
 def _local_slopes(config, tp_axis):
@@ -277,7 +378,7 @@ def paged_decode_step(params, tokens, k_pages, v_pages, page_table, seq_lens,
     ``_decode.global_greedy_pick`` like the sharded generate driver.
     """
     b = tokens.shape[0]
-    ps = k_pages.shape[2]
+    ps = page_size_of(k_pages)
     n_keys = page_table.shape[1] * ps
 
     x = vocab_parallel_embedding(params["embed"], tokens[:, None], tp_axis)
@@ -296,15 +397,16 @@ def paged_decode_step(params, tokens, k_pages, v_pages, page_table, seq_lens,
     k_all, v_all = k_pages, v_pages
     if draft_layers is not None:
         blocks = jax.tree_util.tree_map(lambda a: a[:draft_layers], blocks)
-        k_pages, v_pages = k_pages[:draft_layers], v_pages[:draft_layers]
+        k_pages = jax.tree_util.tree_map(lambda a: a[:draft_layers], k_pages)
+        v_pages = jax.tree_util.tree_map(lambda a: a[:draft_layers], v_pages)
 
     def scan_fn(carry, blk_and_pages):
         h = carry
         blk, kp, vp = blk_and_pages
         ln1 = layer_norm(blk["ln_1"], h, config.layer_norm_epsilon)
         q, k, v = _qkv_proj({"qkv": blk["attn"]["qkv"]}, ln1, config, tp_axis)
-        kp = kp.at[phys, off].set(k[:, 0].astype(kp.dtype))
-        vp = vp.at[phys, off].set(v[:, 0].astype(vp.dtype))
+        kp = _write_kv(kp, phys, off, k[:, 0])
+        vp = _write_kv(vp, phys, off, v[:, 0])
         keys = gather_pages(kp, page_table)
         vals = gather_pages(vp, page_table)
         ctx = _attn_core(q, keys, vals, bias, None, h.dtype)
@@ -316,8 +418,9 @@ def paged_decode_step(params, tokens, k_pages, v_pages, page_table, seq_lens,
 
     x, (k_pages, v_pages) = lax.scan(scan_fn, x, (blocks, k_pages, v_pages))
     if draft_layers is not None:
-        k_pages = k_all.at[:draft_layers].set(k_pages)
-        v_pages = v_all.at[:draft_layers].set(v_pages)
+        merge = lambda full, part: full.at[:draft_layers].set(part)  # noqa: E731
+        k_pages = jax.tree_util.tree_map(merge, k_all, k_pages)
+        v_pages = jax.tree_util.tree_map(merge, v_all, v_pages)
     x = layer_norm(params["ln_f"], x, config.layer_norm_epsilon)
     logits = logits_fn(params, x, tp_axis)[:, 0]  # (B, V_local)
     return logits, k_pages, v_pages
@@ -330,10 +433,16 @@ def copy_page(k_pages, v_pages, src, dst):
     page — the new owner gets a private copy of the shared tokens' KV
     and writes its tail there, while readers of ``src`` are untouched.
     ``src``/``dst`` are runtime scalars: one compiled program covers
-    every copy."""
+    every copy. An int8 bank copies its scale plane WITH the page —
+    COW'd quantized values stay exactly the values the readers of
+    ``src`` dequantize."""
+
+    def cp(plane):
+        return plane.at[:, dst].set(jnp.take(plane, src, axis=1))
+
     return (
-        k_pages.at[:, dst].set(jnp.take(k_pages, src, axis=1)),
-        v_pages.at[:, dst].set(jnp.take(v_pages, src, axis=1)),
+        jax.tree_util.tree_map(cp, k_pages),
+        jax.tree_util.tree_map(cp, v_pages),
     )
 
 
@@ -359,7 +468,7 @@ def paged_prefill_chunk(params, tokens, k_pages, v_pages, page_table, start,
     draft bundle in one pass through this same paged path).
     """
     b, c = tokens.shape
-    ps = k_pages.shape[2]
+    ps = page_size_of(k_pages)
     n_keys = page_table.shape[1] * ps
 
     x = vocab_parallel_embedding(params["embed"], tokens, tp_axis)
@@ -387,8 +496,8 @@ def paged_prefill_chunk(params, tokens, k_pages, v_pages, page_table, start,
         blk, kp, vp = blk_and_pages
         ln1 = layer_norm(blk["ln_1"], h, config.layer_norm_epsilon)
         q, k, v = _qkv_proj({"qkv": blk["attn"]["qkv"]}, ln1, config, tp_axis)
-        kp = kp.at[dest_page, dest_off].set(k.astype(kp.dtype))
-        vp = vp.at[dest_page, dest_off].set(v.astype(vp.dtype))
+        kp = _write_kv(kp, dest_page, dest_off, k)
+        vp = _write_kv(vp, dest_page, dest_off, v)
         keys = gather_pages(kp, page_table)
         vals = gather_pages(vp, page_table)
         ctx = _attn_core(q, keys, vals, bias, qmask, h.dtype)

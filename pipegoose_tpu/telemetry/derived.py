@@ -78,6 +78,21 @@ HBM_BYTES: Dict[str, float] = {
     "cpu": 16 * 1024**3,
 }
 
+# Per-chip HBM BANDWIDTH (B/s, public system specs) — the denominator
+# of the serving decode-layout cost model (planner/serving.py): a
+# batch-1-per-slot decode step is memory-bound, so its floor is
+# (resident weights + KV read) / this number. The quantized-inference
+# win is exactly a smaller numerator here.
+HBM_BW_BYTES: Dict[str, float] = {
+    "v5 lite": 819e9,
+    "v5e": 819e9,
+    "v5p": 2765e9,
+    "v6 lite": 1640e9,
+    "v6e": 1640e9,
+    "v4": 1228e9,
+    "cpu": 50e9,
+}
+
 
 def _kind_lookup(table: Dict[str, float], device_kind: Optional[str],
                  default: float) -> float:
@@ -113,6 +128,12 @@ def hbm_bytes_for(device_kind: Optional[str] = None) -> float:
     feasibility budget where the backend reports no live ``bytes_limit``
     (fake CPU devices report none)."""
     return _kind_lookup(HBM_BYTES, device_kind, 16 * 1024**3)
+
+
+def hbm_bw_bytes_per_s_for(device_kind: Optional[str] = None) -> float:
+    """Per-chip HBM bandwidth (B/s) — the memory-bound decode cost
+    model's denominator (planner/serving.py)."""
+    return _kind_lookup(HBM_BW_BYTES, device_kind, 100e9)
 
 
 def mfu(flops_per_step: float, step_seconds: float,

@@ -226,12 +226,18 @@ class MemoryReport:
     source: str                   # "memory_analysis" | "shape_walk"
     hbm_limit: Optional[int]      # device bytes_limit where the backend reports it
     top: List[dict]               # largest buffers: {path, per_device_bytes, role}
+    # arg-group label -> {dtype string -> per-device bytes}: the dtype
+    # split of each group, so a quantized serving engine's weight and
+    # KV-page drop reads straight off /debug/doctor and
+    # BENCH_DOCTOR_JSON (None on reports from older artifacts)
+    by_dtype: Optional[Dict[str, Dict[str, int]]] = None
 
     def to_json(self) -> dict:
         return dataclasses.asdict(self)
 
     @classmethod
     def from_json(cls, d: dict) -> "MemoryReport":
+        by_dtype = d.get("by_dtype")
         return cls(
             groups=dict(d["groups"]), output_bytes=int(d["output_bytes"]),
             temp_bytes=(None if d.get("temp_bytes") is None
@@ -240,13 +246,23 @@ class MemoryReport:
             hbm_limit=(None if d.get("hbm_limit") is None
                        else int(d["hbm_limit"])),
             top=[dict(t) for t in d.get("top", [])],
+            by_dtype=(None if by_dtype is None else {
+                str(g): {str(k): int(v) for k, v in dd.items()}
+                for g, dd in by_dtype.items()
+            }),
         )
 
     def format_table(self) -> str:
         rows = [("group", "per-device", "of peak")]
         denom = max(self.peak_bytes, 1)
         for k, v in self.groups.items():
-            rows.append((k, _fmt_bytes(v), f"{v / denom:6.1%}"))
+            label = k
+            if self.by_dtype and len(self.by_dtype.get(k, {})) > 0:
+                label = k + " (" + " + ".join(
+                    f"{dt}:{_fmt_bytes(b)}"
+                    for dt, b in sorted(self.by_dtype[k].items())
+                ) + ")"
+            rows.append((label, _fmt_bytes(v), f"{v / denom:6.1%}"))
         rows.append(("outputs", _fmt_bytes(self.output_bytes),
                      f"{self.output_bytes / denom:6.1%}"))
         if self.temp_bytes is not None:
@@ -703,12 +719,14 @@ def diagnose(
 
     # -- memory budget -----------------------------------------------------
     groups: Dict[str, int] = {}
+    by_dtype: Dict[str, Dict[str, int]] = {}
     for b in buffers:
         if b.role == "output":
             continue
-        groups[b.path.split("/")[0]] = (
-            groups.get(b.path.split("/")[0], 0) + b.per_device_bytes
-        )
+        label = b.path.split("/")[0]
+        groups[label] = groups.get(label, 0) + b.per_device_bytes
+        dd = by_dtype.setdefault(label, {})
+        dd[b.dtype] = dd.get(b.dtype, 0) + b.per_device_bytes
     temp = peak = None
     source = "shape_walk"
     try:
@@ -744,6 +762,7 @@ def diagnose(
     memory_report = MemoryReport(
         groups=groups, output_bytes=out_bytes_per_device, temp_bytes=temp,
         peak_bytes=int(peak), source=source, hbm_limit=hbm_limit, top=top,
+        by_dtype=by_dtype,
     )
     cost_flops = None
     try:

@@ -149,6 +149,47 @@ finally:
 print("chaos smoke OK: injected nonfinite bomb recovered, losses finite")
 PY
 
+# Quant greedy-parity smoke (pipegoose_tpu/quant/ + serving, ISSUE 10):
+# an int8-weight + int8-KV engine must serve the exact token streams of
+# the fp engine on a shared-prefix workload, at >= 1.8x measured page
+# capacity — the quantization accuracy contract stays exercised on
+# every CI run before the tier proper.
+echo "== quant greedy-parity smoke (int8 weights + int8 KV) =="
+python - <<'PY'
+from pipegoose_tpu.testing import force_cpu_devices
+
+force_cpu_devices(1)
+
+import jax
+import numpy as np
+
+from pipegoose_tpu.models import bloom
+from pipegoose_tpu.serving import Request, ServingEngine
+
+cfg = bloom.BloomConfig(vocab_size=64, hidden_size=64, n_layer=2, n_head=4)
+params = bloom.init_params(cfg, jax.random.PRNGKey(0))
+rng = np.random.RandomState(7)
+shared = rng.randint(1, 64, (9,))
+reqs = [(np.concatenate([shared, rng.randint(1, 64, (k,))]), n)
+        for k, n in [(2, 4), (4, 3)]]
+
+def serve(**quant):
+    eng = ServingEngine(params, cfg, num_slots=2, num_pages=16,
+                        page_size=4, max_context=32, prefix_cache=True,
+                        **quant)
+    outs, _ = eng.run([Request(prompt=p, max_new_tokens=n)
+                       for p, n in reqs])
+    return eng, [np.asarray(o.generated) for o in outs]
+
+_, fp = serve()
+eng, q = serve(weight_dtype="int8", kv_dtype="int8")
+for a, b in zip(fp, q):
+    np.testing.assert_array_equal(a, b, err_msg="int8 engine diverged")
+ratio = eng.memory_report()["kv"]["page_capacity_ratio"]
+assert ratio >= 1.8, f"page capacity {ratio} < 1.8x"
+print(f"quant smoke OK: greedy token-identical, {ratio}x page capacity")
+PY
+
 echo "== fast tier =="
 python -m pytest tests/ -q -m fast -p no:cacheprovider \
     --continue-on-collection-errors "$@"

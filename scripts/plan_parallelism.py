@@ -95,6 +95,24 @@ def main() -> int:
     ap.add_argument("--tolerance", type=float, default=0.5,
                     help="allowed score gap to top-1 in check mode "
                          "(0.5 = configured must reach 50%% of top-1)")
+    # serving decode-layout mode (planner/serving.py, ROADMAP items 3+4):
+    # analytic (tp, weight_dtype, kv_dtype) x HBM ranking — no compiles
+    ap.add_argument("--serving-decode", action="store_true",
+                    help="rank serving DECODE layouts instead of train "
+                         "steps: (tp, weight_dtype, kv_dtype) vs the "
+                         "chip's HBM budget + bandwidth, analytically")
+    ap.add_argument("--num-pages", type=int, default=1024,
+                    help="serving-decode mode: KV pool pages")
+    ap.add_argument("--page-size", type=int, default=16,
+                    help="serving-decode mode: tokens per page")
+    ap.add_argument("--num-slots", type=int, default=8,
+                    help="serving-decode mode: decode slots")
+    ap.add_argument("--weight-dtype", default="fp",
+                    choices=("fp", "int8", "int4"),
+                    help="serving-decode --check: configured weight wire "
+                         "precision")
+    ap.add_argument("--kv-dtype", default="fp", choices=("fp", "int8"),
+                    help="serving-decode --check: configured KV page dtype")
     args = ap.parse_args()
 
     if args.fake_devices:
@@ -118,11 +136,59 @@ def main() -> int:
         vocab_size=args.vocab, hidden_size=args.hidden,
         n_layer=args.layers, n_head=args.heads,
     )
-    model = BloomPlanModel(cfg, batch=args.batch, seq=args.seq)
     cost_model = CostModel.for_device(
         args.device_kind,
         hbm_bytes=(args.hbm_gib * 1024**3 if args.hbm_gib else None),
     )
+
+    if args.serving_decode:
+        from pipegoose_tpu.planner import (
+            format_serving_plan,
+            plan_serving_decode,
+        )
+
+        plan = plan_serving_decode(
+            cfg, n_devices, num_pages=args.num_pages,
+            page_size=args.page_size, num_slots=args.num_slots,
+            cost_model=cost_model,
+        )
+        if not args.quiet:
+            print(format_serving_plan(plan))
+        if args.json:
+            from pipegoose_tpu.telemetry.exporters import atomic_write_text
+
+            atomic_write_text(args.json, json.dumps(plan, indent=1))
+            print(f"serving plan written: {args.json}")
+        if args.check:
+            # gate semantics, serving flavor: the configured
+            # (tp, weight_dtype, kv_dtype) row must be FEASIBLE and
+            # within --tolerance of the top score — same exit contract
+            # as the train-step gate (exit 2 + the row's reason)
+            name = (f"tp{args.tp}+w:{args.weight_dtype}"
+                    f"+kv:{args.kv_dtype}")
+            row = next((r for r in plan["rows"] if r["name"] == name),
+                       None)
+            if row is None:
+                print(f"serving check FAILED: {name} is not in the "
+                      f"enumerated space (tp must divide "
+                      f"{plan['n_devices']} devices)")
+                return 2
+            if not row["feasible"]:
+                print(f"serving check FAILED: {name} — {row['reason']}")
+                return 2
+            top = plan["rows"][0]
+            if row["score"] < (1.0 - args.tolerance) * top["score"]:
+                print(f"serving check FAILED: {name} scores "
+                      f"{row['score']:,.0f} tok/s vs top-1 {top['name']} "
+                      f"{top['score']:,.0f} (below "
+                      f"{1.0 - args.tolerance:.0%})")
+                return 2
+            print(f"serving check: OK — {name} feasible "
+                  f"({row['reason']}), {row['score']:,.0f} tok/s vs "
+                  f"top-1 {top['score']:,.0f}")
+        return 0
+
+    model = BloomPlanModel(cfg, batch=args.batch, seq=args.seq)
     candidates = enumerate_candidates(
         n_devices,
         pp_sizes=tuple(int(x) for x in args.pp.split(",") if x),

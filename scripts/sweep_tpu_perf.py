@@ -9,6 +9,10 @@ RTT subtracted. One attach per run (tunnel is single-client).
         # Zipf shared-prefix replay arms (baseline / chunked / cached /
         # cached+spec) per slot count instead of the continuous-vs-
         # static A/B
+    python scripts/sweep_tpu_perf.py serving --quant   # ISSUE 10: add
+        # int8w / int8kv / int8w+int8kv arms (tokens/s, TTFT, HBM,
+        # page-capacity ratio vs the fp rows); composes with
+        # --prefix-replay
     python scripts/sweep_tpu_perf.py plan   # ISSUE 7: static layout
         # ranking (pipegoose_tpu/planner/), then measure ONLY the
         # top-K (PLAN_TOP_K) and record predicted-vs-measured deltas
@@ -449,7 +453,7 @@ def plan_sweep():
         print(f"plan artifact: {plan_path}")
 
 
-def serving_sweep(prefix_replay: bool = False):
+def serving_sweep(prefix_replay: bool = False, quant: bool = False):
     """Continuous-batching vs naive padded serving (serving/engine.py)
     across slot counts on the real chip: the decode-step savings grow
     with the slot count as long as the mixed-length workload keeps
@@ -461,7 +465,12 @@ def serving_sweep(prefix_replay: bool = False):
     shared-prefix replay and measures the four engine arms (monolithic
     baseline, chunked prefill, chunked + prefix cache, + speculative)
     per slot count — tokens/s, TTFT p50/p99, hit rate, prefill-token
-    reduction, max decode gap."""
+    reduction, max decode gap.
+
+    ``--quant`` (ROADMAP item 4) adds the int8w / int8kv / int8w+int8kv
+    arms to whichever workload runs: tokens/s, TTFT, resident HBM, and
+    the measured page-capacity ratio per slot count, pinned against the
+    fp rows of the same run."""
     from pipegoose_tpu.models import bloom
     from pipegoose_tpu.serving import (
         prefix_replay_benchmark,
@@ -491,11 +500,13 @@ def serving_sweep(prefix_replay: bool = False):
                     num_slots=slots, num_pages=1 + 16 * slots,
                     page_size=32, max_context=256, prefill_chunk=64,
                     include_speculative=True, speculative=(4, 3),
+                    include_quant=quant,
                 )
             else:
                 results[label] = serving_ab_benchmark(
                     params, cfg, specs, num_slots=slots,
                     num_pages=1 + 3 * slots, page_size=32, max_context=128,
+                    quant_arms=quant,
                 )
         except Exception as e:  # noqa: BLE001
             results[label] = {"error": f"{type(e).__name__}: {e}"[:300]}
@@ -519,9 +530,12 @@ if __name__ == "__main__":
              "comm": comm_sweep, "plan": plan_sweep}
     if mode not in modes:
         raise SystemExit(f"unknown mode {mode!r}; pick one of {sorted(modes)}")
-    if mode == "serving" and "--prefix-replay" in sys.argv[2:]:
-        modes["serving"] = functools.partial(serving_sweep,
-                                             prefix_replay=True)
+    if mode == "serving":
+        modes["serving"] = functools.partial(
+            serving_sweep,
+            prefix_replay="--prefix-replay" in sys.argv[2:],
+            quant="--quant" in sys.argv[2:],
+        )
     # telemetry JSONL artifact (the serving sweep's engines emit their
     # per-step time series into it; every mode gets a final snapshot) —
     # set SWEEP_TELEMETRY_JSONL="" to disable

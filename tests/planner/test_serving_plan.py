@@ -1,0 +1,164 @@
+"""Serving decode-layout planner (ROADMAP items 3+4): the analytic
+byte model must be the engine ``memory_report()``'s exact twin at tp=1,
+quantization must flip HBM-infeasible fp rows to feasible int8 rows
+with BOTH numbers in the reason string (the never-silently-drop
+contract), and the ranking must prefer the layouts that stream fewer
+bytes per step."""
+import jax
+import pytest
+
+from pipegoose_tpu.models import bloom
+from pipegoose_tpu.planner import (
+    ServingCandidate,
+    evaluate_serving_candidate,
+    format_serving_plan,
+    plan_serving_decode,
+)
+from pipegoose_tpu.planner.cost import CostModel
+from pipegoose_tpu.planner.serving import (
+    serving_kv_bytes,
+    serving_weight_bytes,
+)
+from pipegoose_tpu.serving import ServingEngine
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    return bloom.BloomConfig(vocab_size=64, hidden_size=64, n_layer=2,
+                             n_head=4)
+
+
+def test_candidate_validation():
+    with pytest.raises(ValueError, match="weight_dtype"):
+        ServingCandidate(weight_dtype="fp16")
+    with pytest.raises(ValueError, match="kv_dtype"):
+        ServingCandidate(kv_dtype="int4")
+    with pytest.raises(ValueError, match="tp"):
+        ServingCandidate(tp=0)
+    assert ServingCandidate(2, "int8", "int8").name == "tp2+w:int8+kv:int8"
+
+
+@pytest.mark.parametrize("wd,kvd", [("fp", "fp"), ("int8", "fp"),
+                                    ("int8", "int8"), ("int4", "fp")])
+def test_byte_model_matches_live_engine_census(cfg, wd, kvd):
+    """The planner's analytic bytes EQUAL the measured memory_report()
+    of a real engine with the same knobs (tp=1): predicted capacity is
+    the measured capacity, not an estimate of one."""
+    params = bloom.init_params(cfg, jax.random.PRNGKey(0))
+    kw = {}
+    if wd != "fp":
+        kw = {"weight_dtype": wd, "weight_group_size": 16}
+    if kvd != "fp":
+        kw["kv_dtype"] = kvd
+    eng = ServingEngine(params, cfg, num_slots=2, num_pages=16,
+                        page_size=4, max_context=32, **kw)
+    mem = eng.memory_report()
+    cand = ServingCandidate(tp=1, weight_dtype=wd, kv_dtype=kvd)
+    assert serving_weight_bytes(cfg, cand, group_size=16) \
+        == mem["weights"]["total_bytes"]
+    assert serving_kv_bytes(cfg, cand, 16, 4) == mem["kv"]["total_bytes"]
+
+
+def test_int8_flips_infeasible_fp_row_to_feasible(cfg):
+    """A budget between the int8 and fp peaks: the fp row is PRUNED
+    with 'HBM-infeasible: peak X > budget Y', its int8 twin is feasible
+    with 'peak X' <= budget Y' — rows flip with their numbers, they
+    never vanish."""
+    fp = ServingCandidate(1, "fp", "fp")
+    q = ServingCandidate(1, "int8", "int8")
+    num_pages, page_size = 256, 16
+    fp_peak = (serving_weight_bytes(cfg, fp)
+               + serving_kv_bytes(cfg, fp, num_pages, page_size))
+    q_peak = (serving_weight_bytes(cfg, q)
+              + serving_kv_bytes(cfg, q, num_pages, page_size))
+    assert q_peak < fp_peak
+    budget = (fp_peak + q_peak) // 2
+    cm = CostModel.for_device("cpu", hbm_bytes=float(budget))
+    plan = plan_serving_decode(cfg, 1, num_pages=num_pages,
+                               page_size=page_size, cost_model=cm)
+    rows = {r["name"]: r for r in plan["rows"]}
+    fp_row, q_row = rows[fp.name], rows[q.name]
+    assert not fp_row["feasible"]
+    assert "HBM-infeasible" in fp_row["reason"]
+    assert "> budget" in fp_row["reason"]
+    assert q_row["feasible"]
+    assert "HBM ok" in q_row["reason"] and "<= budget" in q_row["reason"]
+    # the reason carries both sides of the comparison as numbers
+    for row in (fp_row, q_row):
+        assert "peak" in row["reason"] and "weights" in row["reason"]
+    assert plan["n_pruned"] >= 1 and plan["n_feasible"] >= 1
+
+
+def test_capacity_pages_and_score_favor_quantized(cfg):
+    cm = CostModel.for_device("v5 lite")
+    common = dict(num_pages=128, page_size=16, num_slots=4)
+    rows = {
+        wd: evaluate_serving_candidate(
+            cfg, ServingCandidate(1, wd, kv), cm, **common
+        )
+        for wd, kv in (("fp", "fp"), ("int8", "int8"))
+    }
+    assert rows["int8"]["capacity_pages"] > rows["fp"]["capacity_pages"]
+    # fewer streamed bytes -> lower step floor -> higher tokens/s score
+    assert rows["int8"]["score"] > rows["fp"]["score"]
+    assert (rows["int8"]["step_seconds_floor"]
+            < rows["fp"]["step_seconds_floor"])
+
+
+def test_tp_indivisible_head_count_pruned_with_reason(cfg):
+    plan = plan_serving_decode(cfg, 8, num_pages=64, page_size=16,
+                               cost_model=CostModel.for_device("cpu"))
+    tp8 = [r for r in plan["rows"] if r["candidate"]["tp"] == 8]
+    assert tp8 and all(not r["feasible"] for r in tp8)
+    assert all("not divisible" in r["reason"] for r in tp8)
+
+
+def test_cli_serving_check_gate_semantics(cfg, tmp_path):
+    """`plan_parallelism.py --serving-decode --check` is a real gate:
+    exit 0 with the configured row's numbers when it is feasible, exit
+    2 naming the reason when the fp layout misses the budget that its
+    int8 twin fits (the headroom story as a CI contract)."""
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    repo = Path(__file__).resolve().parent.parent.parent
+    fp = ServingCandidate(1, "fp", "fp")
+    q = ServingCandidate(1, "int8", "int8")
+    pages, ps = 256, 16
+    budget_b = (serving_weight_bytes(cfg, fp)
+                + serving_kv_bytes(cfg, fp, pages, ps)
+                + serving_weight_bytes(cfg, q)
+                + serving_kv_bytes(cfg, q, pages, ps)) // 2
+    base = [sys.executable, str(repo / "scripts" / "plan_parallelism.py"),
+            "--serving-decode", "--fake-devices", "1", "--quiet",
+            "--layers", str(cfg.n_layer), "--hidden", str(cfg.hidden_size),
+            "--heads", str(cfg.n_head), "--vocab", str(cfg.vocab_size),
+            "--num-pages", str(pages), "--page-size", str(ps),
+            "--hbm-gib", str(budget_b / 1024**3),
+            "--check", "--tp", "1"]
+    ok = subprocess.run(base + ["--weight-dtype", "int8",
+                                "--kv-dtype", "int8"],
+                        capture_output=True, text=True, cwd=str(repo))
+    assert ok.returncode == 0, ok.stdout + ok.stderr
+    assert "serving check: OK" in ok.stdout
+    bad = subprocess.run(base, capture_output=True, text=True,
+                         cwd=str(repo))
+    assert bad.returncode == 2, bad.stdout + bad.stderr
+    assert "HBM-infeasible" in bad.stdout
+
+
+def test_plan_artifact_shape_and_table(cfg):
+    plan = plan_serving_decode(cfg, 2, num_pages=64, page_size=16,
+                               cost_model=CostModel.for_device("v5 lite"))
+    # 2 tp values x 3 weight dtypes x 2 kv dtypes
+    assert len(plan["rows"]) == 12
+    assert plan["n_feasible"] + plan["n_pruned"] == 12
+    assert plan["top"] is not None
+    # feasible rows come first, sorted by descending score
+    scores = [r["score"] for r in plan["rows"] if r["feasible"]]
+    assert scores == sorted(scores, reverse=True)
+    table = format_serving_plan(plan)
+    assert "feasible" in table and "tp2+w:int8+kv:int8" in table
+    import json
+    json.dumps(plan)   # artifact is JSON-able as-is

@@ -1,0 +1,106 @@
+"""Dequant-fused matmul equivalence: the Pallas kernel (interpret mode
+on CPU, the flash_attention convention) must match the XLA reference
+EXACTLY — same math, same scaling order — and both must sit within the
+quantization round-trip error of the fp matmul. Fast tier: these are
+the kernel contracts every serving parity test upstack relies on."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pipegoose_tpu.quant import quantized_matmul
+from pipegoose_tpu.quant.matmul import dequantize_weight
+from pipegoose_tpu.quant.weights import QuantSpec, _quantize_kernel
+
+
+def _quantized(k, dtype="int8", g=16):
+    return _quantize_kernel(k, QuantSpec(dtype, g))
+
+
+@pytest.fixture(scope="module")
+def operands():
+    kx, kw = jax.random.split(jax.random.PRNGKey(0))
+    x = jax.random.normal(kx, (24, 64))
+    w = jax.random.normal(kw, (64, 96)) / 8.0
+    return x, w
+
+
+def test_int8_pallas_interpret_matches_xla(operands):
+    x, w = operands
+    leaf = _quantized(w)
+    y_ref = quantized_matmul(x, leaf["q"], leaf["scale"], impl="xla")
+    y_ker = quantized_matmul(x, leaf["q"], leaf["scale"], impl="pallas",
+                             interpret=True)
+    np.testing.assert_array_equal(np.asarray(y_ker), np.asarray(y_ref))
+
+
+def test_int4_pallas_interpret_matches_xla(operands):
+    x, w = operands
+    leaf = _quantized(w, "int4")
+    y_ref = quantized_matmul(x, leaf["q"], leaf["scale"], impl="xla")
+    y_ker = quantized_matmul(x, leaf["q"], leaf["scale"], impl="pallas",
+                             interpret=True)
+    np.testing.assert_array_equal(np.asarray(y_ker), np.asarray(y_ref))
+
+
+@pytest.mark.parametrize("dtype", ["int8", "int4"])
+def test_matches_fp_within_round_trip_error(operands, dtype):
+    """y_quant - y_fp is bounded by the weight round-trip error times
+    the activation magnitude — the matmul adds NO error of its own
+    (both impls accumulate fp32)."""
+    x, w = operands
+    leaf = _quantized(w, dtype)
+    y_fp = x @ w
+    y_q = quantized_matmul(x, leaf["q"], leaf["scale"], impl="xla")
+    # exact: quantized matmul == x @ dequantized(w) in fp32
+    np.testing.assert_allclose(
+        np.asarray(y_q),
+        np.asarray(x @ dequantize_weight(leaf["q"], leaf["scale"])),
+        rtol=1e-5, atol=1e-5,
+    )
+    rel = float(jnp.max(jnp.abs(y_q - y_fp)) / jnp.max(jnp.abs(y_fp)))
+    assert rel < (0.02 if dtype == "int8" else 0.2)
+
+
+def test_batched_leading_dims_flatten(operands):
+    x, w = operands
+    leaf = _quantized(w)
+    x3 = x.reshape(2, 12, 64)
+    y3 = quantized_matmul(x3, leaf["q"], leaf["scale"], impl="xla")
+    y2 = quantized_matmul(x, leaf["q"], leaf["scale"], impl="xla")
+    assert y3.shape == (2, 12, 96)
+    np.testing.assert_array_equal(np.asarray(y3.reshape(24, 96)),
+                                  np.asarray(y2))
+
+
+def test_token_padding_in_pallas_path(operands):
+    """t=5 is no multiple of any block: the kernel pads up and trims —
+    values still exactly match the reference."""
+    x, w = operands
+    leaf = _quantized(w)
+    y_ref = quantized_matmul(x[:5], leaf["q"], leaf["scale"], impl="xla")
+    y_ker = quantized_matmul(x[:5], leaf["q"], leaf["scale"],
+                             impl="pallas", interpret=True)
+    np.testing.assert_array_equal(np.asarray(y_ker), np.asarray(y_ref))
+
+
+def test_shape_mismatch_raises(operands):
+    x, w = operands
+    leaf = _quantized(w)
+    with pytest.raises(ValueError, match="contraction dim"):
+        quantized_matmul(x[:, :32], leaf["q"], leaf["scale"], impl="xla")
+    leaf4 = _quantized(w, "int4")
+    with pytest.raises(ValueError, match="int4-packed"):
+        quantized_matmul(x[:, :32], leaf4["q"], leaf4["scale"], impl="xla")
+
+
+def test_impl_validation():
+    with pytest.raises(ValueError, match="impl"):
+        quantized_matmul(jnp.zeros((4, 8)), jnp.zeros((8, 8), jnp.int8),
+                         jnp.ones((8,)), impl="cuda")
+
+
+def test_dequantize_weight_rank_mismatch_raises():
+    q = jnp.zeros((4, 8, 8), jnp.int8)
+    with pytest.raises(ValueError, match="scale rank"):
+        dequantize_weight(q, jnp.ones((8,)))

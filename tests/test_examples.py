@@ -42,6 +42,8 @@ CASES = [
     ("elastic_training_demo.py", ["--fake-devices", "8", "--tp", "2",
                                   "--dp", "4", "--out-dir",
                                   "/tmp/pipegoose_elastic_demo_test"]),
+    ("quantized_serving_demo.py", ["--fake-devices", "8", "--tp", "2",
+                                   "--requests", "4"]),
 ]
 
 
