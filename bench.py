@@ -499,6 +499,10 @@ def run_bench(force_cpu: bool) -> None:
                              suffix_lens=(8, 16, 24), max_new=16,
                              num_slots=4, num_pages=65, page_size=32,
                              max_context=256, prefill_chunk=64)
+            cp_kw = dict(n_requests=16, n_prefixes=4, prefix_len=96,
+                         suffix_lens=(8, 16), max_new=8, n_tenants=3,
+                         n_replicas=2, num_slots=1, num_pages=65,
+                         page_size=32, max_context=192)
         else:
             scfg = bloom.BloomConfig(
                 vocab_size=512, hidden_size=128, n_layer=2, n_head=4,
@@ -511,6 +515,10 @@ def run_bench(force_cpu: bool) -> None:
                              suffix_lens=(2, 4, 6), max_new=4,
                              num_slots=2, num_pages=33, page_size=8,
                              max_context=64, prefill_chunk=16)
+            cp_kw = dict(n_requests=12, n_prefixes=4, prefix_len=48,
+                         suffix_lens=(2, 4), max_new=2, n_tenants=3,
+                         n_replicas=2, num_slots=1, num_pages=41,
+                         page_size=8, max_context=64)
         sparams = bloom.init_params(scfg, jax.random.PRNGKey(1))
         # request-trace artifact (BENCH_REQTRACE_JSON, default
         # bench_request_trace.json; empty disables): one EXTRA traced
@@ -532,6 +540,18 @@ def run_bench(force_cpu: bool) -> None:
             res["prefix_replay"] = prefix_replay_benchmark(
                 sparams, scfg, seed=0, include_speculative=True,
                 include_quant=True, trace=bool(reqtrace_path), **replay_kw,
+            )
+            # multi-replica control plane (ISSUE 12): the same
+            # multi-tenant Zipf trace through 2 replicas at each
+            # routing arm — cache-aware vs round-robin on forwarded
+            # prefill tokens + TTFT, plus the scale-down drain's
+            # zero-drop verdict
+            from pipegoose_tpu.serving.control_plane import (
+                control_plane_replay_benchmark,
+            )
+
+            res["control_plane"] = control_plane_replay_benchmark(
+                sparams, scfg, seed=0, **cp_kw,
             )
         finally:
             if was_enabled:

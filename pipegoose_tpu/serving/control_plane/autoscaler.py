@@ -1,0 +1,111 @@
+"""SLO-driven elasticity: burn rate in, capacity decisions out.
+
+The per-replica ``SLOMonitor`` (telemetry/slo.py) answers "is THIS
+engine burning its error budget"; the autoscaler consumes the
+FLEET-level verdict — the same targets evaluated over the merged
+per-replica metrics (telemetry/fleet.py), so one overloaded replica
+among idle peers reads as a routing problem, not a capacity one —
+and turns sustained burn into ``scale up`` and sustained calm into
+``scale down``:
+
+- **up** when any target's fast-window burn >= ``scale_up_burn``
+  (breach-grade pressure) and the fleet is below ``max_replicas``.
+- **down** when every target's fast-window burn <= ``scale_down_burn``,
+  there is no ingress backlog, and the fleet is above ``min_replicas``.
+  The control plane then DRAINS one replica (replica.py): routing
+  stops, in-flight work migrates, zero admitted requests drop.
+- ``cooldown_ticks`` of hysteresis between actions, because a scale-up
+  that immediately re-triggers on its own compile warm-up (or a drain
+  that flaps back) is worse than no autoscaler at all.
+
+Pull-driven like the monitor itself: the control plane calls
+:meth:`decide` once per tick; nothing here owns a thread.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional
+
+
+@dataclass(frozen=True)
+class AutoscalerConfig:
+    min_replicas: int = 1
+    max_replicas: int = 4
+    scale_up_burn: float = 2.0     # fast burn >= this on ANY target -> up
+    scale_down_burn: float = 0.5   # fast burn <= this on ALL targets -> down
+    cooldown_ticks: int = 50
+
+    def __post_init__(self):
+        if not 1 <= self.min_replicas <= self.max_replicas:
+            raise ValueError(
+                f"need 1 <= min_replicas ({self.min_replicas}) <= "
+                f"max_replicas ({self.max_replicas})"
+            )
+        if self.scale_down_burn >= self.scale_up_burn:
+            raise ValueError(
+                f"scale_down_burn ({self.scale_down_burn}) must be < "
+                f"scale_up_burn ({self.scale_up_burn}) — equal thresholds "
+                f"flap"
+            )
+        if self.cooldown_ticks < 0:
+            raise ValueError("cooldown_ticks must be >= 0")
+
+
+class Autoscaler:
+    """Evaluate the fleet SLO monitor and emit up/down/None decisions
+    (module docstring). ``monitor`` is an ``SLOMonitor`` over the
+    fleet-merged registry; the decision log is the ``/debug/fleet``
+    audit trail."""
+
+    def __init__(self, monitor: Any,
+                 config: Optional[AutoscalerConfig] = None):
+        self.monitor = monitor
+        self.config = config or AutoscalerConfig()
+        self.log: List[Dict[str, Any]] = []
+        self._last_action_tick: Optional[int] = None
+
+    def decide(self, tick: int, n_serving: int, backlog: int,
+               now: Optional[float] = None) -> Optional[str]:
+        """One evaluation: returns "up", "down", or None. ``n_serving``
+        counts SERVING replicas (draining ones are already leaving),
+        ``backlog`` the control plane's undispatched ingress — scaling
+        down while requests queue would immediately re-breach."""
+        cfg = self.config
+        if (self._last_action_tick is not None
+                and tick < self._last_action_tick):
+            # the tick counter restarted (a new plane.run): a stale
+            # marker from the previous run would make the delta
+            # negative and suppress decisions far past the configured
+            # hysteresis
+            self._last_action_tick = None
+        if (self._last_action_tick is not None
+                and tick - self._last_action_tick < cfg.cooldown_ticks):
+            return None
+        status = self.monitor.evaluate(now)
+        burns = {name: t.get("burn_fast", 0.0)
+                 for name, t in status.get("targets", {}).items()}
+        decision = None
+        reason = ""
+        if burns and max(burns.values()) >= cfg.scale_up_burn:
+            if n_serving < cfg.max_replicas:
+                hot = max(burns, key=burns.get)
+                decision = "up"
+                reason = (f"target {hot!r} burning {burns[hot]:.2f}x >= "
+                          f"{cfg.scale_up_burn}x")
+            # at max: nothing to add — shedding stays the pressure valve
+        elif (burns and backlog == 0 and n_serving > cfg.min_replicas
+                and max(burns.values()) <= cfg.scale_down_burn):
+            decision = "down"
+            reason = (f"all burns <= {cfg.scale_down_burn}x and no "
+                      f"backlog")
+        if decision is not None:
+            self._last_action_tick = tick
+            self.log.append({
+                "tick": tick,
+                "decision": decision,
+                "reason": reason,
+                "burns": burns,
+                "n_serving": n_serving,
+                "backlog": backlog,
+            })
+        return decision

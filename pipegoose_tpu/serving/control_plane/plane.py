@@ -1,0 +1,437 @@
+"""ControlPlane: the front door over N serving-engine replicas.
+
+Drives the replicas' steppable-run API (``start_run`` / ``tick_once``
+/ ``take_finished`` / ``finish_run``) in one host thread:
+
+    while work remains:
+        autoscale            (fleet SLO burn -> add replica / drain one)
+        shed expired ingress (tenant-queue deadline valve)
+        dispatch             (ledger DRR batch -> router placement ->
+                              replica.submit_request; migrated-out
+                              requests re-place FIRST — they already
+                              paid admission once)
+        tick every busy replica  (each advances prefills + one decode
+                                  step, exactly like a lone engine)
+        collect finished     (per-tenant TTFT/e2e observation,
+                              completion bookkeeping)
+        progress drains      (DRAINING replica empties -> STOPPED,
+                              metrics captured)
+
+Placement is strictly read-only against the replicas (``can_admit``,
+``capacity_snapshot``, ``longest_prefix_len``); the only cross-replica
+state is the control plane's own (ledger queues, router log, fleet
+registry). Determinism: same requests + same replica factory + same
+tick schedule => same placements, same tokens (greedy parity is
+per-engine; routing is lexicographic over deterministic scores).
+
+Every replica gets its OWN ``MetricsRegistry``; ``fleet`` is the
+merged view (telemetry/fleet.py) the fleet ``SLOMonitor`` and
+``/debug/fleet`` read. Per-tenant TTFT/e2e land in the control plane's
+registry as ``serving.tenant.<name>.*`` — ``per_tenant_slo_targets``
+builds one SLO target per tenant over them.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from pipegoose_tpu.serving.control_plane.autoscaler import Autoscaler
+from pipegoose_tpu.serving.control_plane.replica import (
+    Replica,
+    ReplicaState,
+)
+from pipegoose_tpu.serving.control_plane.router import Router
+from pipegoose_tpu.serving.control_plane.tenants import TenantLedger
+from pipegoose_tpu.serving.engine import RequestOutput
+from pipegoose_tpu.serving.scheduler import Request
+from pipegoose_tpu.telemetry.fleet import FleetRegistry
+from pipegoose_tpu.telemetry.registry import MetricsRegistry
+from pipegoose_tpu.telemetry.slo import SLOTarget
+
+
+def per_tenant_slo_targets(
+    tenants: Sequence[str], *,
+    ttft_objective_s: float = 0.5, ttft_p: float = 0.95,
+) -> List[SLOTarget]:
+    """One TTFT latency target per tenant over the control plane's
+    ``serving.tenant.<name>.ttft_seconds`` histograms — the per-tenant
+    half of the fleet verdict (a single hot tenant breaching ITS
+    target while the fleet aggregate looks fine is a fairness page,
+    not a capacity one)."""
+    return [
+        SLOTarget(name=f"tenant_{t}_ttft",
+                  metric=f"serving.tenant.{t}.ttft_seconds",
+                  objective=ttft_objective_s, target=ttft_p)
+        for t in tenants
+    ]
+
+
+class ControlPlane:
+    """Front door over N replicas (module docstring).
+
+    ``replica_factory(name, registry) -> ServingEngine`` builds one
+    replica engine wired to ITS registry; engines must enable the
+    paged prefill path (``prefix_cache=True`` and/or
+    ``prefill_chunk=``) — drain migration re-admits requests that
+    already hold generated tokens, which the monolithic prefill cannot
+    resume. ``policy`` is the routing arm ("cache_aware" |
+    "round_robin"). ``autoscaler`` (optional) consumes the fleet SLO
+    monitor; without one, :meth:`scale_up` / :meth:`start_drain` are
+    the operator's manual controls (and the bench/test seam).
+    """
+
+    def __init__(self, replica_factory: Callable[[str, MetricsRegistry], Any],
+                 *, n_replicas: int = 2, policy: str = "cache_aware",
+                 ledger: Optional[TenantLedger] = None,
+                 autoscaler: Optional[Autoscaler] = None,
+                 registry: Optional[MetricsRegistry] = None,
+                 stall_patience: int = 200,
+                 affinity_slack_tokens: int = 192):
+        if n_replicas < 1:
+            raise ValueError(f"n_replicas must be >= 1, got {n_replicas}")
+        if stall_patience < 1:
+            raise ValueError(
+                f"stall_patience must be >= 1, got {stall_patience}"
+            )
+        self.replica_factory = replica_factory
+        self.registry = (registry if registry is not None
+                         else MetricsRegistry(enabled=True))
+        self.router = Router(policy, registry=self.registry,
+                             affinity_slack_tokens=affinity_slack_tokens)
+        self.ledger = ledger if ledger is not None else TenantLedger()
+        self.autoscaler = autoscaler
+        self.stall_patience = stall_patience
+        self.fleet = FleetRegistry([("control_plane", self.registry)])
+        self.replicas: List[Replica] = []
+        self._next_replica = 0
+        self._now: Callable[[], float] = time.perf_counter
+        self._running = False
+        self._started: List[Replica] = []    # replicas active this run
+        self._migrated: List[Request] = []   # drain re-placement queue
+        self._seq = 0                        # control-plane dispatch ids
+        self._order: Dict[int, int] = {}     # id(req) -> submit order
+        self._outputs: Dict[int, RequestOutput] = {}  # submit order -> out
+        reg = self.registry
+        self._m_replicas = reg.gauge("control_plane.replicas_serving")
+        self._m_dispatched = reg.counter("control_plane.dispatched_total")
+        self._m_migrated = reg.counter("control_plane.migrated_total")
+        self._m_drains = reg.counter("control_plane.drains_total")
+        self._m_scaleups = reg.counter("control_plane.scaleups_total")
+        self._m_shed = reg.counter("control_plane.shed_total")
+        for _ in range(n_replicas):
+            self._add_replica()
+
+    # -- replica lifecycle -------------------------------------------------
+
+    def _add_replica(self) -> Replica:
+        name = f"replica{self._next_replica}"
+        self._next_replica += 1
+        reg = MetricsRegistry(enabled=True)
+        engine = self.replica_factory(name, reg)
+        if not getattr(engine, "_paged_prefill", False):
+            raise ValueError(
+                f"replica {name!r}: control-plane engines need the paged "
+                f"prefill path (prefix_cache=True and/or prefill_chunk=) — "
+                f"drain migration re-admits requests holding generated "
+                f"tokens, which monolithic prefill cannot resume"
+            )
+        rep = Replica(name, engine, registry=reg, index=self._next_replica - 1)
+        self.replicas.append(rep)
+        self.fleet.add_member(name, reg)
+        if self._running:
+            engine.start_run((), now=self._now)
+            self._started.append(rep)
+        self._m_replicas.set(float(len(self.serving_replicas())))
+        return rep
+
+    def serving_replicas(self) -> List[Replica]:
+        return [r for r in self.replicas
+                if r.state is ReplicaState.SERVING]
+
+    def scale_up(self) -> Replica:
+        """Add one replica (autoscaler "up", or the operator). The new
+        engine compiles its programs on first use — on real fleets the
+        factory hands back a pre-warmed engine."""
+        rep = self._add_replica()
+        self._m_scaleups.inc()
+        return rep
+
+    def start_drain(self, name: Optional[str] = None) -> Replica:
+        """Begin draining one replica (autoscaler "down", or the
+        operator): routing stops immediately, its requests migrate to
+        the re-placement queue (dispatched ahead of fresh ingress next
+        tick), and the replica stops once empty. Defaults to the
+        SERVING replica with the least work owed — the cheapest
+        drain."""
+        serving = self.serving_replicas()
+        if len(serving) <= 1:
+            raise ValueError("cannot drain the last serving replica")
+        if name is None:
+            def owed(rep: Replica) -> Tuple[int, int]:
+                snap = rep.engine.sched.capacity_snapshot()
+                return (snap["queued_tokens"]
+                        + snap["active_tokens_remaining"], rep.index)
+            rep = min(serving, key=owed)
+        else:
+            match = [r for r in serving if r.name == name]
+            if not match:
+                raise ValueError(f"no serving replica named {name!r}")
+            rep = match[0]
+        migrated = rep.start_drain()
+        self.router.drop_replica(rep.name)
+        self._migrated.extend(migrated)
+        self._m_migrated.inc(len(migrated))
+        self._m_drains.inc()
+        self._m_replicas.set(float(len(self.serving_replicas())))
+        return rep
+
+    def clear_prefix_caches(self) -> None:
+        """Drop every live replica's unpinned cached pages — the bench
+        and test seam for measuring a COLD-cache trace on warm-compiled
+        engines (routing decides the hit rate only while caches are
+        filling; a fully warmed fleet hits everywhere under any
+        policy)."""
+        for rep in self.replicas:
+            if (rep.state is not ReplicaState.STOPPED
+                    and rep.engine.prefix_cache is not None):
+                rep.engine.prefix_cache.clear()
+        self.router.clear_shadows()
+
+    # -- ingress -----------------------------------------------------------
+
+    def submit(self, req: Request, now: float) -> None:
+        """Accept one request into the tenant ledger. The control plane
+        stamps the submit time (``Scheduler.submit`` preserves it — the
+        user-visible clock starts HERE, not at replica dispatch)."""
+        if req.t_submit is None:
+            req.t_submit = now
+        self._order[id(req)] = len(self._order)
+        self.ledger.submit(req)
+
+    # -- the loop ----------------------------------------------------------
+
+    def _dispatch(self, now: float) -> int:
+        """Place migrated requests first, then one DRR batch of fresh
+        ingress. A request no replica can admit right now goes back
+        where it came from and retries next tick."""
+        placed = 0
+        still: List[Request] = []
+        for req in self._migrated:
+            rep = self.router.route(req, self.replicas, now, seq=self._seq)
+            if rep is None:
+                still.append(req)
+                continue
+            self._seq += 1
+            rep.engine.submit_request(req)
+            placed += 1
+        self._migrated = still
+        if self._migrated:
+            return placed   # re-placement backlog first, fresh traffic waits
+        free_slots = sum(
+            rep.engine.sched.capacity_snapshot()["free_slots"]
+            for rep in self.serving_replicas()
+        )
+        if free_slots < 1:
+            return placed
+        batch = self.ledger.next_batch(free_slots)
+        for i, req in enumerate(batch):
+            rep = self.router.route(req, self.replicas, now, seq=self._seq)
+            if rep is None:
+                # requeue the WHOLE unplaced tail, not just the failed
+                # head — every batch member was already popped from its
+                # tenant FIFO, so dropping one here would silently lose
+                # the request (reversed: requeue_front prepends, so the
+                # original FIFO order survives)
+                for r in reversed(batch[i:]):
+                    self.ledger.requeue_front(r)
+                break
+            self._seq += 1
+            rep.engine.submit_request(req)
+            self._m_dispatched.inc()
+            placed += 1
+        return placed
+
+    def _seq_for(self, req: Request) -> int:
+        """Submit-order index for ``req`` — tolerant of carryovers: a
+        request stranded by an ABORTED previous run (still queued on a
+        replica or in the ledger) drains during the next run and gets
+        appended past that run's own submit order instead of KeyError-
+        ing the bookkeeping."""
+        seq = self._order.get(id(req))
+        if seq is None:
+            seq = len(self._order)
+            self._order[id(req)] = seq
+        return seq
+
+    def _observe_finished(self, req: Request, out: RequestOutput) -> None:
+        reg = self.registry
+        tenant = req.tenant or "default"
+        self.ledger.record_done(req)
+        reg.counter(f"serving.tenant.{tenant}.requests_total").inc()
+        if out.finish_reason == "shed":
+            reg.counter(f"serving.tenant.{tenant}.shed_total").inc()
+        if out.ttft_s is not None:
+            reg.histogram(f"serving.tenant.{tenant}.ttft_seconds").observe(
+                out.ttft_s
+            )
+        if out.finish_reason != "shed":
+            reg.histogram(
+                f"serving.tenant.{tenant}.e2e_latency_seconds"
+            ).observe(out.e2e_latency_s)
+        self._outputs[self._seq_for(req)] = out
+
+    def _shed_expired(self, now: float) -> None:
+        for req in self.ledger.shed_expired(now):
+            self._m_shed.inc()
+            tenant = req.tenant or "default"
+            self.registry.counter(
+                f"serving.tenant.{tenant}.requests_total").inc()
+            self.registry.counter(
+                f"serving.tenant.{tenant}.shed_total").inc()
+            e2e = req.t_done - req.t_submit
+            seq = self._seq_for(req)
+            # ledger-shed requests never reached a scheduler, so they
+            # have no replica uid — a UNIQUE negative sentinel keeps
+            # the uid-keyed conventions of engine outputs intact
+            self._outputs[seq] = RequestOutput(
+                uid=-(seq + 1), prompt=np.asarray(req.prompt),
+                generated=np.asarray(req.generated, np.int64),
+                finish_reason="shed", queue_latency_s=e2e, ttft_s=None,
+                decode_tokens_per_s=None, e2e_latency_s=e2e,
+                tenant=req.tenant,
+            )
+
+    def _autoscale(self, tick: int, now: float) -> None:
+        if self.autoscaler is None:
+            return
+        decision = self.autoscaler.decide(
+            tick, len(self.serving_replicas()),
+            # a prior drain's unplaced refugees count as backlog too:
+            # draining ANOTHER replica while they wait is exactly the
+            # churn the backlog guard exists to prevent
+            self.ledger.pending() + len(self._migrated),
+            now=now,
+        )
+        if decision == "up":
+            self.scale_up()
+        elif decision == "down" and len(self.serving_replicas()) > 1:
+            self.start_drain()
+
+    def _busy(self) -> bool:
+        return (bool(self._migrated) or self.ledger.pending() > 0
+                or any(rep.busy for rep in self.replicas))
+
+    def run(self, requests: Sequence[Request], now=time.perf_counter,
+            tick_hook=None):
+        """Serve ``requests`` across the fleet to completion; returns
+        (outputs in submit order, fleet-metrics dict).
+        ``tick_hook(plane, tick)`` is the orchestration seam (tests and
+        benches force drains/scale-ups mid-run through it)."""
+        if self._running:
+            raise RuntimeError("control plane is already running")
+        self._now = now
+        self._running = True
+        self._outputs = {}
+        self._order = {}
+        self._migrated = []
+        t0 = now()
+        try:
+            self._started = [rep for rep in self.replicas
+                             if rep.state is not ReplicaState.STOPPED]
+            for rep in self._started:
+                rep.engine.start_run((), now=now)
+            for req in requests:
+                self.submit(req, now())
+            tick = 0
+            idle_ticks = 0
+            while self._busy():
+                tick += 1
+                if tick_hook is not None:
+                    tick_hook(self, tick)
+                self._autoscale(tick, now())
+                self._shed_expired(now())
+                placed = self._dispatch(now())
+                progressed = placed > 0
+                for rep in self.replicas:
+                    if rep.state is ReplicaState.STOPPED:
+                        continue
+                    eng = rep.engine
+                    if not eng.sched.all_done():
+                        progressed = eng.tick_once() or progressed
+                    for req, out in eng.take_finished():
+                        self._observe_finished(req, out)
+                        progressed = True
+                    rep.maybe_stop()
+                if progressed:
+                    idle_ticks = 0
+                else:
+                    idle_ticks += 1
+                    if idle_ticks >= self.stall_patience:
+                        raise RuntimeError(
+                            f"control-plane stall: {self.ledger.pending()} "
+                            f"queued + {len(self._migrated)} migrated "
+                            f"requests, no replica made progress for "
+                            f"{self.stall_patience} ticks"
+                        )
+            per_replica: Dict[str, dict] = {}
+            for rep in self._started:
+                if rep.engine.run_in_progress:
+                    # drain any completion the last tick left behind
+                    # before closing the run
+                    for req, out in rep.engine.take_finished():
+                        self._observe_finished(req, out)
+                    _, metrics = rep.engine.finish_run()
+                    per_replica[rep.name] = metrics
+                elif rep.final_metrics is not None:
+                    per_replica[rep.name] = rep.final_metrics
+        except BaseException:
+            # the stall watchdog (or a raising tick_hook) must not
+            # wedge the fleet: abort every replica's steppable run so
+            # a retry can start_run again
+            for rep in self._started:
+                rep.engine.abort_run()
+            raise
+        finally:
+            self._running = False
+        wall = max(now() - t0, 1e-9)
+        outputs = [self._outputs[i] for i in sorted(self._outputs)]
+        generated = sum(len(o.generated) for o in outputs)
+        metrics = {
+            "wall_time_s": round(wall, 6),
+            "requests": len(outputs),
+            "generated_tokens": generated,
+            "decode_tokens_per_s": round(generated / wall, 2),
+            # the fleet FLOP meter: prompt tokens actually forwarded
+            # through any replica's prefill — cache-aware routing's
+            # acceptance metric (fewer forwarded tokens, same output)
+            "prefill_tokens": sum(
+                m.get("prefill_tokens", 0) for m in per_replica.values()
+            ),
+            "shed_requests": sum(
+                1 for o in outputs if o.finish_reason == "shed"
+            ),
+            "per_replica": per_replica,
+            "router": self.router.stats(),
+            "tenants": self.ledger.stats(),
+        }
+        if self.autoscaler is not None:
+            metrics["autoscaler"] = list(self.autoscaler.log)
+        return outputs, metrics
+
+    # -- observability -----------------------------------------------------
+
+    def fleet_status(self) -> Dict[str, Any]:
+        """The ``/debug/fleet`` payload: per-replica state + load,
+        router stats, per-tenant ledger shares, autoscaler audit log —
+        everything JSON-able, snapshot-style."""
+        return {
+            "replicas": [rep.status() for rep in self.replicas],
+            "serving": len(self.serving_replicas()),
+            "router": self.router.stats(),
+            "tenants": self.ledger.stats(),
+            "migrated_pending": len(self._migrated),
+            "autoscaler": (list(self.autoscaler.log)
+                           if self.autoscaler is not None else None),
+        }

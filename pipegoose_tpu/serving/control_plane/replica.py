@@ -1,0 +1,111 @@
+"""Replica lifecycle: one ServingEngine behind the router.
+
+A replica is an independently meshed engine — its own scheduler, page
+pool, and prefix cache — wrapped with the state machine the router and
+autoscaler act on:
+
+    SERVING ──start_drain──> DRAINING ──(emptied)──> STOPPED
+
+- **SERVING** accepts routed requests and ticks every control-plane
+  iteration.
+- **DRAINING** stops accepting. The control plane immediately preempts
+  its in-flight requests (pages released, shared prefix pages survive
+  in the cache) and withdraws its queue; the migrated requests re-admit
+  elsewhere through the normal re-prefill path — which HITS the target
+  replica's cache for any shared prefix — so scale-down drops zero
+  admitted work. The replica still ticks until its scheduler empties.
+- **STOPPED** is terminal: the engine's run is finished and its
+  aggregate metrics captured in ``final_metrics``.
+
+This module is the structural seam ROADMAP item 2 (disaggregated
+prefill/decode pools) will hang from: a pool is a set of replicas with
+a role tag, and cross-mesh KV streaming replaces the re-prefill
+migration path.
+"""
+from __future__ import annotations
+
+import enum
+from typing import Any, Dict, List, Optional
+
+
+class ReplicaState(enum.Enum):
+    SERVING = "serving"
+    DRAINING = "draining"
+    STOPPED = "stopped"
+
+
+class Replica:
+    """One engine + lifecycle state + per-replica bookkeeping. The
+    ``registry`` is the replica's OWN metrics registry (fleet-level
+    views merge them — telemetry/fleet.py); ``index`` is the stable
+    routing tie-break."""
+
+    def __init__(self, name: str, engine: Any, *, registry: Any = None,
+                 index: int = 0):
+        self.name = name
+        self.engine = engine
+        self.registry = registry
+        self.index = index
+        self.state = ReplicaState.SERVING
+        self.dispatched = 0            # requests routed here, lifetime
+        self.migrated_out = 0          # requests drained away
+        self.final_metrics: Optional[dict] = None
+
+    @property
+    def accepting(self) -> bool:
+        return self.state is ReplicaState.SERVING
+
+    @property
+    def busy(self) -> bool:
+        return (self.state is not ReplicaState.STOPPED
+                and self.engine.run_in_progress
+                and not self.engine.sched.all_done())
+
+    def start_drain(self) -> List[Any]:
+        """Flip to DRAINING and give up every request: active ones are
+        preempted (the scheduler requeues them with pages released),
+        then the whole queue is withdrawn. Returns the migrated
+        requests — each still carries its generated tokens and its
+        original submit/admit timestamps, so re-admission elsewhere
+        resumes the exact greedy stream (token-identity pinned)."""
+        if self.state is not ReplicaState.SERVING:
+            raise ValueError(
+                f"replica {self.name!r} is {self.state.value}, not serving"
+            )
+        self.state = ReplicaState.DRAINING
+        sched = self.engine.sched
+        for req in list(sched.active()):
+            sched.preempt(req)
+        migrated = [sched.withdraw(req) for req in list(sched.queue)]
+        self.migrated_out += len(migrated)
+        return migrated
+
+    def maybe_stop(self) -> bool:
+        """DRAINING -> STOPPED once the scheduler is empty; closes the
+        engine's run and captures its aggregate metrics."""
+        if self.state is not ReplicaState.DRAINING:
+            return False
+        if not self.engine.sched.all_done():
+            return False
+        if self.engine.run_in_progress:
+            _, self.final_metrics = self.engine.finish_run()
+        self.state = ReplicaState.STOPPED
+        return True
+
+    def status(self) -> Dict[str, Any]:
+        """JSON-able row for ``/debug/fleet``."""
+        cache = self.engine.prefix_cache
+        out: Dict[str, Any] = {
+            "name": self.name,
+            "state": self.state.value,
+            "dispatched": self.dispatched,
+            "migrated_out": self.migrated_out,
+        }
+        if self.state is not ReplicaState.STOPPED:
+            out["load"] = self.engine.sched.capacity_snapshot()
+            if cache is not None:
+                out["cache"] = {
+                    "cached_pages": cache.cached_pages,
+                    "evictable_pages": cache.evictable_count(),
+                }
+        return out

@@ -1,0 +1,231 @@
+"""Cache-aware request placement over serving replicas.
+
+Each replica's radix prefix cache is an independent store; without
+placement awareness, a request whose prefix is hot on replica A lands
+on replica B by round-robin luck and pays a full prefill. The router
+turns hit rate into a decision:
+
+- **cache_aware** (default): probe every accepting replica that can
+  admit the request (``Scheduler.can_admit`` — the side-effect-free
+  admission ledger) with the prefix cache's read-only
+  ``longest_prefix_len`` and pick the replica holding the LONGEST
+  cached prefix of the request's tokens. Ties break by load — fewest
+  queued + in-flight tokens owed, then most free + evictable pages,
+  then the stable replica index (determinism). The probe is a shadow
+  read of each replica's published prefixes: nothing is pinned, no LRU
+  clock moves, so probing N replicas costs N trie walks and perturbs
+  none of them.
+- **round_robin**: rotate over admitting replicas — the baseline arm
+  every bench compares against.
+
+Every decision lands in a bounded log (the ``/debug/fleet`` forensics
+and the Perfetto router track — ``telemetry.chrometrace.
+router_trace_events``) plus ``router.*`` counters.
+"""
+from __future__ import annotations
+
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+from pipegoose_tpu.serving.control_plane.replica import Replica
+from pipegoose_tpu.telemetry.registry import get_registry
+
+POLICIES = ("cache_aware", "round_robin")
+
+
+class ShadowIndex:
+    """Router-side radix over the prompts ROUTED to one replica — the
+    shadow of that replica's prefix cache, block-granular (one node per
+    ``page_size`` token block, same keying as the real trie).
+
+    Fed by placements, not only by published pages: the real cache
+    publishes a prefix only when its prefill completes, so during a
+    bursty cold start every probe reads 0 and same-prefix requests
+    scatter by the load tie-break — each replica then pays its own cold
+    prefill for the same prefix. Recording the placement OPTIMISTICALLY
+    (the routed prompt's pages WILL be published a few ticks later)
+    keeps the second occurrence of a prefix behind the first one's
+    replica, which is the whole point of cache-aware routing. The
+    read-only ``longest_prefix_len`` probe of the real cache remains
+    the ground truth the router maxes this against — a shadow that
+    over-claims after an eviction costs one suboptimal placement, never
+    correctness (admission re-checks everything).
+
+    Bounded: past ``max_blocks`` nodes the shadow resets empty and
+    rebuilds from subsequent placements + probes (coarse, self-healing,
+    and O(1) — a per-chain LRU would cost more than the misroutes it
+    prevents at this size)."""
+
+    __slots__ = ("page_size", "max_blocks", "_root", "_blocks")
+
+    def __init__(self, page_size: int, max_blocks: int = 4096):
+        self.page_size = int(page_size)
+        self.max_blocks = int(max_blocks)
+        self._root: Dict[tuple, dict] = {}
+        self._blocks = 0
+
+    def insert(self, tokens) -> None:
+        ps = self.page_size
+        toks = [int(t) for t in tokens]
+        children = self._root
+        for i in range(len(toks) // ps):
+            blk = tuple(toks[i * ps:(i + 1) * ps])
+            node = children.get(blk)
+            if node is None:
+                if self._blocks >= self.max_blocks:
+                    self.clear()
+                    return
+                node = {}
+                children[blk] = node
+                self._blocks += 1
+            children = node
+
+    def longest_match(self, tokens) -> int:
+        """Matched tokens, page-granular (the shadow has no COW-head
+        notion — the probe of the real cache supplies that
+        refinement)."""
+        ps = self.page_size
+        toks = [int(t) for t in tokens]
+        children = self._root
+        i = 0
+        while (i + 1) * ps <= len(toks):
+            node = children.get(tuple(toks[i * ps:(i + 1) * ps]))
+            if node is None:
+                break
+            children = node
+            i += 1
+        return i * ps
+
+    def clear(self) -> None:
+        self._root = {}
+        self._blocks = 0
+
+
+class Router:
+    def __init__(self, policy: str = "cache_aware", *, registry=None,
+                 max_decisions: int = 512,
+                 affinity_slack_tokens: int = 192):
+        if policy not in POLICIES:
+            raise ValueError(
+                f"unknown routing policy {policy!r} (expected one of "
+                f"{POLICIES})"
+            )
+        if affinity_slack_tokens < 0:
+            raise ValueError(
+                f"affinity_slack_tokens must be >= 0, got "
+                f"{affinity_slack_tokens}"
+            )
+        self.policy = policy
+        self.affinity_slack_tokens = int(affinity_slack_tokens)
+        self.registry = registry if registry is not None else get_registry()
+        self.decisions: deque = deque(maxlen=max_decisions)
+        self._rr_next = 0
+        self._shadows: Dict[str, ShadowIndex] = {}  # replica name -> shadow
+        reg = self.registry
+        self._m_decisions = reg.counter("router.decisions_total")
+        self._m_cache_routed = reg.counter(
+            "router.cache_routed_total",
+            help="decisions where a nonzero cached prefix chose the replica",
+        )
+        self._m_matched = reg.counter(
+            "router.matched_tokens_total",
+            help="prefix tokens already cached on the chosen replica",
+        )
+        self._m_unplaceable = reg.counter(
+            "router.unplaceable_total",
+            help="route() calls where no replica could admit",
+        )
+
+    def route(self, req: Any, replicas: List[Replica],
+              now: float, seq: Optional[int] = None) -> Optional[Replica]:
+        """Pick the replica for ``req`` among ``replicas`` (None when
+        no accepting replica can admit it right now — the dispatcher
+        requeues and retries next tick). Pure reads: the only mutation
+        anywhere is the router's own decision log/counters."""
+        cands = [rep for rep in replicas
+                 if rep.accepting and rep.engine.sched.can_admit(req)]
+        if not cands:
+            self._m_unplaceable.inc()
+            return None
+        matched = 0
+        if self.policy == "round_robin":
+            chosen = cands[self._rr_next % len(cands)]
+            self._rr_next += 1
+        else:
+            tokens = req.tokens   # prompt + generated: a migrated
+            # request probes with everything its re-prefill will walk,
+            # so the replica that cached its prefix pre-drain wins
+            scored = []
+            for rep in cands:
+                cache = rep.engine.prefix_cache
+                m = (cache.longest_prefix_len(tokens)
+                     if cache is not None else 0)
+                shadow = self._shadows.get(rep.name)
+                if shadow is not None:
+                    # max(published, placed): the shadow covers the
+                    # publication lag, the probe is the ground truth
+                    m = max(m, shadow.longest_match(tokens))
+                snap = rep.engine.sched.capacity_snapshot()
+                load = (snap["queued_tokens"]
+                        + snap["active_tokens_remaining"])
+                headroom = snap["free_pages"] + snap["evictable_pages"]
+                scored.append((-m, load, -headroom, rep.index, rep))
+            # affinity with an imbalance guard: rank by longest match
+            # (ties: least owed tokens, most free+evictable pages,
+            # stable index) and take the FIRST candidate whose load
+            # stays within ``affinity_slack_tokens`` of the fleet
+            # minimum. Pure affinity piles a hot prefix onto one
+            # replica while its peers idle (p99 pays the queue); pure
+            # load-balancing scatters the prefix and every replica pays
+            # its own cold prefill. The guard bounds the pile-up to a
+            # fixed token debt, and a spill warms the spill target's
+            # cache, so the cost is one cold prefill per guard trip.
+            scored.sort(key=lambda s: s[:4])
+            min_load = min(s[1] for s in scored)
+            chosen = next(
+                s for s in scored
+                if s[1] <= min_load + self.affinity_slack_tokens
+            )
+            matched = -chosen[0]
+            chosen = chosen[4]
+            shadow = self._shadows.get(chosen.name)
+            if shadow is None:
+                shadow = ShadowIndex(chosen.engine.page_size)
+                self._shadows[chosen.name] = shadow
+            shadow.insert(tokens)
+        chosen.dispatched += 1
+        self._m_decisions.inc()
+        if matched:
+            self._m_cache_routed.inc()
+            self._m_matched.inc(matched)
+        self.decisions.append({
+            "t": now,
+            "seq": seq,   # control-plane dispatch sequence (uid is
+            # replica-local and not assigned until the target submits)
+            "tenant": req.tenant,
+            "replica": chosen.name,
+            "policy": self.policy,
+            "matched_tokens": matched,
+            "prompt_len": req.prompt_len,
+            "candidates": len(cands),
+        })
+        return chosen
+
+    def drop_replica(self, name: str) -> None:
+        """Forget a drained/stopped replica's shadow (its cache is
+        going away with it)."""
+        self._shadows.pop(name, None)
+
+    def clear_shadows(self) -> None:
+        for shadow in self._shadows.values():
+            shadow.clear()
+
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "policy": self.policy,
+            "decisions_total": self._m_decisions.value,
+            "cache_routed_total": self._m_cache_routed.value,
+            "matched_tokens_total": self._m_matched.value,
+            "unplaceable_total": self._m_unplaceable.value,
+            "recent_decisions": list(self.decisions)[-16:],
+        }

@@ -1,0 +1,273 @@
+"""Per-tenant fair-share dispatch: weighted deficit round robin.
+
+The single-engine scheduler is deliberately FIFO (deterministic
+admission); fairness belongs one layer up, where traffic from many
+tenants meets finite fleet capacity. The ledger holds one ingress FIFO
+per tenant and releases requests to the router in **deficit round
+robin** order (Shreedhar & Varghese): each dispatch round, every
+backlogged tenant's deficit grows by ``quantum_tokens x weight``; a
+tenant may release queued requests while its deficit covers their
+token cost (``prompt_len + max_new_tokens`` — the work the fleet will
+actually spend). A hot tenant flooding the queue therefore gets
+exactly its weighted share of dispatched tokens, never the whole
+fleet, while an idle tenant's deficit resets (no hoarding credit to
+burst later past everyone).
+
+**Priority classes** are strict between classes: all class-0 backlogs
+dispatch before any class-1 request is considered, DRR applies within
+a class. Use sparingly — a saturating class 0 starves the rest by
+design (that is what priority means); the starvation-freedom pin
+applies to tenants of equal class.
+
+**Deadline shedding is the pressure valve** (PR 9): requests carry
+``deadline_s`` from submit, the ledger sheds expired never-dispatched
+requests at each dispatch round exactly like the scheduler sheds
+never-admitted ones at admission — under sustained overload a tenant's
+excess traffic dies in ITS OWN queue instead of crowding the fleet.
+
+Host-side only; no jax, no device state.
+"""
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional
+
+DEFAULT_TENANT = "default"
+
+
+@dataclass(frozen=True)
+class TenantSpec:
+    """One tenant's dispatch contract: ``weight`` scales its DRR
+    quantum (2.0 = twice the fair share of dispatched tokens under
+    contention), ``priority`` its strict class (lower dispatches
+    first)."""
+
+    name: str
+    weight: float = 1.0
+    priority: int = 0
+
+    def __post_init__(self):
+        if self.weight <= 0:
+            raise ValueError(
+                f"tenant {self.name!r}: weight must be > 0, "
+                f"got {self.weight}"
+            )
+        if self.priority < 0:
+            raise ValueError(
+                f"tenant {self.name!r}: priority must be >= 0, "
+                f"got {self.priority}"
+            )
+
+
+class _TenantState:
+    __slots__ = ("spec", "queue", "deficit", "submitted", "dispatched",
+                 "dispatched_tokens", "shed", "done", "done_tokens")
+
+    def __init__(self, spec: TenantSpec):
+        self.spec = spec
+        self.queue: deque = deque()
+        self.deficit = 0.0
+        self.submitted = 0
+        self.dispatched = 0
+        self.dispatched_tokens = 0
+        self.shed = 0
+        self.done = 0
+        self.done_tokens = 0
+
+
+class TenantLedger:
+    """Weighted fair-share ingress queue over tenants (module
+    docstring). Unknown tenants auto-register at ``default_weight`` /
+    ``default_priority`` — production fleets pre-register contracts,
+    tests and benches just submit."""
+
+    def __init__(self, specs: Optional[List[TenantSpec]] = None, *,
+                 quantum_tokens: int = 64, default_weight: float = 1.0,
+                 default_priority: int = 0):
+        if quantum_tokens < 1:
+            raise ValueError(
+                f"quantum_tokens must be >= 1, got {quantum_tokens}"
+            )
+        self.quantum_tokens = int(quantum_tokens)
+        self.default_weight = float(default_weight)
+        self.default_priority = int(default_priority)
+        self._tenants: Dict[str, _TenantState] = {}
+        self._order: List[str] = []       # registration order (stable RR)
+        self._rr_start = 0                # rotating DRR start pointer
+        for spec in specs or []:
+            self.register(spec)
+
+    # -- registration ------------------------------------------------------
+
+    def register(self, spec: TenantSpec) -> None:
+        if spec.name in self._tenants:
+            raise ValueError(f"tenant {spec.name!r} already registered")
+        self._tenants[spec.name] = _TenantState(spec)
+        self._order.append(spec.name)
+
+    def _state(self, tenant: Optional[str]) -> _TenantState:
+        name = tenant if tenant is not None else DEFAULT_TENANT
+        st = self._tenants.get(name)
+        if st is None:
+            st = _TenantState(TenantSpec(
+                name, weight=self.default_weight,
+                priority=self.default_priority,
+            ))
+            self._tenants[name] = st
+            self._order.append(name)
+        return st
+
+    # -- ingress -----------------------------------------------------------
+
+    @staticmethod
+    def cost(req: Any) -> int:
+        """DRR token cost: the work the fleet will spend on the request
+        (whole prompt through prefill + the new-token budget)."""
+        return int(req.prompt_len) + int(req.max_new_tokens)
+
+    def submit(self, req: Any) -> None:
+        st = self._state(req.tenant)
+        st.submitted += 1
+        st.queue.append(req)
+
+    def pending(self) -> int:
+        return sum(len(st.queue) for st in self._tenants.values())
+
+    def shed_expired(self, now: float) -> List[Any]:
+        """Drop queued requests already past their deadline (same
+        contract as ``Scheduler._shed_expired``: never-admitted only —
+        ``t_admit`` marks paid prefill and exempts a migrated
+        request). Returns the shed requests, terminal with
+        ``finish_reason="shed"``, for the control plane to report."""
+        from pipegoose_tpu.serving.scheduler import Status
+
+        shed: List[Any] = []
+        for st in self._tenants.values():
+            if not any(r.deadline_s is not None for r in st.queue):
+                continue
+            kept: deque = deque()
+            for req in st.queue:
+                if (req.deadline_s is not None
+                        and req.t_admit is None
+                        and req.t_submit is not None
+                        and now - req.t_submit > req.deadline_s):
+                    req.status = Status.DONE
+                    req.finish_reason = "shed"
+                    req.t_done = now
+                    st.shed += 1
+                    shed.append(req)
+                else:
+                    kept.append(req)
+            st.queue = kept
+        return shed
+
+    # -- dispatch ----------------------------------------------------------
+
+    def next_batch(self, budget_requests: int) -> List[Any]:
+        """One DRR round: release up to ``budget_requests`` requests in
+        weighted fair order. Strict priority between classes; within a
+        class, each backlogged tenant earns ``quantum x weight`` deficit
+        and releases FIFO while the deficit covers the head's cost. A
+        tenant whose queue drains loses its leftover deficit (classic
+        DRR: idleness is not bankable credit). The rotating start
+        pointer keeps same-round ordering fair across rounds."""
+        out: List[Any] = []
+        if budget_requests < 1 or not self._order:
+            return out
+        backlogged = [n for n in self._order if self._tenants[n].queue]
+        if not backlogged:
+            return out
+        classes = sorted({self._tenants[n].spec.priority
+                          for n in backlogged})
+        self._rr_start += 1
+        for prio in classes:
+            names = [n for n in backlogged
+                     if self._tenants[n].spec.priority == prio]
+            k = self._rr_start % max(len(names), 1)
+            names = names[k:] + names[:k]
+            # keep granting quanta until the budget fills or the class
+            # drains — a single quantum smaller than one request's cost
+            # must not deadlock dispatch (the deficit accumulates)
+            while len(out) < budget_requests:
+                progressed = False
+                for name in names:
+                    st = self._tenants[name]
+                    if not st.queue:
+                        st.deficit = 0.0
+                        continue
+                    st.deficit += self.quantum_tokens * st.spec.weight
+                    while (st.queue and len(out) < budget_requests
+                           and st.deficit >= self.cost(st.queue[0])):
+                        req = st.queue.popleft()
+                        c = self.cost(req)
+                        st.deficit -= c
+                        st.dispatched += 1
+                        st.dispatched_tokens += c
+                        out.append(req)
+                        progressed = True
+                    if not st.queue:
+                        st.deficit = 0.0
+                if not progressed and not any(
+                        self._tenants[n].queue for n in names):
+                    break
+                if not progressed:
+                    # budget not filled but quanta keep accruing toward
+                    # the cheapest head; loop again (bounded: deficit
+                    # grows monotonically toward the head's cost)
+                    continue
+        return out
+
+    def requeue_front(self, req: Any) -> None:
+        """Put an un-placeable request back at the FRONT of its tenant
+        queue WITHOUT re-charging its dispatch (the deficit already
+        paid; re-charging would bill a full cost per failed placement
+        attempt)."""
+        st = self._state(req.tenant)
+        st.dispatched -= 1
+        st.dispatched_tokens -= self.cost(req)
+        st.queue.appendleft(req)
+
+    def record_done(self, req: Any) -> None:
+        st = self._state(req.tenant)
+        st.done += 1
+        st.done_tokens += len(req.generated)
+
+    # -- views -------------------------------------------------------------
+
+    def fair_floor(self, tenant: str) -> float:
+        """The tenant's guaranteed dispatched-token share among SAME-
+        priority tenants that have dispatched anything: weight over the
+        class's total weight. The starvation-freedom pin asserts every
+        continuously backlogged tenant's measured share stays >= this
+        floor (less DRR's one-quantum granularity slack)."""
+        st = self._tenants[tenant]
+        peers = [s for s in self._tenants.values()
+                 if s.spec.priority == st.spec.priority
+                 and (s.dispatched or s.queue)]
+        total = sum(s.spec.weight for s in peers)
+        return st.spec.weight / total if total else 1.0
+
+    def stats(self) -> Dict[str, Any]:
+        total_tokens = sum(s.dispatched_tokens
+                           for s in self._tenants.values())
+        out: Dict[str, Any] = {}
+        for name in self._order:
+            st = self._tenants[name]
+            out[name] = {
+                "weight": st.spec.weight,
+                "priority": st.spec.priority,
+                "submitted": st.submitted,
+                "queued": len(st.queue),
+                "dispatched": st.dispatched,
+                "dispatched_tokens": st.dispatched_tokens,
+                "dispatched_token_share": (
+                    round(st.dispatched_tokens / total_tokens, 4)
+                    if total_tokens else 0.0
+                ),
+                "fair_floor": round(self.fair_floor(name), 4),
+                "shed": st.shed,
+                "done": st.done,
+                "generated_tokens": st.done_tokens,
+            }
+        return out

@@ -110,11 +110,50 @@ class RequestOutput:
     ttft_s: Optional[float]
     decode_tokens_per_s: Optional[float]
     e2e_latency_s: float = 0.0  # submit -> done wall time
+    tenant: Optional[str] = None  # multi-tenant identity (None = untagged)
 
     @property
     def tokens(self) -> np.ndarray:
         return np.concatenate([np.asarray(self.prompt, np.int64),
                                np.asarray(self.generated, np.int64)])
+
+
+class _RunState:
+    """Accumulators for one serving run — the state ``run()`` kept in
+    locals before the steppable extraction (``start_run`` /
+    ``tick_once`` / ``finish_run``), so a control plane can interleave
+    N replica engines tick-by-tick in one host thread. Host-side only;
+    nothing here touches device memory."""
+
+    __slots__ = (
+        "now", "tick_hook", "t0", "tok0", "done", "outputs",
+        "per_request", "generated_total", "shed_count", "steps",
+        "prefills", "chunks", "spec_drafted", "spec_accepted",
+        "occ_slots", "occ_pages", "stalled", "tick", "t_last_decode",
+        "max_gap", "table", "seq_lens", "tokens",
+    )
+
+    def __init__(self, engine: "ServingEngine", now, tick_hook):
+        self.now = now
+        self.tick_hook = tick_hook
+        self.t0 = 0.0                   # set at the end of start_run
+        self.tok0 = engine._m_tokens.value
+        self.done: List[Request] = []   # finished, outputs not built yet
+        self.outputs: List[RequestOutput] = []
+        self.per_request: List[dict] = []
+        self.generated_total = 0
+        self.shed_count = 0
+        self.steps = self.prefills = self.chunks = 0
+        self.spec_drafted = self.spec_accepted = 0
+        self.occ_slots = self.occ_pages = 0.0
+        self.stalled = 0
+        self.tick = 0
+        self.t_last_decode: Optional[float] = None
+        self.max_gap = 0.0
+        self.table = np.zeros((engine.num_slots, engine.table_width),
+                              np.int32)
+        self.seq_lens = np.zeros((engine.num_slots,), np.int32)
+        self.tokens = np.zeros((engine.num_slots,), np.int32)
 
 
 class ServingEngine:
@@ -184,6 +223,7 @@ class ServingEngine:
         self.stall_patience = stall_patience
         self.tracer = tracer
         self.last_doctor_report = None   # refreshed by doctor()/doctor_chunk()
+        self._run: Optional[_RunState] = None   # live steppable run
         if recorder is not None and tracer is not None:
             # a decode_stall (or any) black box then embeds the live
             # request timelines: the dump NAMES the stuck request
@@ -215,6 +255,9 @@ class ServingEngine:
         self._m_shared = reg.counter("serving.prefix_cache.shared_pages")
         self._m_cow = reg.counter("serving.prefix_cache.cow_copies")
         self._m_cached = reg.gauge("serving.prefix_cache.cached_pages")
+        # pages leaf-first eviction could recover right now — the head-
+        # room half of the admission ledger, and the router's tie-break
+        self._m_evictable = reg.gauge("serving.prefix_cache.evictable_pages")
         self._m_frag = reg.gauge("serving.pool.fragmentation")
         self._m_prefill_tok = reg.counter("serving.prefill_tokens_total")
         self._m_chunks = reg.counter("serving.prefill_chunks_total")
@@ -829,6 +872,7 @@ class ServingEngine:
             )
             if trig.dump_path:
                 where = f" (black box: {trig.dump_path})"
+        self._run = None   # the stall is terminal for this run
         raise RuntimeError(f"serving decode stall: {reason}{where}")
 
     # -- API ---------------------------------------------------------------
@@ -839,212 +883,262 @@ class ServingEngine:
         (list[RequestOutput] in submit order, aggregate-metrics dict).
         ``tick_hook(engine, tick)``: optional per-iteration callback —
         the test/orchestration seam for mid-run interventions such as
-        ``engine.sched.preempt`` (the evict/re-admit contract)."""
-        reg = self.registry
+        ``engine.sched.preempt`` (the evict/re-admit contract).
+
+        A thin driver over the steppable run API (``start_run`` /
+        ``tick_once`` / ``finish_run``): same order of operations as
+        the pre-extraction monolith, token-identity test-pinned. The
+        control plane (serving/control_plane/) uses the steppable form
+        directly to interleave N replica engines in one host thread."""
+        self.start_run(requests, now=now, tick_hook=tick_hook)
+        try:
+            while not self.sched.all_done():
+                self.tick_once()
+            return self.finish_run()
+        except BaseException:
+            # a raising tick_hook (or the stall watchdog) must leave
+            # the engine reusable, exactly like the pre-extraction
+            # monolith whose state lived in locals
+            self.abort_run()
+            raise
+
+    def abort_run(self) -> None:
+        """Discard a live steppable run (exception recovery): per-run
+        accumulators drop, the engine becomes reusable. Requests still
+        in the scheduler are NOT touched — callers owning them (the
+        control plane's drain path) withdraw first. No-op when no run
+        is in progress."""
+        self._run = None
+
+    def start_run(self, requests: Sequence[Request] = (),
+                  now=time.perf_counter, tick_hook=None) -> None:
+        """Begin a steppable run: reset the per-run accumulators, point
+        the tracer at ``now``'s time domain, submit ``requests``. Drive
+        with :meth:`tick_once` until ``sched.all_done()`` (or until an
+        orchestrator decides to stop) and close with
+        :meth:`finish_run`."""
+        if self._run is not None:
+            raise RuntimeError("a serving run is already in progress")
         self._run_prefill_tokens = 0   # prompt tokens forwarded this run
         self._run_hit_tokens = 0       # prompt tokens served by the cache
         if self.tracer is not None:
             # one time domain: tracer-internal timestamps (e.g. preempt
             # hooks) must come from the same clock as t_submit/t_done
             self.tracer.set_clock(now)
+        rs = _RunState(self, now, tick_hook)
+        self._run = rs
         for r in requests:
-            self.sched.submit(r, now())
-        self._m_requests.inc(len(requests))
-        self._m_queue.set(len(self.sched.queue))
-        tok0 = self._m_tokens.value
-        done: List[Request] = []
-        steps = prefills = chunks = 0
-        spec_drafted = spec_accepted = 0
-        occ_slots = occ_pages = 0.0
-        table = np.zeros((self.num_slots, self.table_width), np.int32)
-        seq_lens = np.zeros((self.num_slots,), np.int32)
-        tokens = np.zeros((self.num_slots,), np.int32)
-        t0 = now()
-        stalled = 0
-        tick = 0
-        t_last_decode = None
-        max_gap = 0.0
-        while not self.sched.all_done():
-            tick += 1
-            if tick_hook is not None:
-                tick_hook(self, tick)
-            admitted = self.sched.admit(now())
-            shed_now = self.sched.drain_shed()
-            if shed_now:
-                # shedding IS the degraded-but-healthy mode: a counter
-                # and terminal outputs, never a watchdog trigger — the
-                # SLO shed-fraction target decides when it's too much
-                self._m_shed.inc(len(shed_now))
-                done.extend(shed_now)
-            chunked_this_tick = 0
-            if self._paged_prefill:
-                for req in admitted:
-                    self._start_prefill(req, now)
-                # one chunk per prefilling request per tick: the "mixed
-                # step" — prefill advances below, decode advances after,
-                # every tick
-                for req in [r for r in self.sched.active()
-                            if r.status is Status.PREFILL]:
-                    if req.status is not Status.PREFILL:
-                        continue  # retracted by an earlier neighbor's
-                        # lazy growth this very loop: back in the queue
-                    self._prefill_chunk_tick(req, now)
-                    chunks += 1
-                    chunked_this_tick += 1
-                    if req.status is Status.DONE:
-                        done.append(req)
-                    if req.status is not Status.PREFILL:
-                        prefills += 1
-            else:
-                for req in admitted:
-                    self._prefill_request(req, now)
-                    prefills += 1
-                    if req.status is Status.DONE:
-                        done.append(req)
-            active = [r for r in self.sched.active()
-                      if r.status is Status.DECODE]
-            self._m_queue.set(len(self.sched.queue))
-            if not active:
-                # no admission, no prefill chunk AND no decode work:
-                # nothing in this loop is time-dependent, so repeated
-                # no-progress iterations mean the queue is stuck (e.g. a
-                # reservation the pool can never cover). The watchdog
-                # turns that silent livelock into a black-box dump + a
-                # loud error.
-                if admitted or chunked_this_tick or shed_now:
-                    # shedding is progress: the queue shrank
-                    stalled = 0
-                else:
-                    stalled += 1
-                    if stalled >= self.stall_patience:
-                        self._stall(steps, now() - t0)
-                t_last_decode = None
-                continue  # everything admitted finished at prefill
-            stalled = 0
-            use_spec = (
-                self.speculative is not None
-                and any(r.max_new_tokens - len(r.generated) > 1
-                        for r in active)
-            )
-            if use_spec:
-                t_step = now()
-                emitted, drafted, accepted, active = self._spec_cycle(
-                    active, now, done)
-                spec_drafted += drafted
-                spec_accepted += accepted
-                t = now()
-            else:
-                for req in active:
-                    if req.status is Status.DECODE:
-                        self.sched.ensure_page(req)
-                # lazy growth may have RETRACTED a neighbor (temporal
-                # cache-ledger interference — see Scheduler.ensure_pages);
-                # only still-decoding survivors join the step
-                active = [r for r in active if r.status is Status.DECODE]
-                table.fill(0)
-                seq_lens.fill(0)
-                tokens.fill(0)
-                for req in active:
-                    table[req.slot, :len(req.pages)] = req.pages
-                    seq_lens[req.slot] = req.cached_len
-                    tokens[req.slot] = req.generated[-1]
-                t_step = now()
-                with span("serving.decode_step", registry=reg):
-                    nxt, self.k_pages, self.v_pages = self._step(
-                        self.params, jnp.asarray(tokens), self.k_pages,
-                        self.v_pages, jnp.asarray(table),
-                        jnp.asarray(seq_lens),
-                    )
-                    nxt = np.asarray(nxt)  # host fetch syncs: span = work
-                t = now()
-                emitted = len(active)
-                self._trace_tick(active, t_step, t)
-            if t_last_decode is not None:
-                gap = t_step - t_last_decode
-                self._m_gap.observe(gap)
-                max_gap = max(max_gap, gap)
-            t_last_decode = t
-            steps += 1
-            slot_occ = len(active) / self.num_slots
-            page_occ = self.pool.used_count / self.pool.capacity
-            occ_slots += slot_occ
-            occ_pages += page_occ
-            # per-token decode latency: a plain step emits one token per
-            # active slot; a speculative cycle may emit several — both
-            # normalize to seconds per token per slot
-            self._m_tok_lat.observe(
-                (t - t_step) * len(active) / max(emitted, 1))
-            self._m_steps.inc()
-            self._m_tokens.inc(emitted)
-            self._m_active.set(len(active))
-            self._m_slot_occ.set(slot_occ)
-            self._m_page_occ.set(page_occ)
-            if reg.enabled:
-                # fragmentation() sorts the free list — too heavy for
-                # the disabled path's one-branch cost contract
-                self._m_frag.set(self.pool.fragmentation())
-                if self.prefix_cache is not None:
-                    # refresh per step, not just on insert: pressure
-                    # eviction happens exactly when dashboards look
-                    self._m_cached.set(self.prefix_cache.cached_pages)
-            # the occupancy TIME SERIES the end-of-run averages flatten
-            reg.event("serving.step", step=steps, active=len(active),
-                      queue_depth=len(self.sched.queue), dur_s=t - t_step,
-                      slot_occupancy=slot_occ, page_occupancy=page_occ,
-                      tokens=emitted)
-            if self.recorder is not None:
-                self.recorder.observe_serving_step(
-                    steps, active=len(active),
-                    queue_depth=len(self.sched.queue), dur_s=t - t_step,
-                    tokens=emitted,
-                )
-            if not use_spec:
-                for req in active:
-                    self.sched.record_token(req, int(nxt[req.slot]), t)
-                    if req.status is Status.DONE:
-                        done.append(req)
-        wall = max(now() - t0, 1e-9)
-        # telemetry tokens/s from the COUNTER delta: cross-checks the
-        # per-step instrumentation against the legacy aggregate below
-        # (tests pin agreement within 1%)
-        self._m_tps.set((self._m_tokens.value - tok0) / wall)
+            self.submit_request(r)
+        rs.t0 = now()
 
-        done.sort(key=lambda r: r.uid)
-        outputs, per_request = [], []
-        shed_count = 0
-        for r in done:
-            if r.finish_reason == "shed":
-                # terminal but never served: the whole life was queue
-                # (or requeue) wait; TTFT/decode are None (matching the
-                # per_request dict) and the latency histograms are NOT
-                # observed — a shed row must not flatter (or poison)
-                # the served tail
-                shed_count += 1
-                e2e = r.t_done - r.t_submit
-                outputs.append(RequestOutput(
-                    uid=r.uid, prompt=np.asarray(r.prompt),
-                    generated=np.asarray(r.generated, np.int64),
-                    finish_reason="shed",
-                    queue_latency_s=e2e,
-                    ttft_s=None,
-                    decode_tokens_per_s=None,
-                    e2e_latency_s=e2e,
-                ))
-                per_request.append({
-                    "uid": r.uid,
-                    "prompt_len": r.prompt_len,
-                    "new_tokens": len(r.generated),
-                    "finish_reason": "shed",
-                    "queue_latency_s": round(e2e, 6),
-                    "ttft_s": None,
-                    "e2e_latency_s": round(e2e, 6),
-                    "decode_tokens_per_s": None,
-                })
-                continue
+    def submit_request(self, req: Request) -> None:
+        """Mid-run ingress — the control-plane router's dispatch entry
+        point (and the drain path's re-admission target: a migrated
+        request keeps its first-submission timestamps, see
+        ``Scheduler.submit``)."""
+        rs = self._run
+        if rs is None:
+            raise RuntimeError("submit_request needs start_run first")
+        self.sched.submit(req, rs.now())
+        self._m_requests.inc()
+        self._m_queue.set(len(self.sched.queue))
+
+    @property
+    def run_in_progress(self) -> bool:
+        return self._run is not None
+
+    def tick_once(self) -> bool:
+        """One scheduler iteration: admit, shed, advance prefills, one
+        decode step over the active slots, record tokens. Returns True
+        when the tick made progress (admitted / prefilled / decoded /
+        shed) — the idle-replica signal a control plane polls."""
+        rs = self._run
+        if rs is None:
+            raise RuntimeError("tick_once needs start_run first")
+        reg = self.registry
+        now = rs.now
+        rs.tick += 1
+        if rs.tick_hook is not None:
+            rs.tick_hook(self, rs.tick)
+        admitted = self.sched.admit(now())
+        shed_now = self.sched.drain_shed()
+        if shed_now:
+            # shedding IS the degraded-but-healthy mode: a counter
+            # and terminal outputs, never a watchdog trigger — the
+            # SLO shed-fraction target decides when it's too much
+            self._m_shed.inc(len(shed_now))
+            rs.done.extend(shed_now)
+        chunked_this_tick = 0
+        if self._paged_prefill:
+            for req in admitted:
+                self._start_prefill(req, now)
+            # one chunk per prefilling request per tick: the "mixed
+            # step" — prefill advances below, decode advances after,
+            # every tick
+            for req in [r for r in self.sched.active()
+                        if r.status is Status.PREFILL]:
+                if req.status is not Status.PREFILL:
+                    continue  # retracted by an earlier neighbor's
+                    # lazy growth this very loop: back in the queue
+                self._prefill_chunk_tick(req, now)
+                rs.chunks += 1
+                chunked_this_tick += 1
+                if req.status is Status.DONE:
+                    rs.done.append(req)
+                if req.status is not Status.PREFILL:
+                    rs.prefills += 1
+        else:
+            for req in admitted:
+                self._prefill_request(req, now)
+                rs.prefills += 1
+                if req.status is Status.DONE:
+                    rs.done.append(req)
+        active = [r for r in self.sched.active()
+                  if r.status is Status.DECODE]
+        self._m_queue.set(len(self.sched.queue))
+        if not active:
+            # no admission, no prefill chunk AND no decode work:
+            # nothing in this loop is time-dependent, so repeated
+            # no-progress iterations mean the queue is stuck (e.g. a
+            # reservation the pool can never cover). The watchdog
+            # turns that silent livelock into a black-box dump + a
+            # loud error.
+            if admitted or chunked_this_tick or shed_now:
+                # shedding is progress: the queue shrank
+                rs.stalled = 0
+            else:
+                rs.stalled += 1
+                if rs.stalled >= self.stall_patience:
+                    self._stall(rs.steps, now() - rs.t0)
+            rs.t_last_decode = None
+            # everything admitted finished at prefill
+            return bool(admitted or chunked_this_tick or shed_now)
+        rs.stalled = 0
+        use_spec = (
+            self.speculative is not None
+            and any(r.max_new_tokens - len(r.generated) > 1
+                    for r in active)
+        )
+        if use_spec:
+            t_step = now()
+            emitted, drafted, accepted, active = self._spec_cycle(
+                active, now, rs.done)
+            rs.spec_drafted += drafted
+            rs.spec_accepted += accepted
+            t = now()
+        else:
+            for req in active:
+                if req.status is Status.DECODE:
+                    self.sched.ensure_page(req)
+            # lazy growth may have RETRACTED a neighbor (temporal
+            # cache-ledger interference — see Scheduler.ensure_pages);
+            # only still-decoding survivors join the step
+            active = [r for r in active if r.status is Status.DECODE]
+            rs.table.fill(0)
+            rs.seq_lens.fill(0)
+            rs.tokens.fill(0)
+            for req in active:
+                rs.table[req.slot, :len(req.pages)] = req.pages
+                rs.seq_lens[req.slot] = req.cached_len
+                rs.tokens[req.slot] = req.generated[-1]
+            t_step = now()
+            with span("serving.decode_step", registry=reg):
+                nxt, self.k_pages, self.v_pages = self._step(
+                    self.params, jnp.asarray(rs.tokens), self.k_pages,
+                    self.v_pages, jnp.asarray(rs.table),
+                    jnp.asarray(rs.seq_lens),
+                )
+                nxt = np.asarray(nxt)  # host fetch syncs: span = work
+            t = now()
+            emitted = len(active)
+            self._trace_tick(active, t_step, t)
+        if rs.t_last_decode is not None:
+            gap = t_step - rs.t_last_decode
+            self._m_gap.observe(gap)
+            rs.max_gap = max(rs.max_gap, gap)
+        rs.t_last_decode = t
+        rs.steps += 1
+        slot_occ = len(active) / self.num_slots
+        page_occ = self.pool.used_count / self.pool.capacity
+        rs.occ_slots += slot_occ
+        rs.occ_pages += page_occ
+        # per-token decode latency: a plain step emits one token per
+        # active slot; a speculative cycle may emit several — both
+        # normalize to seconds per token per slot
+        self._m_tok_lat.observe(
+            (t - t_step) * len(active) / max(emitted, 1))
+        self._m_steps.inc()
+        self._m_tokens.inc(emitted)
+        self._m_active.set(len(active))
+        self._m_slot_occ.set(slot_occ)
+        self._m_page_occ.set(page_occ)
+        if reg.enabled:
+            # fragmentation() sorts the free list — too heavy for
+            # the disabled path's one-branch cost contract
+            self._m_frag.set(self.pool.fragmentation())
+            if self.prefix_cache is not None:
+                # refresh per step, not just on insert: pressure
+                # eviction happens exactly when dashboards look
+                self._m_cached.set(self.prefix_cache.cached_pages)
+                self._m_evictable.set(
+                    self.prefix_cache.evictable_count()
+                )
+        # the occupancy TIME SERIES the end-of-run averages flatten
+        reg.event("serving.step", step=rs.steps, active=len(active),
+                  queue_depth=len(self.sched.queue), dur_s=t - t_step,
+                  slot_occupancy=slot_occ, page_occupancy=page_occ,
+                  tokens=emitted)
+        if self.recorder is not None:
+            self.recorder.observe_serving_step(
+                rs.steps, active=len(active),
+                queue_depth=len(self.sched.queue), dur_s=t - t_step,
+                tokens=emitted,
+            )
+        if not use_spec:
+            for req in active:
+                self.sched.record_token(req, int(nxt[req.slot]), t)
+                if req.status is Status.DONE:
+                    rs.done.append(req)
+        return True
+
+    def _build_output(self, r: Request) -> RequestOutput:
+        """One finished request -> (RequestOutput, per-request dict),
+        appended to the run's accumulated rows."""
+        rs = self._run
+        if r.finish_reason == "shed":
+            # terminal but never served: the whole life was queue
+            # (or requeue) wait; TTFT/decode are None (matching the
+            # per_request dict) and the latency histograms are NOT
+            # observed — a shed row must not flatter (or poison)
+            # the served tail
+            rs.shed_count += 1
+            e2e = r.t_done - r.t_submit
+            out = RequestOutput(
+                uid=r.uid, prompt=np.asarray(r.prompt),
+                generated=np.asarray(r.generated, np.int64),
+                finish_reason="shed",
+                queue_latency_s=e2e,
+                ttft_s=None,
+                decode_tokens_per_s=None,
+                e2e_latency_s=e2e,
+                tenant=r.tenant,
+            )
+            row = {
+                "uid": r.uid,
+                "tenant": r.tenant,
+                "prompt_len": r.prompt_len,
+                "new_tokens": len(r.generated),
+                "finish_reason": "shed",
+                "queue_latency_s": round(e2e, 6),
+                "ttft_s": None,
+                "e2e_latency_s": round(e2e, 6),
+                "decode_tokens_per_s": None,
+            }
+        else:
             decode_s = max(r.t_done - r.t_admit, 1e-9)
             e2e = r.t_done - r.t_submit
             self._m_e2e.observe(e2e)
-            outputs.append(RequestOutput(
+            out = RequestOutput(
                 uid=r.uid, prompt=np.asarray(r.prompt),
                 generated=np.asarray(r.generated, np.int64),
                 finish_reason=r.finish_reason,
@@ -1052,9 +1146,11 @@ class ServingEngine:
                 ttft_s=r.t_first_token - r.t_submit,
                 decode_tokens_per_s=len(r.generated) / decode_s,
                 e2e_latency_s=e2e,
-            ))
-            per_request.append({
+                tenant=r.tenant,
+            )
+            row = {
                 "uid": r.uid,
+                "tenant": r.tenant,
                 "prompt_len": r.prompt_len,
                 "new_tokens": len(r.generated),
                 "finish_reason": r.finish_reason,
@@ -1062,27 +1158,69 @@ class ServingEngine:
                 "ttft_s": round(r.t_first_token - r.t_submit, 6),
                 "e2e_latency_s": round(e2e, 6),
                 "decode_tokens_per_s": round(len(r.generated) / decode_s, 2),
-            })
-        generated = sum(len(o.generated) for o in outputs)
+            }
+        rs.outputs.append(out)
+        rs.per_request.append(row)
+        rs.generated_total += len(out.generated)
+        return out
+
+    def take_finished(self) -> List[Tuple[Request, RequestOutput]]:
+        """Pop requests finished since the last call as
+        (request, output) pairs — the control plane's incremental
+        collection point, so completions can be attributed to tenants
+        and replicas while the run is still going. :meth:`finish_run`
+        still reports EVERY request in its outputs/metrics regardless
+        (rows accumulate run-wide)."""
+        rs = self._run
+        if rs is None:
+            raise RuntimeError("take_finished needs start_run first")
+        taken = [(r, self._build_output(r))
+                 for r in sorted(rs.done, key=lambda r: r.uid)]
+        rs.done = []
+        return taken
+
+    def finish_run(self):
+        """Close the run: build outputs for everything not already
+        taken, set the wall-rate gauge, return (outputs in uid order,
+        aggregate-metrics dict). The metrics cover the WHOLE run
+        including requests handed out through :meth:`take_finished`."""
+        rs = self._run
+        if rs is None:
+            raise RuntimeError("finish_run needs start_run first")
+        now = rs.now
+        wall = max(now() - rs.t0, 1e-9)
+        # telemetry tokens/s from the COUNTER delta: cross-checks the
+        # per-step instrumentation against the legacy aggregate below
+        # (tests pin agreement within 1%)
+        self._m_tps.set((self._m_tokens.value - rs.tok0) / wall)
+        for r in sorted(rs.done, key=lambda r: r.uid):
+            self._build_output(r)
+        rs.done = []
+        order = sorted(range(len(rs.outputs)),
+                       key=lambda i: rs.outputs[i].uid)
+        outputs = [rs.outputs[i] for i in order]
+        per_request = [rs.per_request[i] for i in order]
         metrics = {
             "wall_time_s": round(wall, 6),
-            "decode_steps": steps,
-            "prefills": prefills,
-            "generated_tokens": generated,
-            "decode_tokens_per_s": round(generated / wall, 2),
-            "slot_occupancy": round(occ_slots / steps, 4) if steps else 0.0,
-            "page_occupancy": round(occ_pages / steps, 4) if steps else 0.0,
+            "decode_steps": rs.steps,
+            "prefills": rs.prefills,
+            "generated_tokens": rs.generated_total,
+            "decode_tokens_per_s": round(rs.generated_total / wall, 2),
+            "slot_occupancy": round(rs.occ_slots / rs.steps, 4)
+            if rs.steps else 0.0,
+            "page_occupancy": round(rs.occ_pages / rs.steps, 4)
+            if rs.steps else 0.0,
             "requests": per_request,
             # tokens actually forwarded through prefill this run — the
             # FLOP meter every engine flavor reports on the same basis
             # (prompt tokens only, never decode; cache hits subtract)
             "prefill_tokens": self._run_prefill_tokens,
             # deadline-shed terminal count (graceful degradation)
-            "shed_requests": shed_count,
+            "shed_requests": rs.shed_count,
         }
         if self._paged_prefill:
-            metrics["prefill_chunks"] = chunks
-            metrics["max_decode_gap_s"] = round(max_gap, 6)
+            metrics["prefill_chunks"] = rs.chunks
+            metrics["max_decode_gap_s"] = round(rs.max_gap, 6)
         if self.prefix_cache is not None:
             hit = self._run_hit_tokens
             fwd = self._run_prefill_tokens
@@ -1095,11 +1233,13 @@ class ServingEngine:
             }
         if self.speculative is not None:
             metrics["speculative"] = {
-                "draft_tokens": spec_drafted,
-                "accepted_tokens": spec_accepted,
-                "acceptance_rate": round(spec_accepted / spec_drafted, 4)
-                if spec_drafted else 0.0,
+                "draft_tokens": rs.spec_drafted,
+                "accepted_tokens": rs.spec_accepted,
+                "acceptance_rate": round(
+                    rs.spec_accepted / rs.spec_drafted, 4)
+                if rs.spec_drafted else 0.0,
             }
+        self._run = None
         return outputs, metrics
 
 
@@ -1229,23 +1369,45 @@ def serving_ab_benchmark(params, config, request_specs, *, num_slots=4,
 
 def make_skewed_replay(*, n_requests: int, n_prefixes: int, prefix_len: int,
                        suffix_lens: Sequence[int], max_new: int,
-                       vocab: int, seed: int = 0, zipf_a: float = 1.2):
+                       vocab: int, seed: int = 0, zipf_a: float = 1.2,
+                       n_tenants: Optional[int] = None,
+                       tenant_zipf_a: float = 1.2):
     """Synthetic heavy-traffic replay with SKEWED prompt reuse: each
     request's prompt is one of ``n_prefixes`` shared prefixes (drawn
     Zipf-style — rank r with weight 1/r^a, the few-hot-system-prompts
     shape production traffic has) followed by a private random suffix.
     Returns a list of (prompt ndarray, max_new) pairs; every call with
     the same seed replays the identical trace, so cache-on and
-    cache-off arms measure the same workload."""
+    cache-off arms measure the same workload.
+
+    ``n_tenants``: multi-tenant flavor — each request additionally
+    draws a tenant name ("t0".."tN") from a SECOND independent Zipf
+    (``tenant_zipf_a``), the one-hot-customer shape the control plane's
+    fairness ledger exists for, and the rows become (prompt, max_new,
+    tenant) TRIPLES. Default None keeps the legacy pair shape, so
+    every existing caller unpacks unchanged."""
     rng = np.random.RandomState(seed)
     prefixes = [rng.randint(1, vocab, (prefix_len,)) for _ in range(n_prefixes)]
     weights = np.array([1.0 / (r + 1) ** zipf_a for r in range(n_prefixes)])
     weights /= weights.sum()
+    t_weights = None
+    if n_tenants is not None:
+        if n_tenants < 1:
+            raise ValueError(f"n_tenants must be >= 1, got {n_tenants}")
+        t_weights = np.array(
+            [1.0 / (r + 1) ** tenant_zipf_a for r in range(n_tenants)]
+        )
+        t_weights /= t_weights.sum()
     specs = []
     for _ in range(n_requests):
         pfx = prefixes[rng.choice(n_prefixes, p=weights)]
         sfx = rng.randint(1, vocab, (int(rng.choice(suffix_lens)),))
-        specs.append((np.concatenate([pfx, sfx]), max_new))
+        prompt = np.concatenate([pfx, sfx])
+        if t_weights is None:
+            specs.append((prompt, max_new))
+        else:
+            tenant = f"t{int(rng.choice(n_tenants, p=t_weights))}"
+            specs.append((prompt, max_new, tenant))
     return specs
 
 

@@ -129,6 +129,22 @@ class PrefixCache:
 
         return sum(1 for n in self._nodes.values() if recoverable(n))
 
+    def longest_prefix_len(self, tokens: Sequence[int]) -> int:
+        """TOKEN-granular length of the longest cached prefix of
+        ``tokens``: fully matched pages plus the longest matching head
+        of a partially matched (COW-candidate) page. Built on the
+        side-effect-free :meth:`lookup`, so probing NEVER pins a page,
+        never touches the LRU clock, and never evicts (pinned by test)
+        — this is the read-only probe the control-plane router calls
+        against every replica per routing decision. Capped at
+        ``len(tokens) - 1`` exactly like admission's lookup (at least
+        one token must always be forwarded to produce logits), so the
+        router's score equals the hit the chosen replica will see."""
+        n = len(np.asarray(tokens))
+        if n <= 1:
+            return 0
+        return self.lookup(tokens, max_tokens=n - 1).total_tokens
+
     def lookup(self, tokens: Sequence[int], max_tokens: Optional[int] = None
                ) -> PrefixHit:
         """Longest cached prefix of ``tokens``, capped at ``max_tokens``

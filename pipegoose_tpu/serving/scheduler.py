@@ -72,6 +72,11 @@ class Request:
     # arrive too late to matter, so spending prefill+decode on it only
     # makes every other request later). None = never shed.
     deadline_s: Optional[float] = None
+    # multi-tenant identity: who this request belongs to. The scheduler
+    # itself is tenant-blind; the control plane's fair-share ledger
+    # (serving/control_plane/tenants.py) keys on it, the tracer carries
+    # it through timeline events, and per-request metric dicts report it.
+    tenant: Optional[str] = None
 
     uid: Optional[int] = None
     status: Status = Status.QUEUED
@@ -180,7 +185,13 @@ class Scheduler:
             )
         req.uid = self._next_uid
         self._next_uid += 1
-        req.t_submit = now
+        if req.t_submit is None:
+            # FIRST submission only — the same contract admit() keeps for
+            # t_admit: a request MIGRATED between replicas (control-plane
+            # drain: withdraw here, submit there) keeps the user-visible
+            # submit time, so queue_latency_s/ttft_s never go negative
+            # against a preserved t_admit
+            req.t_submit = now
         req.status = Status.QUEUED
         self.queue.append(req)
         if self.tracer is not None:
@@ -221,6 +232,108 @@ class Scheduler:
         out, self.shed = self.shed, []
         return out
 
+    def _admission_check(self, req: Request):
+        """The admission ledger, side-effect-free: can the pool (plus
+        evictable cache pages, minus pins a cache hit would take) cover
+        ``req``'s worst case beyond all outstanding reservations?
+        Returns ``(fits, hit)`` — the SINGLE implementation both
+        :meth:`admit` and the router-facing :meth:`can_admit` probe
+        evaluate, so probe and admission cannot disagree on the same
+        state (pinned by test). ``lookup`` is side-effect-free, so a
+        False verdict leaves the cache LRU order and every refcount
+        untouched."""
+        target = req.target_len
+        worst = self.pool.pages_for(req.prompt_len + req.max_new_tokens)
+        hit = None
+        shared: List[int] = []
+        evictable = pinned = 0
+        if self.cache is not None and (
+            self.pool.free_count + self.cache.cached_pages
+            - self._outstanding_total
+            < worst - (target - 1) // self.pool.page_size
+        ):
+            # O(1) reject: even if EVERY cached page were evictable
+            # and the hit were the longest possible, the head can't
+            # fit — skip the trie walk + whole-trie evictable scan.
+            # (A head blocked only by the EXACT ledger still rescans
+            # each tick; acceptable until caches reach a size where
+            # incremental evictable accounting pays for itself.)
+            return False, None
+        if self.cache is not None:
+            # >= 1 token must be forwarded: its logits produce the
+            # next token (resumed requests re-derive their pending)
+            hit = self.cache.lookup(req.tokens[:target],
+                                    max_tokens=target - 1)
+            shared = hit.pages
+            pins = shared + (
+                [hit.cow_page] if hit.cow_page is not None else []
+            )
+            pinned = sum(1 for p in pins if self.pool.refcount(p) == 1)
+            evictable = self.cache.evictable_count()
+        need_new = worst - len(shared)
+        if (self.pool.free_count + evictable - pinned
+                - self._outstanding_total < need_new):
+            return False, hit
+        return True, hit
+
+    def can_admit(self, req: Request) -> bool:
+        """Side-effect-free admission probe: would :meth:`admit` admit
+        ``req`` RIGHT NOW if it sat at the head of the queue? Evaluates
+        the exact ledger admit() uses (:meth:`_admission_check` is the
+        shared implementation) plus slot availability, without debiting
+        the reservation total, pinning a hit's pages, or touching the
+        cache's LRU clock — the control-plane router calls this per
+        routing decision, and a probe that mutated state would skew the
+        very admission it predicts."""
+        if not any(s is None for s in self.slots):
+            return False
+        if not self.continuous and any(s is not None for s in self.slots):
+            return False  # naive padded batching: drain before refill
+        return self._admission_check(req)[0]
+
+    def capacity_snapshot(self) -> dict:
+        """Read-only load + capacity view (free/evictable pages, queued
+        tokens) — the router's tie-break signal. ``queued_tokens`` and
+        ``active_tokens_remaining`` count work still owed: prefill
+        targets plus undecoded new-token budgets. Like
+        :meth:`can_admit`, this never mutates anything."""
+        active = self.active()
+        return {
+            "free_slots": sum(1 for s in self.slots if s is None),
+            "num_slots": self.num_slots,
+            "free_pages": self.pool.free_count,
+            "evictable_pages": (self.cache.evictable_count()
+                                if self.cache is not None else 0),
+            "outstanding_pages": self._outstanding_total,
+            "queued_requests": len(self.queue),
+            "queued_tokens": sum(
+                r.target_len + max(r.max_new_tokens - len(r.generated), 0)
+                for r in self.queue
+            ),
+            "active_requests": len(active),
+            "active_tokens_remaining": sum(
+                max(r.max_new_tokens - len(r.generated), 0) for r in active
+            ),
+        }
+
+    def withdraw(self, req: Request) -> Request:
+        """Remove a QUEUED request from this scheduler (control-plane
+        drain: this replica gives the request up so another replica's
+        :meth:`submit` can take it). Only queue members can be
+        withdrawn — an active request must be :meth:`preempt`-ed back
+        into the queue first, which releases its pages. Lifecycle
+        timestamps survive (submit/admit both preserve existing marks),
+        so withdraw → submit elsewhere books the wait between them as
+        stall time, never as a fresh queue latency."""
+        try:
+            self.queue.remove(req)
+        except ValueError:
+            raise ValueError(
+                f"request uid={req.uid} is not queued on this scheduler"
+            )
+        req.slot = None
+        return req
+
     def admit(self, now: float) -> List[Request]:
         """Move queued requests into free slots while the pool (plus
         evictable cache pages) can cover their worst case beyond all
@@ -241,36 +354,11 @@ class Scheduler:
             req = self.queue[0]
             target = req.target_len
             worst = self.pool.pages_for(req.prompt_len + req.max_new_tokens)
-            hit = None
-            shared: List[int] = []
-            evictable = pinned = 0
-            if self.cache is not None and (
-                self.pool.free_count + self.cache.cached_pages
-                - self._outstanding_total
-                < worst - (target - 1) // self.pool.page_size
-            ):
-                # O(1) reject: even if EVERY cached page were evictable
-                # and the hit were the longest possible, the head can't
-                # fit — skip the trie walk + whole-trie evictable scan.
-                # (A head blocked only by the EXACT ledger still rescans
-                # each tick; acceptable until caches reach a size where
-                # incremental evictable accounting pays for itself.)
-                break
-            if self.cache is not None:
-                # >= 1 token must be forwarded: its logits produce the
-                # next token (resumed requests re-derive their pending)
-                hit = self.cache.lookup(req.tokens[:target],
-                                        max_tokens=target - 1)
-                shared = hit.pages
-                pins = shared + (
-                    [hit.cow_page] if hit.cow_page is not None else []
-                )
-                pinned = sum(1 for p in pins if self.pool.refcount(p) == 1)
-                evictable = self.cache.evictable_count()
-            need_new = worst - len(shared)
-            if (self.pool.free_count + evictable - pinned
-                    - self._outstanding_total < need_new):
+            fits, hit = self._admission_check(req)
+            if not fits:
                 break  # FIFO head-of-line: deterministic admission order
+            shared: List[int] = hit.pages if hit is not None else []
+            need_new = worst - len(shared)
             self.queue.popleft()
             req.slot = free_slots[0]
             self.slots[req.slot] = req

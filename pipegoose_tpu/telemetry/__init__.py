@@ -35,8 +35,14 @@ from pipegoose_tpu.telemetry.chrometrace import (
     ChromeTraceExporter,
     pipeline_trace_events,
     register_pipeline_gauges,
+    router_trace_events,
     span_events_to_trace,
     trace_from_jsonl,
+)
+from pipegoose_tpu.telemetry.fleet import (
+    FleetRegistry,
+    merge_histograms,
+    merge_metrics,
 )
 from pipegoose_tpu.telemetry.opsserver import OpsServer, parse_prometheus_text
 from pipegoose_tpu.telemetry.reqtrace import (
@@ -101,6 +107,7 @@ __all__ = [
     "ChromeTraceExporter",
     "Counter",
     "DoctorReport",
+    "FleetRegistry",
     "FlightRecorder",
     "Gauge",
     "Histogram",
@@ -136,8 +143,11 @@ __all__ = [
     "health_stats",
     "host_health",
     "iter_collectives",
+    "merge_histograms",
+    "merge_metrics",
     "mfu",
     "parse_prometheus_text",
+    "router_trace_events",
     "peak_flops_for",
     "pipeline_trace_events",
     "register_pipeline_gauges",

@@ -39,6 +39,7 @@ from pipegoose_tpu.utils.procindex import RankFilter as _RankFilter
 PID_HOST = 1        # host-side spans (trainer/serving/decode driver)
 PID_PIPELINE = 2    # theoretical pipeline clock timeline
 PID_REQUESTS = 3    # per-request serving timelines (telemetry/reqtrace.py)
+PID_FLEET = 4       # control-plane router decisions (one track per replica)
 
 
 def span_events_to_trace(
@@ -191,6 +192,46 @@ def register_pipeline_gauges(
     return frac
 
 
+def router_trace_events(decisions: Iterable[dict], *,
+                        pid: int = PID_FLEET,
+                        wall_offset: float = 0.0) -> List[dict]:
+    """Render a control-plane router's decision log
+    (``Router.decisions`` — serving/control_plane/router.py) as
+    Perfetto rows: ONE TRACK PER REPLICA, an instant marker per routing
+    decision carrying the tenant, the matched cached-prefix tokens, and
+    the candidate count — loadable next to the per-slot request
+    timelines, so "why did this request land here" sits one track above
+    "what happened to it". ``wall_offset`` aligns the decisions' clock
+    domain with the span rows (pass the owning tracer's
+    ``wall_offset`` when combining)."""
+    decisions = list(decisions)
+    replicas: List[str] = []
+    for d in decisions:
+        if d["replica"] not in replicas:
+            replicas.append(d["replica"])
+    events: List[dict] = [{
+        "name": "process_name", "ph": "M", "pid": pid,
+        "args": {"name": "serving fleet (router decisions)"},
+    }]
+    for tid, name in enumerate(replicas):
+        events.append({
+            "name": "thread_name", "ph": "M", "pid": pid, "tid": tid,
+            "args": {"name": name},
+        })
+    for d in decisions:
+        tenant = d.get("tenant") or "default"
+        events.append({
+            "name": f"route {tenant}"
+                    + (f" +{d['matched_tokens']}tok"
+                       if d.get("matched_tokens") else ""),
+            "cat": "router.decision", "ph": "i", "s": "t",
+            "ts": (d["t"] + wall_offset) * 1e6,
+            "pid": pid, "tid": replicas.index(d["replica"]),
+            "args": {k: v for k, v in d.items() if k != "t"},
+        })
+    return events
+
+
 class ChromeTraceExporter:
     """Registry sink accumulating span/step events as trace events;
     ``write()`` emits one Perfetto-loadable JSON file atomically.
@@ -257,6 +298,13 @@ class ChromeTraceExporter:
         from pipegoose_tpu.telemetry.reqtrace import request_trace_events
 
         self.add_events(request_trace_events(tracer, **kwargs))
+
+    def add_router_decisions(self, decisions: Iterable[dict],
+                             **kwargs: Any) -> None:
+        """Attach a control-plane router's decision log (see
+        :func:`router_trace_events`) — one track per replica in the
+        fleet process group."""
+        self.add_events(router_trace_events(decisions, **kwargs))
 
     def write(self, path: Optional[str] = None) -> Optional[str]:
         """Render and atomically write the trace JSON; returns the path

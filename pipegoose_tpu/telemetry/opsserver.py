@@ -23,6 +23,10 @@ background HTTP endpoint over the same telemetry objects:
                           stuck and where is its latency going".
 - ``GET /debug/doctor``   the last mesh-doctor ``DoctorReport`` as JSON
                           (the compiled program's sharding plan).
+- ``GET /debug/fleet``    the control plane's live fleet status
+                          (serving/control_plane/): per-replica state +
+                          load, router stats, per-tenant fair-share
+                          ledger, autoscaler audit log.
 
 Operational posture: rank-0-filtered (non-zero ranks never bind a
 socket — same ``RankFilter`` convention as the file exporters),
@@ -54,6 +58,10 @@ class OpsServer:
     ``tracer``: optional ``RequestTracer`` behind ``/debug/requests``.
     ``doctor``: a ``DoctorReport`` or a zero-arg callable returning one
     (e.g. ``lambda: engine.last_doctor_report``).
+    ``fleet``: a JSON-able dict or a zero-arg callable returning one
+    (e.g. ``control_plane.fleet_status``) behind ``/debug/fleet`` —
+    per-replica state + load, router stats, per-tenant shares, the
+    autoscaler audit log.
     """
 
     def __init__(
@@ -67,6 +75,7 @@ class OpsServer:
         recorder: Optional[Any] = None,
         tracer: Optional[Any] = None,
         doctor: Optional[Any] = None,
+        fleet: Optional[Any] = None,
     ):
         self.registry = registry if registry is not None else get_registry()
         self.host = host
@@ -76,6 +85,7 @@ class OpsServer:
         self.recorder = recorder
         self.tracer = tracer
         self._doctor = doctor
+        self._fleet = fleet
         self._lock = threading.Lock()
         # SLOMonitor mutates per-target state on evaluate(), so
         # concurrent /healthz probes must serialize — but on its OWN
@@ -103,6 +113,21 @@ class OpsServer:
             except Exception:  # noqa: BLE001 - provider failure != 500 storm
                 return None
         return d
+
+    def set_fleet(self, fleet: Any) -> None:
+        """Attach (or replace) the provider behind ``/debug/fleet``."""
+        with self._lock:
+            self._fleet = fleet
+
+    def _fleet_status(self) -> Optional[Any]:
+        with self._lock:
+            f = self._fleet
+        if callable(f):
+            try:
+                return f()
+            except Exception:  # noqa: BLE001 - provider failure != 500 storm
+                return None
+        return f
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -250,10 +275,18 @@ def _make_handler(ops: OpsServer):
                         payload = (report.to_json()
                                    if hasattr(report, "to_json") else report)
                         self._send_json(200, payload)
+                elif path == "/debug/fleet":
+                    payload = ops._fleet_status()
+                    if payload is None:
+                        self._send_json(404, {"error": "no fleet status "
+                                              "provider attached"})
+                    else:
+                        self._send_json(200, payload)
                 elif path == "/":
                     self._send_json(200, {
                         "endpoints": ["/metrics", "/healthz",
-                                      "/debug/requests", "/debug/doctor"],
+                                      "/debug/requests", "/debug/doctor",
+                                      "/debug/fleet"],
                     })
                 else:
                     self._send_json(404, {"error": f"unknown path {path!r}"})

@@ -74,7 +74,8 @@ class RequestTimeline:
     """
 
     __slots__ = (
-        "uid", "prompt_len", "max_new_tokens", "slot", "events", "dropped",
+        "uid", "tenant", "prompt_len", "max_new_tokens", "slot", "events",
+        "dropped",
         "t_submit", "t_first_token", "t_done", "finish_reason",
         "components", "ttft_s", "ttft_components", "e2e_s",
         "hit_tokens", "prefill_tokens", "prefill_chunks", "cow_copies",
@@ -85,6 +86,7 @@ class RequestTimeline:
 
     def __init__(self, uid: int, max_events: int):
         self.uid = uid
+        self.tenant: Optional[str] = None
         self.prompt_len = 0
         self.max_new_tokens = 0
         self.slot: Optional[int] = None
@@ -137,6 +139,7 @@ class RequestTimeline:
         """JSON-able attribution record (the ``serving.attrib.*`` shape)."""
         out: Dict[str, Any] = {
             "uid": self.uid,
+            "tenant": self.tenant,
             "prompt_len": self.prompt_len,
             "components": dict(self.components),
             "ttft_s": self.ttft_s,
@@ -309,6 +312,7 @@ class RequestTracer(NullRequestTracer):
         tl = self.in_flight.get(req.uid)
         if tl is None:
             tl = RequestTimeline(req.uid, self.max_events)
+            tl.tenant = getattr(req, "tenant", None)
             tl.prompt_len = int(req.prompt_len)
             tl.max_new_tokens = int(req.max_new_tokens)
             tl.t_submit = t
@@ -322,7 +326,8 @@ class RequestTracer(NullRequestTracer):
             tl = self._get(req, t)
             tl.transition("queue", t)
             tl.add_event("submit", t, prompt_len=tl.prompt_len,
-                         max_new_tokens=tl.max_new_tokens)
+                         max_new_tokens=tl.max_new_tokens,
+                         tenant=tl.tenant)
 
     def on_admit(self, req: Any, t: float) -> None:
         with self._lock:

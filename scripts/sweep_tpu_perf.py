@@ -17,6 +17,10 @@ RTT subtracted. One attach per run (tunnel is single-client).
         # ranking (pipegoose_tpu/planner/), then measure ONLY the
         # top-K (PLAN_TOP_K) and record predicted-vs-measured deltas
         # in the PLAN_JSON artifact
+    python scripts/sweep_tpu_perf.py control-plane   # ISSUE 12: the
+        # multi-tenant replay through round-robin vs cache-aware
+        # routing at 2 and 4 replicas — forwarded prefill tokens,
+        # TTFT, tenant shares, drain zero-drop verdict
 """
 from __future__ import annotations
 
@@ -453,6 +457,43 @@ def plan_sweep():
         print(f"plan artifact: {plan_path}")
 
 
+def control_plane_sweep():
+    """Multi-replica control plane (serving/control_plane/, ISSUE 12):
+    the multi-tenant Zipf trace through round-robin vs cache-aware
+    routing at 2 and 4 replicas on the real chip — forwarded prefill
+    tokens, TTFT p50/p99, per-tenant dispatched shares, and the
+    scale-down drain's zero-drop verdict per fleet size."""
+    from pipegoose_tpu.models import bloom
+    from pipegoose_tpu.serving.control_plane import (
+        control_plane_replay_benchmark,
+    )
+
+    cfg = bloom.BloomConfig.bloom_560m(dtype=jnp.bfloat16)
+    params = bloom.init_params(cfg, jax.random.PRNGKey(1))
+    from pipegoose_tpu import telemetry
+
+    reg = telemetry.get_registry()
+    was_enabled = reg.enabled
+    results = {}
+    for replicas in (2, 4):
+        label = f"replicas{replicas}"
+        reg.disable()
+        try:
+            results[label] = control_plane_replay_benchmark(
+                params, cfg, n_requests=8 * replicas, n_prefixes=6,
+                prefix_len=96, suffix_lens=(8, 16), max_new=8,
+                n_tenants=4, n_replicas=replicas, num_slots=1,
+                num_pages=65, page_size=32, max_context=192,
+            )
+        except Exception as e:  # noqa: BLE001
+            results[label] = {"error": f"{type(e).__name__}: {e}"[:300]}
+        finally:
+            if was_enabled:
+                reg.enable()
+        print(label, json.dumps(results[label]), flush=True)
+    print(json.dumps(results))
+
+
 def serving_sweep(prefix_replay: bool = False, quant: bool = False):
     """Continuous-batching vs naive padded serving (serving/engine.py)
     across slot counts on the real chip: the decode-step savings grow
@@ -527,7 +568,8 @@ if __name__ == "__main__":
     mode = sys.argv[1] if len(sys.argv) > 1 else "kernel"
     modes = {"kernel": kernel_sweep, "model": model_sweep,
              "fusedce": fusedce_sweep, "serving": serving_sweep,
-             "comm": comm_sweep, "plan": plan_sweep}
+             "comm": comm_sweep, "plan": plan_sweep,
+             "control-plane": control_plane_sweep}
     if mode not in modes:
         raise SystemExit(f"unknown mode {mode!r}; pick one of {sorted(modes)}")
     if mode == "serving":

@@ -57,6 +57,8 @@ FAST_FILES = {
     "tests/models/test_generate.py",            # KV-cache decode
     "tests/serving/test_kv_pool.py",            # paged-KV allocator/gather
     "tests/serving/test_serving_scheduler.py",  # continuous-batching lifecycle
+    "tests/serving/test_control_plane.py",      # router/ledger/drain (ISSUE 12)
+    "tests/telemetry/test_fleet.py",            # fleet metric merge + /debug/fleet
     "tests/telemetry/test_registry.py",         # metrics + <5µs overhead guard
     "tests/telemetry/test_spans.py",            # span tracing + jit safety
     "tests/telemetry/test_exporters.py",        # JSONL / Prometheus / rank-0

@@ -1,0 +1,228 @@
+"""Fleet metric aggregation (telemetry/fleet.py): exact counter/
+histogram merges, the FleetRegistry overlay view, burn-rate verdicts
+preserved across the merge (hand-computed + blip suppression), the
+router Perfetto track, and the ``/debug/fleet`` ops endpoint."""
+import json
+from urllib.request import urlopen
+
+import pytest
+
+from pipegoose_tpu.telemetry.chrometrace import (
+    PID_FLEET,
+    router_trace_events,
+)
+from pipegoose_tpu.telemetry.fleet import (
+    FleetRegistry,
+    merge_histograms,
+    merge_metrics,
+)
+from pipegoose_tpu.telemetry.opsserver import OpsServer
+from pipegoose_tpu.telemetry.registry import Histogram, MetricsRegistry
+from pipegoose_tpu.telemetry.slo import SLOMonitor, SLOTarget
+
+
+def _member(name):
+    return name, MetricsRegistry(enabled=True)
+
+
+# -- merge math -------------------------------------------------------------
+
+
+def test_merge_counters_sum_and_gauges_sum_skipping_unset():
+    a, b = MetricsRegistry(enabled=True), MetricsRegistry(enabled=True)
+    a.counter("req_total").inc(3)
+    b.counter("req_total").inc(4)
+    a.gauge("pages_free").set(10.0)
+    b.gauge("pages_free").set(7.0)
+    a.gauge("only_a").set(2.0)
+    b.gauge("only_a")            # registered, never set (NaN): skipped
+    merged = merge_metrics([a.metrics(), b.metrics()])
+    assert merged["req_total"].value == 7.0
+    assert merged["pages_free"].value == 17.0
+    assert merged["only_a"].value == 2.0
+
+
+def test_merge_histograms_equals_union_hand_computed():
+    """The merged histogram must be indistinguishable (buckets, count,
+    sum, min/max) from one histogram that saw every observation —
+    that identity is what makes fleet burn rates exact."""
+    buckets = (0.1, 1.0)
+    ha = Histogram("h", buckets=buckets)
+    hb = Histogram("h", buckets=buckets)
+    hu = Histogram("h", buckets=buckets)   # the union reference
+    for v in (0.05, 0.07, 2.0):
+        ha.observe(v)
+        hu.observe(v)
+    for v in (0.5, 0.06):
+        hb.observe(v)
+        hu.observe(v)
+    m = merge_histograms("h", [ha, hb])
+    assert m._counts == hu._counts == [3, 1, 1]
+    assert m.count == 5
+    assert m.sum == pytest.approx(hu.sum)
+    assert m._min == pytest.approx(0.05)
+    assert m._max == pytest.approx(2.0)
+
+
+def test_merge_histograms_rejects_mismatched_buckets():
+    ha = Histogram("h", buckets=(0.1, 1.0))
+    hb = Histogram("h", buckets=(0.2, 1.0))
+    with pytest.raises(ValueError, match="mismatched buckets"):
+        merge_histograms("h", [ha, hb])
+
+
+def test_merge_metrics_rejects_conflicting_types():
+    a, b = MetricsRegistry(enabled=True), MetricsRegistry(enabled=True)
+    a.counter("x")
+    b.gauge("x")
+    with pytest.raises(TypeError, match="conflicting types"):
+        merge_metrics([a.metrics(), b.metrics()])
+
+
+# -- the registry view ------------------------------------------------------
+
+
+def test_fleet_registry_overlays_own_metrics_and_members():
+    na, ra = _member("a")
+    nb, rb = _member("b")
+    fleet = FleetRegistry([(na, ra), (nb, rb)])
+    ra.counter("serving.tokens_total").inc(5)
+    rb.counter("serving.tokens_total").inc(7)
+    fleet.gauge("slo.breaching").set(1.0)     # own write
+    m = fleet.metrics()
+    assert m["serving.tokens_total"].value == 12.0
+    assert m["slo.breaching"].value == 1.0
+    assert fleet.member_names == ["a", "b"]
+    # snapshot()/to_prometheus() ride the merged view
+    assert fleet.snapshot()["counters"]["serving.tokens_total"] == 12.0
+    assert "serving_tokens_total 12.0" in fleet.to_prometheus()
+    fleet.remove_member("a")
+    assert fleet.metrics()["serving.tokens_total"].value == 7.0
+    with pytest.raises(ValueError, match="already registered"):
+        fleet.add_member("b", rb)
+    with pytest.raises(ValueError, match="no fleet member"):
+        fleet.remove_member("zzz")
+
+
+# -- burn-rate verdicts over the merge -------------------------------------
+
+
+def _monitor(reg, **kw):
+    clock = [0.0]
+    kw.setdefault("fast_window_s", 10.0)
+    kw.setdefault("slow_window_s", 100.0)
+    mon = SLOMonitor(
+        [SLOTarget(name="ttft", metric="serving.ttft_seconds",
+                   objective=0.1, target=0.9)],
+        registry=reg, clock=lambda: clock[0], **kw,
+    )
+    return mon, clock
+
+
+def test_merged_burn_verdict_matches_union_hand_computed():
+    """Observations split across two replicas must produce the EXACT
+    burn rate of a single registry that saw the union: 5 bad / 25
+    events -> bad fraction 0.2 -> burn 2.0 at a 10% budget."""
+    na, ra = _member("a")
+    nb, rb = _member("b")
+    fleet = FleetRegistry([(na, ra), (nb, rb)])
+    union = MetricsRegistry(enabled=True)
+    fmon, fclock = _monitor(fleet)
+    umon, uclock = _monitor(union)
+    fmon.evaluate()
+    umon.evaluate()
+    for i in range(20):                      # good, alternating replicas
+        (ra if i % 2 else rb).histogram(
+            "serving.ttft_seconds").observe(0.01)
+        union.histogram("serving.ttft_seconds").observe(0.01)
+    for _ in range(5):                       # bad, all on replica b
+        rb.histogram("serving.ttft_seconds").observe(1.0)
+        union.histogram("serving.ttft_seconds").observe(1.0)
+    fclock[0] = uclock[0] = 5.0
+    fs = fmon.evaluate()["targets"]["ttft"]
+    us = umon.evaluate()["targets"]["ttft"]
+    assert fs["bad_fraction_fast"] == pytest.approx(5 / 25)
+    assert fs["burn_fast"] == pytest.approx(2.0)
+    for key in ("burn_fast", "burn_slow", "bad_fraction_fast",
+                "events_fast", "breaching"):
+        assert fs[key] == us[key], key
+    assert fs["breaching"] is True
+
+
+def test_blip_suppression_still_holds_post_merge():
+    """A fast-window burst on ONE replica against a fleet-wide clean
+    slow window must not page — the multi-window behavior survives the
+    merge."""
+    na, ra = _member("a")
+    nb, rb = _member("b")
+    fleet = FleetRegistry([(na, ra), (nb, rb)])
+    mon, clock = _monitor(fleet)
+    for i in range(41):                      # 200s of good fleet history
+        clock[0] = i * 5.0
+        for j in range(10):
+            (ra if j % 2 else rb).histogram(
+                "serving.ttft_seconds").observe(0.01)
+        mon.evaluate()
+    clock[0] = 205.0
+    for _ in range(10):                      # short burst, replica b only
+        rb.histogram("serving.ttft_seconds").observe(2.0)
+    st = mon.evaluate()
+    t = st["targets"]["ttft"]
+    assert t["burn_fast"] >= 2.0
+    assert t["burn_slow"] < 2.0
+    assert st["ok"]
+
+
+# -- router Perfetto track --------------------------------------------------
+
+
+def test_router_trace_events_one_track_per_replica():
+    decisions = [
+        {"t": 1.0, "seq": 0, "tenant": "t0", "replica": "replica0",
+         "policy": "cache_aware", "matched_tokens": 0, "prompt_len": 20,
+         "candidates": 2},
+        {"t": 2.0, "seq": 1, "tenant": "t1", "replica": "replica1",
+         "policy": "cache_aware", "matched_tokens": 16, "prompt_len": 20,
+         "candidates": 2},
+        {"t": 3.0, "seq": 2, "tenant": None, "replica": "replica0",
+         "policy": "cache_aware", "matched_tokens": 16, "prompt_len": 20,
+         "candidates": 2},
+    ]
+    rows = router_trace_events(decisions)
+    names = {r["args"]["name"] for r in rows if r["name"] == "thread_name"}
+    assert names == {"replica0", "replica1"}
+    assert all(r["pid"] == PID_FLEET for r in rows)
+    markers = [r for r in rows if r["ph"] == "i"]
+    assert len(markers) == 3
+    assert markers[0]["ts"] == pytest.approx(1.0e6)
+    assert markers[1]["name"] == "route t1 +16tok"
+    assert markers[1]["args"]["matched_tokens"] == 16
+    assert markers[2]["name"] == "route default +16tok"
+    # two decisions on replica0 share its track
+    assert markers[0]["tid"] == markers[2]["tid"]
+    json.dumps(rows)                          # Perfetto rows are JSON
+
+
+# -- /debug/fleet -----------------------------------------------------------
+
+
+def test_debug_fleet_endpoint_serves_provider():
+    reg = MetricsRegistry(enabled=True)
+    payload = {"replicas": [{"name": "replica0", "state": "serving"}],
+               "serving": 1}
+    with OpsServer(registry=reg, port=0, fleet=lambda: payload) as srv:
+        body = json.loads(
+            urlopen(srv.url + "/debug/fleet", timeout=5).read())
+        assert body == payload
+        root = json.loads(urlopen(srv.url + "/", timeout=5).read())
+        assert "/debug/fleet" in root["endpoints"]
+
+
+def test_debug_fleet_404_without_provider():
+    reg = MetricsRegistry(enabled=True)
+    with OpsServer(registry=reg, port=0) as srv:
+        try:
+            urlopen(srv.url + "/debug/fleet", timeout=5)
+            assert False, "expected 404"
+        except Exception as e:  # urllib raises HTTPError on 404
+            assert getattr(e, "code", None) == 404

@@ -44,6 +44,9 @@ CASES = [
                                   "/tmp/pipegoose_elastic_demo_test"]),
     ("quantized_serving_demo.py", ["--fake-devices", "8", "--tp", "2",
                                    "--requests", "4"]),
+    ("control_plane_demo.py", ["--fake-devices", "8", "--requests", "10",
+                               "--out-dir",
+                               "/tmp/pipegoose_control_plane_demo_test"]),
 ]
 
 
