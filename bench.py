@@ -503,6 +503,11 @@ def run_bench(force_cpu: bool) -> None:
                          suffix_lens=(8, 16), max_new=8, n_tenants=3,
                          n_replicas=2, num_slots=1, num_pages=65,
                          page_size=32, max_context=192)
+            dg_kw = dict(n_requests=12, n_prefixes=3, prefix_len=96,
+                         suffix_lens=(8, 16), max_new=16, num_slots=4,
+                         prefill_pages=65, decode_pages=65, page_size=32,
+                         max_context=256, prefill_chunk=64,
+                         kv_dtype="int8")
         else:
             scfg = bloom.BloomConfig(
                 vocab_size=512, hidden_size=128, n_layer=2, n_head=4,
@@ -519,6 +524,11 @@ def run_bench(force_cpu: bool) -> None:
                          suffix_lens=(2, 4), max_new=2, n_tenants=3,
                          n_replicas=2, num_slots=1, num_pages=41,
                          page_size=8, max_context=64)
+            dg_kw = dict(n_requests=8, n_prefixes=3, prefix_len=24,
+                         suffix_lens=(2, 4), max_new=4, num_slots=2,
+                         prefill_pages=33, decode_pages=33, page_size=8,
+                         max_context=64, prefill_chunk=16,
+                         kv_dtype="int8")
         sparams = bloom.init_params(scfg, jax.random.PRNGKey(1))
         # request-trace artifact (BENCH_REQTRACE_JSON, default
         # bench_request_trace.json; empty disables): one EXTRA traced
@@ -552,6 +562,18 @@ def run_bench(force_cpu: bool) -> None:
 
             res["control_plane"] = control_plane_replay_benchmark(
                 sparams, scfg, seed=0, **cp_kw,
+            )
+            # disaggregated prefill/decode (ISSUE 13): the same skewed
+            # replay through a prefill pool streaming int8 KV pages
+            # into a decode pool vs one monolithic engine — token
+            # identity, decode-pool rate vs the monolithic decode-only
+            # rate, and the wire-vs-fp byte savings
+            from pipegoose_tpu.serving.disagg import (
+                disagg_serving_benchmark,
+            )
+
+            res["disagg"] = disagg_serving_benchmark(
+                sparams, scfg, seed=0, **dg_kw,
             )
         finally:
             if was_enabled:

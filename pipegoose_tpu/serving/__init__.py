@@ -10,6 +10,10 @@ docs/serving.md for the request lifecycle, page-table layout, the
 prefix-cache / COW / eviction semantics, and the quantization accuracy
 contract.
 """
+from pipegoose_tpu.serving.disagg import (
+    DisaggEngine,
+    disagg_serving_benchmark,
+)
 from pipegoose_tpu.serving.engine import (
     RequestOutput,
     ServingEngine,
@@ -33,6 +37,7 @@ from pipegoose_tpu.serving.prefix_cache import PrefixCache, PrefixHit
 from pipegoose_tpu.serving.scheduler import Request, Scheduler, Status
 
 __all__ = [
+    "DisaggEngine",
     "NULL_PAGE",
     "PagePool",
     "PrefixCache",
@@ -44,6 +49,7 @@ __all__ = [
     "Status",
     "copy_page",
     "dequantize_kv",
+    "disagg_serving_benchmark",
     "gather_pages",
     "init_pages",
     "make_skewed_replay",

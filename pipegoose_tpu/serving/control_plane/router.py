@@ -30,7 +30,7 @@ from typing import Any, Dict, List, Optional
 from pipegoose_tpu.serving.control_plane.replica import Replica
 from pipegoose_tpu.telemetry.registry import get_registry
 
-POLICIES = ("cache_aware", "round_robin")
+POLICIES = ("cache_aware", "round_robin", "disagg")
 
 
 class ShadowIndex:
@@ -142,6 +142,12 @@ class Router:
         no accepting replica can admit it right now — the dispatcher
         requeues and retries next tick). Pure reads: the only mutation
         anywhere is the router's own decision log/counters."""
+        if self.policy == "disagg":
+            raise ValueError(
+                "the disagg policy dispatches through route_disagg("
+                "prefill_replicas, decode_replicas) — one pool cannot "
+                "serve both roles"
+            )
         cands = [rep for rep in replicas
                  if rep.accepting and rep.engine.sched.can_admit(req)]
         if not cands:
@@ -155,44 +161,7 @@ class Router:
             tokens = req.tokens   # prompt + generated: a migrated
             # request probes with everything its re-prefill will walk,
             # so the replica that cached its prefix pre-drain wins
-            scored = []
-            for rep in cands:
-                cache = rep.engine.prefix_cache
-                m = (cache.longest_prefix_len(tokens)
-                     if cache is not None else 0)
-                shadow = self._shadows.get(rep.name)
-                if shadow is not None:
-                    # max(published, placed): the shadow covers the
-                    # publication lag, the probe is the ground truth
-                    m = max(m, shadow.longest_match(tokens))
-                snap = rep.engine.sched.capacity_snapshot()
-                load = (snap["queued_tokens"]
-                        + snap["active_tokens_remaining"])
-                headroom = snap["free_pages"] + snap["evictable_pages"]
-                scored.append((-m, load, -headroom, rep.index, rep))
-            # affinity with an imbalance guard: rank by longest match
-            # (ties: least owed tokens, most free+evictable pages,
-            # stable index) and take the FIRST candidate whose load
-            # stays within ``affinity_slack_tokens`` of the fleet
-            # minimum. Pure affinity piles a hot prefix onto one
-            # replica while its peers idle (p99 pays the queue); pure
-            # load-balancing scatters the prefix and every replica pays
-            # its own cold prefill. The guard bounds the pile-up to a
-            # fixed token debt, and a spill warms the spill target's
-            # cache, so the cost is one cold prefill per guard trip.
-            scored.sort(key=lambda s: s[:4])
-            min_load = min(s[1] for s in scored)
-            chosen = next(
-                s for s in scored
-                if s[1] <= min_load + self.affinity_slack_tokens
-            )
-            matched = -chosen[0]
-            chosen = chosen[4]
-            shadow = self._shadows.get(chosen.name)
-            if shadow is None:
-                shadow = ShadowIndex(chosen.engine.page_size)
-                self._shadows[chosen.name] = shadow
-            shadow.insert(tokens)
+            matched, chosen = self._pick_cache_aware(cands, tokens)
         chosen.dispatched += 1
         self._m_decisions.inc()
         if matched:
@@ -210,6 +179,102 @@ class Router:
             "candidates": len(cands),
         })
         return chosen
+
+    def _replica_load(self, rep: Replica, snap: Optional[dict] = None
+                      ) -> int:
+        if snap is None:
+            snap = rep.engine.sched.capacity_snapshot()
+        # transfer_tokens_owed: a staged cross-pool transfer owes only
+        # its unmaterialized tail + decode budget (scheduler ledger),
+        # but it IS load this pool will pay — count it or disagg
+        # dispatch piles onto a pool whose queue merely LOOKS empty
+        return (snap["queued_tokens"] + snap["active_tokens_remaining"]
+                + snap.get("transfer_tokens_owed", 0))
+
+    def _pick_cache_aware(self, cands: List[Replica], tokens):
+        """The cache-aware scoring shared by ``cache_aware`` routing
+        and the disagg decode-replica pin: rank every candidate by the
+        longest cached prefix it already holds — the read-only
+        ``longest_prefix_len`` probe maxed with the router-side shadow
+        (which covers the publication lag) — with an IMBALANCE GUARD:
+        take the FIRST candidate in (match desc, owed-tokens asc,
+        free+evictable pages desc, stable index) order whose load stays
+        within ``affinity_slack_tokens`` of the fleet minimum. Pure
+        affinity piles a hot prefix onto one replica while its peers
+        idle (p99 pays the queue); pure load-balancing scatters the
+        prefix and every replica pays its own cold prefill. The guard
+        bounds the pile-up to a fixed token debt, and a spill warms the
+        spill target's cache, so the cost is one cold prefill per guard
+        trip. Records the placement in the winner's shadow and returns
+        ``(matched_tokens, replica)``."""
+        scored = []
+        for rep in cands:
+            cache = rep.engine.prefix_cache
+            m = (cache.longest_prefix_len(tokens)
+                 if cache is not None else 0)
+            shadow = self._shadows.get(rep.name)
+            if shadow is not None:
+                # max(published, placed): the shadow covers the
+                # publication lag, the probe is the ground truth
+                m = max(m, shadow.longest_match(tokens))
+            snap = rep.engine.sched.capacity_snapshot()
+            headroom = snap["free_pages"] + snap["evictable_pages"]
+            scored.append((-m, self._replica_load(rep, snap), -headroom,
+                           rep.index, rep))
+        scored.sort(key=lambda s: s[:4])
+        min_load = min(s[1] for s in scored)
+        best = next(s for s in scored
+                    if s[1] <= min_load + self.affinity_slack_tokens)
+        matched, chosen = -best[0], best[4]
+        shadow = self._shadows.get(chosen.name)
+        if shadow is None:
+            shadow = ShadowIndex(chosen.engine.page_size)
+            self._shadows[chosen.name] = shadow
+        shadow.insert(tokens)
+        return matched, chosen
+
+    def route_disagg(self, req: Any, prefill_replicas: List[Replica],
+                     decode_replicas: List[Replica], now: float,
+                     seq: Optional[int] = None):
+        """Disaggregated dispatch (serving/disagg/): pick the PREFILL
+        replica by least owed work among accepting replicas that can
+        admit the prompt (their prefill-only ledgers reserve prompt
+        pages only), and PIN the DECODE replica up front — cache-aware
+        over the decode pool (longest cached prefix, shadow-covered,
+        load-guarded exactly like ``cache_aware``), because the decode
+        replica is where the request's KV will live and where a later
+        request sharing its prefix must land. Pinning at route time is
+        what makes decode-pool affinity a decision rather than
+        whatever pool had a free slot when the transfer completed.
+        Returns ``(prefill_replica, decode_replica)`` or ``None`` when
+        either pool has no candidate right now."""
+        p_cands = [rep for rep in prefill_replicas
+                   if rep.accepting and rep.engine.sched.can_admit(req)]
+        d_cands = [rep for rep in decode_replicas if rep.accepting]
+        if not p_cands or not d_cands:
+            self._m_unplaceable.inc()
+            return None
+        prefill = min(p_cands,
+                      key=lambda rep: (self._replica_load(rep), rep.index))
+        matched, decode = self._pick_cache_aware(d_cands, req.tokens)
+        prefill.dispatched += 1
+        decode.dispatched += 1
+        self._m_decisions.inc()
+        if matched:
+            self._m_cache_routed.inc()
+            self._m_matched.inc(matched)
+        self.decisions.append({
+            "t": now,
+            "seq": seq,
+            "tenant": req.tenant,
+            "policy": "disagg",
+            "replica": decode.name,      # the pin: where the KV lands
+            "prefill_replica": prefill.name,
+            "matched_tokens": matched,
+            "prompt_len": req.prompt_len,
+            "candidates": len(p_cands) + len(d_cands),
+        })
+        return prefill, decode
 
     def drop_replica(self, name: str) -> None:
         """Forget a drained/stopped replica's shadow (its cache is

@@ -1,0 +1,150 @@
+"""Disagg-vs-monolithic replay: the tentpole's acceptance meter.
+
+``disagg_serving_benchmark`` replays the same Zipf-skewed shared-prefix
+trace through (a) one monolithic ``ServingEngine`` (prefix cache +
+chunked prefill — the PR 6 production shape) and (b) a ``DisaggEngine``
+whose prefill and decode pools split the same work, reporting per arm:
+
+- tokens/s and TTFT p50/p99 (the user-visible columns);
+- the **decode-pool rate** ``generated / summed decode-step time`` —
+  for the monolithic arm that is what a decode-only engine would do
+  (its decode steps, measured, minus the prefill stalls between them);
+  for the disagg arm it is the decode pool's actual rate. The ratio is
+  the "prefill off the critical path" acceptance meter (within 10% on
+  hardware; reported, not asserted, here — CI asserts token identity);
+- the **transfer block**: wire bytes moved, their fp-equivalent, and
+  the savings ratio (int8 pools ship q + scale at ~1/(itemsize)x the
+  fp bytes — the GB-equivalent saved per the ISSUE), plus the queue
+  high-water mark;
+- the **token-identity verdict**: both arms' measured runs must emit
+  identical streams (the invariant every disagg test pins).
+
+Tiny-config friendly: bench.py's serving block runs it on CPU smoke
+and TPU geometries, and ``scripts/sweep_tpu_perf.py disagg`` sweeps it
+on hardware.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+from pipegoose_tpu.serving.disagg.engine import DisaggEngine
+from pipegoose_tpu.serving.engine import ServingEngine, make_skewed_replay
+from pipegoose_tpu.serving.scheduler import Request
+from pipegoose_tpu.telemetry.registry import Histogram, MetricsRegistry
+
+
+def _requests(replay):
+    return [Request(prompt=p, max_new_tokens=n) for p, n in replay]
+
+
+def _row(outs, wall_metrics) -> Dict:
+    h_ttft = Histogram("disagg.arm.ttft_seconds")   # standalone reservoir
+    for o in outs:
+        if o.ttft_s is not None:
+            h_ttft.observe(o.ttft_s)
+    return {
+        "decode_tokens_per_s": wall_metrics["decode_tokens_per_s"],
+        "ttft_p50_s": round(h_ttft.quantile(0.5), 6),
+        "ttft_p99_s": round(h_ttft.quantile(0.99), 6),
+        "wall_time_s": wall_metrics["wall_time_s"],
+    }
+
+
+def disagg_serving_benchmark(
+        params, config, *, n_requests: int = 12, n_prefixes: int = 3,
+        prefix_len: int = 48, suffix_lens=(2, 4, 6), max_new: int = 4,
+        seed: int = 0, zipf_a: float = 1.2, num_slots: int = 2,
+        prefill_pages: int = 33, decode_pages: int = 33,
+        page_size: int = 8, max_context: int = 96,
+        prefill_chunk: int = 16, max_inflight: int = 8,
+        kv_dtype: Optional[str] = None,
+        prefill_mesh=None, prefill_param_specs=None,
+        decode_mesh=None, decode_param_specs=None,
+        tp_axis: str = "tensor"):
+    """Measure disagg vs monolithic on one trace (module docstring);
+    returns a JSON-able dict with both arms, the transfer block, and
+    the token-identity verdict. Pass ``prefill_mesh``/``decode_mesh``
+    (+ matching param-spec trees) to put the pools on different
+    meshes — tp 2 -> 1 is the reshard the tests pin."""
+    vocab = getattr(config, "valid_vocab_size", None) or config.vocab_size
+    replay = make_skewed_replay(
+        n_requests=n_requests, n_prefixes=n_prefixes, prefix_len=prefix_len,
+        suffix_lens=suffix_lens, max_new=max_new, vocab=vocab, seed=seed,
+        zipf_a=zipf_a,
+    )
+    results: Dict = {}
+
+    # -- monolithic reference arm -----------------------------------------
+    single = ServingEngine(
+        params, config, num_slots=num_slots, num_pages=decode_pages,
+        page_size=page_size, max_context=max_context,
+        prefix_cache=True, prefill_chunk=prefill_chunk,
+        kv_dtype=kv_dtype, mesh=decode_mesh,
+        param_specs=decode_param_specs, tp_axis=tp_axis,
+    )
+    single.run(_requests(replay))           # cold warmup: compiles
+    single.run(_requests(replay))           # warm warmup: hit paths
+    ref_outs, ref_metrics = single.run(_requests(replay))
+    row = _row(ref_outs, ref_metrics)
+    step_t = ref_metrics.get("decode_step_time_s", 0.0)
+    row["decode_only_tokens_per_s"] = round(
+        ref_metrics["generated_tokens"] / max(step_t, 1e-9), 2
+    ) if step_t else 0.0
+    row["prefill_tokens"] = ref_metrics["prefill_tokens"]
+    results["single"] = row
+
+    # -- disagg arm --------------------------------------------------------
+    def build():
+        pe = ServingEngine(
+            params, config, num_slots=num_slots, num_pages=prefill_pages,
+            page_size=page_size, max_context=max_context,
+            prefix_cache=True, prefill_chunk=prefill_chunk,
+            prefill_only=True, kv_dtype=kv_dtype, mesh=prefill_mesh,
+            param_specs=prefill_param_specs, tp_axis=tp_axis,
+            registry=MetricsRegistry(),
+        )
+        de = ServingEngine(
+            params, config, num_slots=num_slots, num_pages=decode_pages,
+            page_size=page_size, max_context=max_context,
+            prefix_cache=True, prefill_chunk=prefill_chunk,
+            kv_dtype=kv_dtype, mesh=decode_mesh,
+            param_specs=decode_param_specs, tp_axis=tp_axis,
+            registry=MetricsRegistry(), stall_patience=10_000,
+        )
+        return DisaggEngine(pe, de, max_inflight=max_inflight,
+                            registry=MetricsRegistry())
+
+    disagg = build()
+    disagg.run(_requests(replay))           # cold warmup
+    disagg.run(_requests(replay))           # warm warmup
+    outs, metrics = disagg.run(_requests(replay))
+    row = _row(outs, metrics)
+    row["decode_pool_tokens_per_s"] = metrics["decode_pool_tokens_per_s"]
+    row["prefill_tokens"] = metrics["prefill_pool"]["prefill_tokens"]
+    row["transfer"] = metrics["transfer"]
+    results["disagg"] = row
+
+    identical = len(ref_outs) == len(outs) and all(
+        np.array_equal(a.generated, b.generated)
+        for a, b in zip(ref_outs, outs)
+    )
+    xfer = metrics["transfer"]
+    results["summary"] = {
+        "requests": n_requests,
+        "kv_dtype": kv_dtype or "fp",
+        "outputs_token_identical": bool(identical),
+        # prefill off the decode pool's critical path: its measured
+        # rate vs the monolithic arm's decode-only rate
+        "decode_pool_vs_decode_only": round(
+            row["decode_pool_tokens_per_s"]
+            / max(results["single"]["decode_only_tokens_per_s"], 1e-9), 3,
+        ),
+        "transfer_wire_mb": round(xfer["wire_bytes"] / 1e6, 3),
+        "transfer_fp_equiv_mb": round(xfer["fp_equiv_bytes"] / 1e6, 3),
+        "wire_savings_ratio": xfer["wire_savings_ratio"],
+        "max_queue_depth": xfer["max_queue_depth"],
+        "fallbacks": xfer["fallbacks"],
+    }
+    return results

@@ -1,0 +1,226 @@
+"""DisaggEngine: prefill on one mesh, decode on another, KV in flight.
+
+The orchestrator over one prefill pool and one decode pool (ROADMAP
+item 2): prefill is compute-bound, decode is HBM-bandwidth-bound, and
+one engine on one mesh sizes both wrong. Here each pool is an ordinary
+``ServingEngine`` on ITS OWN mesh (geometry may differ — tp_prefill=2
+feeding tp_decode=1 is the canonical reshard), driven tick-by-tick in
+one host thread through the steppable-run API, with the page-granular
+transfer primitive (transfer.py) between them:
+
+    while work remains:
+        tick the prefill engine        (unless the transfer queue is
+                                        full — backpressure)
+        stream completed pages         (chunk boundary = streaming
+                                        boundary; pages ship as soon
+                                        as their content is final)
+        service the transfer queue     (stage -> import -> mark
+                                        complete on the decode pool)
+        admit materialized requests    (admit_with_pages: decode
+                                        starts, no prefill ever runs
+                                        on the decode pool)
+        tick the decode engine
+        collect finished requests
+
+Invariants the tests pin:
+
+- **Token identity.** Greedy output is token-identical to a single
+  engine serving the same requests — across fp and int8-KV pools and
+  across the tp 2 -> 1 reshard. The wire never changes a value the
+  attention core reads: int8 pages ship q + scale verbatim, fp pages
+  ship at pool precision by default.
+- **Exact attribution.** With a shared ``RequestTracer``, every
+  request's queue + prefill + transfer + decode + stall components sum
+  to its e2e exactly — ``transfer`` is a first-class phase, not decode
+  noise.
+- **Bounded in-flight.** The transfer queue is the only buffer; its
+  bound pauses prefill rather than queueing host slabs unboundedly.
+- **Fallback.** A failed shipment aborts the staging and re-prefills
+  on the decode pool (same tokens, by determinism); the disagg run
+  finishes every request either way.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Optional, Sequence
+
+from pipegoose_tpu.serving.disagg.transfer import (
+    PageHandoff,
+    PoolTransfer,
+    TransferQueue,
+)
+from pipegoose_tpu.serving.disagg.workers import DecodeWorker, PrefillWorker
+from pipegoose_tpu.serving.scheduler import Request
+from pipegoose_tpu.telemetry.registry import get_registry
+
+
+class DisaggEngine:
+    """Two-pool disaggregated serving orchestrator.
+
+    ``prefill_engine`` must be ``prefill_only`` (with ``prefill_chunk``
+    — the streaming boundary); ``decode_engine`` must have the paged
+    prefill path enabled (the fallback re-prefills there). Pools must
+    share page geometry and ``kv_dtype``; page counts and meshes may
+    differ. ``max_inflight`` bounds queued shipments (backpressure);
+    ``wire_dtype="bf16"`` opts fp pools into a half-width wire
+    (transfer.py's precision caveats apply). ``tracer`` attaches ONE
+    shared ``RequestTracer`` to both engines so the attribution
+    contract spans the whole pipeline."""
+
+    def __init__(self, prefill_engine, decode_engine, *,
+                 max_inflight: int = 8,
+                 wire_dtype: Optional[str] = None,
+                 registry=None, tracer=None,
+                 stall_patience: int = 1000):
+        if stall_patience < 1:
+            raise ValueError(
+                f"stall_patience must be >= 1, got {stall_patience}"
+            )
+        self.registry = registry if registry is not None else get_registry()
+        reg = self.registry
+        self._m_handoffs = reg.counter("serving.transfer.handoffs_total")
+        self._m_pages = reg.counter("serving.transfer.pages_total")
+        self._m_bytes = reg.counter("serving.transfer.bytes_total")
+        self._m_failures = reg.counter("serving.transfer.failures_total")
+        self._m_fallbacks = reg.counter("serving.transfer.fallbacks_total")
+        self._h_bytes = reg.histogram("serving.transfer.bytes")
+        self._h_lat = reg.histogram("serving.transfer.seconds")
+        self._m_qdepth = reg.gauge("serving.transfer.queue_depth")
+        self.stall_patience = stall_patience
+        # plain host tallies next to the registry instruments, so the
+        # run metrics stay truthful even under a disabled registry
+        self.total_handoffs = self.total_pages = self.total_bytes = 0
+        self.transfer = PoolTransfer(prefill_engine, decode_engine,
+                                     wire_dtype=wire_dtype)
+        self.queue = TransferQueue(max_inflight)
+        self.prefill = PrefillWorker(prefill_engine, self.queue,
+                                     self.transfer)
+        self.decode = DecodeWorker(decode_engine, self.transfer,
+                                   owner=self)
+        if tracer is not None:
+            prefill_engine.attach_tracer(tracer)
+            decode_engine.attach_tracer(tracer)
+        self.tracer = tracer
+
+    # -- shipment telemetry (DecodeWorker calls back) ----------------------
+
+    def _observe_shipment(self, rec: PageHandoff, t: float) -> None:
+        self.total_pages += rec.n_pages
+        self.total_bytes += rec.wire_bytes
+        self._m_pages.inc(rec.n_pages)
+        self._m_bytes.inc(rec.wire_bytes)
+        self._h_bytes.observe(float(rec.wire_bytes))
+        self._h_lat.observe(max(t - rec.t_created, 0.0))
+        if rec.final:
+            self.total_handoffs += 1
+            self._m_handoffs.inc()
+
+    # -- the loop ----------------------------------------------------------
+
+    def _busy(self) -> bool:
+        pe, de = self.prefill.engine, self.decode.engine
+        return (not pe.sched.all_done() or len(self.queue) > 0
+                or self.decode.pending > 0 or not de.sched.all_done())
+
+    def run(self, requests: Sequence[Request], now=time.perf_counter,
+            tick_hook=None):
+        """Serve ``requests`` through the two pools to completion;
+        returns (outputs in uid order, metrics dict — pool metrics
+        plus the ``transfer`` block). ``tick_hook(engine, tick)`` is
+        the orchestration/test seam."""
+        pe, de = self.prefill.engine, self.decode.engine
+        if pe.run_in_progress or de.run_in_progress:
+            # guard BEFORE start_run: the exception path below aborts
+            # both engines, which must never tear down a live outer run
+            raise RuntimeError("a disagg run is already in progress")
+        pe.start_run((), now=now)
+        de.start_run((), now=now)
+        outputs: Dict[int, Any] = {}
+        # per-RUN deltas: the tallies are lifetime (warmup runs would
+        # otherwise pollute a measured run's transfer block)
+        h0, p0, b0 = (self.total_handoffs, self.total_pages,
+                      self.total_bytes)
+        f0, fb0 = self.decode.failures, self.decode.fallbacks
+        self.queue.reset_depth_mark()   # per-run high-water, like the rest
+        t0 = now()
+        tick = stalled = 0
+        try:
+            for req in requests:
+                pe.submit_request(req)
+            while self._busy():
+                tick += 1
+                if tick_hook is not None:
+                    tick_hook(self, tick)
+                progressed = False
+                if not pe.sched.all_done() and self.queue.has_room():
+                    # queue full = backpressure: the prefill pool
+                    # pauses instead of racing ahead of a decode pool
+                    # that cannot stage reservations yet
+                    progressed = pe.tick_once() or progressed
+                progressed = self.prefill.stream_ready(now) > 0 or progressed
+                progressed = self.decode.service(self.queue, now) > 0 \
+                    or progressed
+                progressed = self.decode.admit_ready(now) > 0 or progressed
+                if not de.sched.all_done():
+                    progressed = de.tick_once() or progressed
+                for req, out in de.take_finished():
+                    outputs[out.uid] = out
+                    progressed = True
+                for req, out in pe.take_finished():
+                    outputs[out.uid] = out   # prefill-side sheds only
+                    progressed = True
+                self._m_qdepth.set(float(len(self.queue)))
+                if progressed:
+                    stalled = 0
+                else:
+                    stalled += 1
+                    if stalled >= self.stall_patience:
+                        raise RuntimeError(
+                            f"disagg stall: no progress for "
+                            f"{self.stall_patience} ticks — "
+                            f"{len(self.queue)} queued shipments, "
+                            f"{self.decode.pending} staged, prefill "
+                            f"done={pe.sched.all_done()}, decode "
+                            f"done={de.sched.all_done()}"
+                        )
+            _, pmetrics = pe.finish_run()
+            _, dmetrics = de.finish_run()
+        except BaseException:
+            pe.abort_run()
+            de.abort_run()
+            raise
+        wall = max(now() - t0, 1e-9)
+        outs = [outputs[uid] for uid in sorted(outputs)]
+        generated = sum(len(o.generated) for o in outs)
+        step_time = dmetrics.get("decode_step_time_s", 0.0)
+        metrics = {
+            "wall_time_s": round(wall, 6),
+            "requests": len(outs),
+            "generated_tokens": generated,
+            "decode_tokens_per_s": round(generated / wall, 2),
+            # the decode POOL's intrinsic rate (prefill + transfer off
+            # its critical path): generated / summed decode-step time
+            "decode_pool_tokens_per_s": round(
+                generated / max(step_time, 1e-9), 2
+            ) if step_time else 0.0,
+            "shed_requests": sum(
+                1 for o in outs if o.finish_reason == "shed"
+            ),
+            "transfer": {
+                "handoffs": self.total_handoffs - h0,
+                "pages": self.total_pages - p0,
+                "wire_bytes": self.total_bytes - b0,
+                "fp_equiv_bytes": ((self.total_pages - p0)
+                                   * self.transfer.fp_page_bytes),
+                "failures": self.decode.failures - f0,
+                "fallbacks": self.decode.fallbacks - fb0,
+                "max_queue_depth": self.queue.max_depth,
+            },
+            "prefill_pool": pmetrics,
+            "decode_pool": dmetrics,
+        }
+        fp_eq = metrics["transfer"]["fp_equiv_bytes"]
+        metrics["transfer"]["wire_savings_ratio"] = round(
+            1.0 - metrics["transfer"]["wire_bytes"] / fp_eq, 4
+        ) if fp_eq else 0.0
+        return outs, metrics

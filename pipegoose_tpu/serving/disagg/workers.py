@@ -1,0 +1,264 @@
+"""The two pool-side halves of disaggregated serving.
+
+``PrefillWorker`` rides a ``prefill_only`` engine (compute-dense pool):
+it streams each request's COMPLETED full pages into the transfer queue
+as soon as a chunk finishes — not after the whole prefill — and, via
+the engine's handoff hook, ships the final partial page together with
+the first token the last chunk's logits produced, at which point the
+request leaves the prefill scheduler entirely (slot, pages and
+reservation freed; cache-shared pages survive in the prefill pool's
+prefix cache for the next request with the same prefix).
+
+``DecodeWorker`` rides a normal paged engine (bandwidth-dense pool):
+it stages inbound requests against the decode scheduler's transfer
+ledger (``begin_transfer`` reserves the FULL decode worst case before
+the first page lands — the never-strand contract), imports shipments
+in order, and admits a request into a decode slot the moment its page
+table is fully materialized (``admit_with_pages`` — no prefill ever
+runs for it here). A shipment that fails (:class:`~pipegoose_tpu.
+serving.disagg.transfer.TransferError`) aborts the staging and, once
+the request has fully left the prefill pool (its final record drained),
+falls back to a LOCAL re-prefill on the decode engine — greedy
+determinism makes the fallback's tokens identical to the transfer
+path's.
+
+Both workers are host-side orchestration; the only device programs are
+the engines' own compiled steps plus the pool pair's export/import
+gather/scatter (transfer.py).
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Set
+
+from pipegoose_tpu.serving.disagg.transfer import (
+    PageHandoff,
+    PoolTransfer,
+    TransferError,
+    TransferQueue,
+)
+from pipegoose_tpu.serving.scheduler import Request, Status
+
+
+class PrefillWorker:
+    """Streams finished prefix pages off a ``prefill_only`` engine.
+
+    ``stream_ready`` runs after each engine tick: any PREFILL request
+    whose ``prefilled_len`` crossed new full-page boundaries has those
+    pages' content FINAL (chunked prefill writes strictly forward), so
+    they export immediately — the decode pool starts materializing the
+    page table while later chunks still compute. The handoff hook (the
+    engine calls it at prefill completion, before the scheduler
+    releases anything) ships the tail.
+
+    Preemption-safe: a preempted prefill re-prefills BYTE-identical
+    page content (token values alone determine it, quantized or not),
+    so pages streamed before the preemption stay valid and are never
+    re-shipped."""
+
+    def __init__(self, engine, queue: TransferQueue,
+                 transfer: PoolTransfer):
+        if not getattr(engine, "prefill_only", False):
+            raise ValueError(
+                "PrefillWorker needs a prefill_only engine — a normal "
+                "engine would reserve decode pages this pool never "
+                "writes and try to decode instead of handing off"
+            )
+        self.engine = engine
+        self.queue = queue
+        self.transfer = transfer
+        self._streamed: Dict[int, int] = {}    # uid -> pages shipped
+        engine.set_handoff_hook(self._handoff)
+
+    def stream_ready(self, now) -> int:
+        """Export every newly completed full page of every in-flight
+        prefill, bounded by the queue's room. Returns shipments made."""
+        n_recs = 0
+        ps = self.engine.page_size
+        for req in self.engine.sched.active():
+            if req.status is not Status.PREFILL:
+                continue
+            stable = min(req.prefilled_len, req.prompt_len) // ps
+            start = self._streamed.get(req.uid, 0)
+            while start < stable and self.queue.has_room():
+                end = min(start + self.transfer.width, stable)
+                self._push(req, start, end, final=False,
+                           first_token=None, t=now())
+                start = end
+                n_recs += 1
+            if start:
+                self._streamed[req.uid] = start
+        return n_recs
+
+    def _handoff(self, engine, req: Request, first_token: int,
+                 t: float) -> None:
+        """Engine handoff hook: ship whatever was not streamed yet —
+        including the final partial page — with the first token. Runs
+        BEFORE finish_handoff releases the pages."""
+        total = engine.pool.pages_for(req.prompt_len)
+        start = self._streamed.pop(req.uid, 0)
+        # the final shipment may span several widths when streaming was
+        # backpressured; all but the last ride as plain chunks
+        while total - start > self.transfer.width:
+            end = start + self.transfer.width
+            self._push(req, start, end, final=False, first_token=None, t=t)
+            start = end
+        self._push(req, start, total, final=True,
+                   first_token=first_token, t=t)
+
+    def _push(self, req: Request, p0: int, p1: int, *, final: bool,
+              first_token: Optional[int], t: float) -> None:
+        ids = req.pages[p0:p1]
+        k, v, nbytes = self.transfer.export(ids)
+        end_tokens = min(p1 * self.engine.page_size, req.prompt_len)
+        self.queue.push(PageHandoff(
+            req=req, page_index=p0, n_pages=len(ids),
+            tokens_end=end_tokens, k=k, v=v, wire_bytes=nbytes,
+            final=final, first_token=first_token, t_created=t,
+        ))
+
+
+class DecodeWorker:
+    """Stages, imports, and admits inbound transfers on the decode
+    pool; owns the transfer-failure fallback."""
+
+    def __init__(self, engine, transfer: PoolTransfer, owner=None):
+        if not getattr(engine, "_paged_prefill", False):
+            raise ValueError(
+                "DecodeWorker needs the paged prefill path on the "
+                "decode engine (prefix_cache=True and/or "
+                "prefill_chunk=) — the transfer-failure fallback "
+                "re-prefills locally"
+            )
+        if getattr(engine, "prefill_only", False):
+            raise ValueError("the decode engine cannot be prefill_only")
+        self.engine = engine
+        self.transfer = transfer
+        self.owner = owner                     # DisaggEngine (metrics)
+        self._staged: Dict[int, dict] = {}     # uid -> {req, first_token,
+        #                                        complete}
+        self._failed: Set[int] = set()         # uids awaiting fallback
+        self.fallbacks = 0
+        self.failures = 0
+
+    # -- the per-tick drains ----------------------------------------------
+
+    def service(self, queue: TransferQueue, now) -> int:
+        """Drain the transfer queue: stage (reserve) on first contact,
+        import each shipment in order, mark complete at the final
+        record. STAGING is head-of-line: when the decode ledger cannot
+        cover a new request's worst case, no request behind it stages
+        either (FIFO-deterministic, no starvation) — but records of
+        ALREADY-STAGED requests behind the blocked one still import
+        (their reservations were made; finishing them is exactly what
+        frees the ledger for the blocked head — skipping them would
+        deadlock the very backpressure this implements). Per-request
+        record order is preserved (the scan keeps relative order).
+        Returns shipments imported."""
+        n = 0
+        sched = self.engine.sched
+        staging_blocked = False
+        for rec in list(queue._q):
+            req = rec.req
+            if req.uid in self._failed:
+                # a failed request's stragglers drain without import;
+                # the FINAL record marks the prefill pool done with it
+                # — only then may the fallback re-own the request
+                queue.remove(rec)
+                if rec.final:
+                    self._failed.discard(req.uid)
+                    self._fallback(req)
+                continue
+            if req.uid not in self._staged:
+                if staging_blocked or not sched.begin_transfer(req, now()):
+                    # ledger full: this uid (and, for fairness, every
+                    # unstaged uid behind it) retries next tick
+                    staging_blocked = True
+                    continue
+                self._staged[req.uid] = {
+                    "req": req, "first_token": None, "complete": False,
+                }
+            t0 = now()
+            try:
+                if rec.n_pages:
+                    pages = sched.transfer_pages(req, rec.tokens_end)
+                    dst = pages[
+                        rec.page_index:rec.page_index + rec.n_pages
+                    ]
+                    self.transfer.import_(rec, dst)
+                elif rec.final:
+                    # zero-page final: still route through the fault
+                    # seam so an injected failure on it exercises the
+                    # fallback too
+                    self.transfer.import_(rec, [])
+            except TransferError as e:
+                queue.remove(rec)
+                self._fail(req, e, final_seen=rec.final)
+                continue
+            queue.remove(rec)
+            n += 1
+            t1 = now()
+            st = self._staged[req.uid]
+            if rec.final:
+                st["first_token"] = rec.first_token
+                st["complete"] = True
+            self._observe(rec, req, t0, t1)
+        return n
+
+    def admit_ready(self, now) -> int:
+        """Admit every fully materialized staged request into a free
+        decode slot (insertion order — deterministic). Returns
+        admissions made."""
+        n = 0
+        for uid in list(self._staged):
+            st = self._staged[uid]
+            if not st["complete"]:
+                continue
+            if not self.engine.admit_transferred(st["req"],
+                                                 st["first_token"]):
+                break                  # no free slot: retry next tick
+            del self._staged[uid]
+            n += 1
+        return n
+
+    @property
+    def pending(self) -> int:
+        return len(self._staged) + len(self._failed)
+
+    # -- failure path ------------------------------------------------------
+
+    def _fail(self, req: Request, err: TransferError,
+              final_seen: bool) -> None:
+        self.failures += 1
+        if self.owner is not None:
+            self.owner._m_failures.inc()
+        st = self._staged.pop(req.uid, None)
+        if st is not None:
+            self.engine.sched.abort_transfer(req)
+        if final_seen:
+            self._fallback(req)        # prefill pool already released it
+        else:
+            self._failed.add(req.uid)  # wait for the final record
+
+    def _fallback(self, req: Request) -> None:
+        """Local re-prefill: the decode engine's own paged prefill
+        serves the request from scratch (hitting its prefix cache
+        where transferred-in neighbors already published the prefix).
+        Greedy determinism keeps the tokens identical to the transfer
+        path's — the contract the fallback test pins."""
+        self.fallbacks += 1
+        if self.owner is not None:
+            self.owner._m_fallbacks.inc()
+        self.engine.submit_request(req, reuse_uid=True)
+
+    def _observe(self, rec: PageHandoff, req: Request, t0: float,
+                 t1: float) -> None:
+        tr = self.engine.tracer
+        if tr is not None:
+            tr.on_transfer_chunk(
+                req, t1, dur_s=t1 - t0,
+                tokens=rec.tokens_end - rec.page_index
+                * self.engine.page_size,
+                pages=rec.n_pages, nbytes=rec.wire_bytes,
+            )
+        if self.owner is not None:
+            self.owner._observe_shipment(rec, t1)

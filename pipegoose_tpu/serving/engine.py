@@ -130,7 +130,7 @@ class _RunState:
         "per_request", "generated_total", "shed_count", "steps",
         "prefills", "chunks", "spec_drafted", "spec_accepted",
         "occ_slots", "occ_pages", "stalled", "tick", "t_last_decode",
-        "max_gap", "table", "seq_lens", "tokens",
+        "max_gap", "step_time", "table", "seq_lens", "tokens",
     )
 
     def __init__(self, engine: "ServingEngine", now, tick_hook):
@@ -150,6 +150,7 @@ class _RunState:
         self.tick = 0
         self.t_last_decode: Optional[float] = None
         self.max_gap = 0.0
+        self.step_time = 0.0            # summed decode-step wall time
         self.table = np.zeros((engine.num_slots, engine.table_width),
                               np.int32)
         self.seq_lens = np.zeros((engine.num_slots,), np.int32)
@@ -181,7 +182,8 @@ class ServingEngine:
                  tracer=None,
                  weight_dtype: Optional[str] = None,
                  kv_dtype: Optional[str] = None,
-                 weight_group_size: int = 32):
+                 weight_group_size: int = 32,
+                 prefill_only: bool = False):
         """``recorder``: optional ``telemetry.FlightRecorder`` — every
         decode step lands in its ring, and the no-decode-progress
         watchdog dumps a black box through it before raising.
@@ -205,9 +207,23 @@ class ServingEngine:
         quantize-on-write, dequantize-in-gather (serving/kv_pool.py).
         ``weight_group_size``: int4 contraction-group width. Both
         default OFF: a default-constructed engine builds the exact
-        PR 1/6 programs, byte for byte."""
+        PR 1/6 programs, byte for byte.
+
+        ``prefill_only=True`` turns the engine into a disaggregated
+        PREFILL POOL (serving/disagg/): the admission ledger reserves
+        only ``pages_for(prompt)`` (nothing here ever decodes), and a
+        completed prefill HANDS OFF — first token + exported KV pages —
+        through the handoff hook (:meth:`set_handoff_hook`) instead of
+        entering decode. Requires ``prefill_chunk`` (the chunk is the
+        streaming boundary) and a hook before the first run."""
         if max_context % page_size:
             raise ValueError("max_context must be a multiple of page_size")
+        if prefill_only and prefill_chunk is None:
+            raise ValueError(
+                "prefill_only requires prefill_chunk: the chunk is the "
+                "disagg streaming boundary (and the monolithic prefill "
+                "path cannot hand off)"
+            )
         if stall_patience < 1:
             raise ValueError(f"stall_patience must be >= 1, got {stall_patience}")
         if speculative is not None:
@@ -309,11 +325,17 @@ class ServingEngine:
         self.pool = PagePool(num_pages, page_size)
         self._run_prefill_tokens = self._run_hit_tokens = 0  # set per run()
         self.prefix_cache = PrefixCache(self.pool) if prefix_cache else None
+        self.prefill_only = prefill_only
+        # disagg handoff seam: hook(engine, req, first_token, t) runs at
+        # prefill completion BEFORE the scheduler releases the pages, so
+        # it can export them (serving/disagg/workers.py)
+        self._handoff_hook = None
         self.sched = Scheduler(num_slots, self.pool, max_context,
                                continuous=continuous,
                                prefix_cache=self.prefix_cache,
                                chunk_tokens=prefill_chunk,
-                               tracer=tracer)
+                               tracer=tracer,
+                               prefill_only=prefill_only)
         # paged prefill path: required by the cache (the tail attends to
         # shared pages) and by chunking; the legacy monolithic
         # forward_cached + write_prompt_pages path stays the default
@@ -612,6 +634,53 @@ class ServingEngine:
         if self.recorder is not None:
             self.recorder.set_request_tracer(tracer)
 
+    def set_handoff_hook(self, hook) -> None:
+        """Install (or clear, with None) the disagg handoff seam:
+        ``hook(engine, req, first_token, t)`` runs at each prefill's
+        completion, BEFORE the scheduler releases the request's pages —
+        the one moment the finished prompt KV is both complete and
+        still addressable for export (serving/disagg/workers.py's
+        PrefillWorker is the production hook)."""
+        self._handoff_hook = hook
+
+    def admit_transferred(self, req: Request, first_token: int) -> bool:
+        """Disagg decode-pool admission: bind a fully materialized
+        transfer (every page imported at wire precision) to a free
+        slot, skipping prefill — ``Scheduler.admit_with_pages`` does
+        the lifecycle; this wrapper adds the engine bookkeeping a
+        normal admission would have accrued (request counter, prefix-
+        cache publication of the transferred-in prompt pages so later
+        LOCAL re-prefills hit them, run-state done collection when the
+        request finishes at admission). Returns False when no slot is
+        free (the staged transfer keeps its pages + reservation)."""
+        rs = self._run
+        if rs is None:
+            raise RuntimeError("admit_transferred needs start_run first")
+        if self.prefix_cache is not None:
+            # transferred-in pages are real prompt KV with FINAL
+            # content: publish the full pages exactly like a local
+            # prefill would, so a fallback (or migrated) request
+            # sharing the prefix hits them. BEFORE admission, from the
+            # stage record — a request finishing AT admission
+            # (max_new=1/eos) releases its pages inside
+            # admit_with_pages, and publishing freed pages would be a
+            # no-op at best
+            stage = self.sched.transfers.get(req.uid)
+            if stage is not None:
+                n_full = req.prompt_len // self.page_size
+                self.prefix_cache.insert(
+                    np.asarray(req.prompt)[:n_full * self.page_size],
+                    stage["pages"][:n_full],
+                )
+                self._m_cached.set(self.prefix_cache.cached_pages)
+        if not self.sched.admit_with_pages(req, first_token, rs.now()):
+            return False
+        self._m_requests.inc()
+        self._observe_ttft(req)
+        if req.status is Status.DONE:
+            rs.done.append(req)
+        return True
+
     def _observe_ttft(self, req: Request) -> None:
         """Record TTFT into the histogram EXACTLY ONCE per request. Two
         engine paths can complete a prefill (the monolithic
@@ -740,6 +809,18 @@ class ServingEngine:
             )
             self._m_cached.set(self.prefix_cache.cached_pages)
         self._m_prefills.inc()
+        if self._handoff_hook is not None:
+            # disagg prefill pool: the first token exists NOW — hand it
+            # off with the remaining un-streamed pages instead of
+            # decoding here. The hook exports from the still-allocated
+            # pages; finish_handoff then frees slot + pages +
+            # reservation and opens the transfer attribution phase.
+            t1 = now()
+            self._m_tokens.inc()       # the prefill's token, as always
+            self._handoff_hook(self, req, int(tok), t1)
+            self.sched.finish_handoff(req, t1)
+            self._observe_ttft(req)
+            return
         if req.generated:
             # resumed after preemption: the forwarded tail's last logits
             # re-derive the pending token (greedy is deterministic);
@@ -748,9 +829,18 @@ class ServingEngine:
             if tr is not None:
                 tr.on_resume(req, now())
             return
+        had_first = req.t_first_token is not None
         self.sched.record_token(req, tok, now())
         self._m_tokens.inc()
         self._observe_ttft(req)
+        if tr is not None and had_first and req.status is not Status.DONE:
+            # disagg transfer-failure fallback: the request already
+            # carries its handoff-time first token, so record_token
+            # fired no first-token hook — without this resume the
+            # timeline would book the whole decode as prefill (a DONE
+            # request's timeline just closed; re-opening it would leak
+            # a ghost)
+            tr.on_resume(req, now())
 
     def _spec_cycle(self, rows: List[Request], now, done: List[Request]):
         """One speculative decode cycle over the active batch: draft up
@@ -852,7 +942,7 @@ class ServingEngine:
             f"{self.pool.free_count}/{self.pool.capacity} pages free"
         )
         if head is not None:
-            worst = self.pool.pages_for(head.prompt_len + head.max_new_tokens)
+            worst = self.pool.pages_for(self.sched._worst_tokens(head))
             reason += (
                 f"; queue head uid={head.uid} needs {worst} pages worst-case"
             )
@@ -919,6 +1009,12 @@ class ServingEngine:
         :meth:`finish_run`."""
         if self._run is not None:
             raise RuntimeError("a serving run is already in progress")
+        if self.prefill_only and self._handoff_hook is None:
+            raise RuntimeError(
+                "a prefill_only engine needs a handoff hook before it "
+                "runs (set_handoff_hook) — finished prefills have "
+                "nowhere to go otherwise"
+            )
         self._run_prefill_tokens = 0   # prompt tokens forwarded this run
         self._run_hit_tokens = 0       # prompt tokens served by the cache
         if self.tracer is not None:
@@ -931,15 +1027,16 @@ class ServingEngine:
             self.submit_request(r)
         rs.t0 = now()
 
-    def submit_request(self, req: Request) -> None:
+    def submit_request(self, req: Request, reuse_uid: bool = False) -> None:
         """Mid-run ingress — the control-plane router's dispatch entry
         point (and the drain path's re-admission target: a migrated
         request keeps its first-submission timestamps, see
-        ``Scheduler.submit``)."""
+        ``Scheduler.submit``). ``reuse_uid=True`` keeps an existing
+        cross-scheduler uid (the disagg transfer-failure fallback)."""
         rs = self._run
         if rs is None:
             raise RuntimeError("submit_request needs start_run first")
-        self.sched.submit(req, rs.now())
+        self.sched.submit(req, rs.now(), reuse_uid=reuse_uid)
         self._m_requests.inc()
         self._m_queue.set(len(self.sched.queue))
 
@@ -1058,6 +1155,7 @@ class ServingEngine:
             rs.max_gap = max(rs.max_gap, gap)
         rs.t_last_decode = t
         rs.steps += 1
+        rs.step_time += t - t_step
         slot_occ = len(active) / self.num_slots
         page_occ = self.pool.used_count / self.pool.capacity
         rs.occ_slots += slot_occ
@@ -1203,6 +1301,10 @@ class ServingEngine:
         metrics = {
             "wall_time_s": round(wall, 6),
             "decode_steps": rs.steps,
+            # summed decode-step wall time: generated / this = the
+            # decode-POOL rate (prefill stalls excluded) — the disagg
+            # bench's "prefill off the critical path" meter
+            "decode_step_time_s": round(rs.step_time, 6),
             "prefills": rs.prefills,
             "generated_tokens": rs.generated_total,
             "decode_tokens_per_s": round(rs.generated_total / wall, 2),

@@ -426,6 +426,47 @@ def paged_decode_step(params, tokens, k_pages, v_pages, page_table, seq_lens,
     return logits, k_pages, v_pages
 
 
+def export_page_slab(pages, page_ids, wire_dtype=None):
+    """Page EXPORT view for cross-pool KV streaming (serving/disagg/):
+    gather ``page_ids`` (W,) int32 out of one bank into a contiguous
+    slab ``(L, W, ps, nh, hd)`` at WIRE precision. An int8 bank ships
+    its ``{"q", "scale"}`` planes verbatim — quantized pages are NEVER
+    dequantized in flight (the whole point of the int8 wire format);
+    an fp bank optionally down-casts to ``wire_dtype="bf16"`` (the
+    distributed/compressed.py convention — exact when the pool dtype
+    is already bf16, lossy for an fp32 pool). Pure jax: jit it on the
+    source pool's mesh and the gather resolves this shard's heads; the
+    host fetch of the result is the resharding point."""
+    if _is_quantized(pages):
+        if wire_dtype is not None:
+            raise ValueError(
+                "int8 pools define their own wire format (q + scale); "
+                f"wire_dtype={wire_dtype!r} does not apply"
+            )
+        return {"q": jnp.take(pages["q"], page_ids, axis=1),
+                "scale": jnp.take(pages["scale"], page_ids, axis=1)}
+    slab = jnp.take(pages, page_ids, axis=1)
+    if wire_dtype == "bf16":
+        return slab.astype(jnp.bfloat16)
+    if wire_dtype is not None:
+        raise ValueError(f"unknown wire_dtype {wire_dtype!r} "
+                         f"(fp pools support None or 'bf16')")
+    return slab
+
+
+def import_page_slab(pages, slab, dst_ids):
+    """Page IMPORT view: scatter a wire slab into ``dst_ids`` (W,) of
+    one bank. The quantized layout lands q and scale planes together
+    (still never dequantized — the decode pool's gather does that, per
+    read, like for locally written pages); a bf16 wire slab up-casts to
+    the pool dtype here. Padding entries route to the NULL page, the
+    same sink every other pad write uses."""
+    if _is_quantized(pages):
+        return {"q": pages["q"].at[:, dst_ids].set(slab["q"]),
+                "scale": pages["scale"].at[:, dst_ids].set(slab["scale"])}
+    return pages.at[:, dst_ids].set(slab.astype(pages.dtype))
+
+
 def copy_page(k_pages, v_pages, src, dst):
     """Copy-on-write duplication: device-copy one physical page (every
     layer's k and v planes) from ``src`` to ``dst``. The prefix cache

@@ -56,6 +56,10 @@ class Status(enum.Enum):
     QUEUED = "queued"
     PREFILL = "prefill"
     DECODE = "decode"
+    # disaggregated serving (serving/disagg/): the request's KV pages
+    # are in flight between pools — left the prefill scheduler via
+    # finish_handoff, staged on the decode scheduler via begin_transfer
+    TRANSFER = "transfer"
     DONE = "done"
 
 
@@ -132,7 +136,8 @@ class Request:
 class Scheduler:
     def __init__(self, num_slots: int, pool: PagePool, max_context: int,
                  continuous: bool = True, prefix_cache=None,
-                 chunk_tokens: Optional[int] = None, tracer=None):
+                 chunk_tokens: Optional[int] = None, tracer=None,
+                 prefill_only: bool = False):
         if num_slots < 1:
             raise ValueError("need at least one decode slot")
         if chunk_tokens is not None and (
@@ -148,6 +153,14 @@ class Scheduler:
         self.continuous = continuous
         self.cache = prefix_cache
         self.chunk_tokens = chunk_tokens
+        # disaggregated prefill pool (serving/disagg/): requests here
+        # only ever hold their PROMPT's pages — they hand off to a
+        # decode pool at prefill completion instead of decoding — so
+        # the admission ledger reserves pages_for(prompt) rather than
+        # pages_for(prompt + max_new). Reserving the decode worst case
+        # on a pool that never decodes would throttle prefill admission
+        # by pages nobody here will ever write.
+        self.prefill_only = prefill_only
         # request-lifecycle observer (telemetry/reqtrace.py): the
         # scheduler owns the lifecycle transitions, so it drives the
         # tracer's submit/admit/preempt/first-token/done hooks; None
@@ -158,13 +171,28 @@ class Scheduler:
         # deadline-shed requests since the last drain_shed() — the
         # engine drains these per tick to count them and emit outputs
         self.shed: List[Request] = []
+        # inbound cross-pool transfers staged via begin_transfer,
+        # uid -> {"req", "pages", "outstanding", "tokens"}: pages
+        # materialize here chunk by chunk until admit_with_pages binds
+        # the request to a slot (serving/disagg/). Scheduler-side
+        # records, NOT request fields — the request may still be live
+        # on its prefill scheduler while pages stream.
+        self.transfers: dict = {}
         self._outstanding_total = 0
         self._next_uid = 0
 
+    def _worst_tokens(self, req: Request) -> int:
+        """Tokens the admission ledger reserves pages for: the decode
+        worst case, or just the prompt on a prefill-only pool."""
+        if self.prefill_only:
+            return req.prompt_len
+        return req.prompt_len + req.max_new_tokens
+
     # -- lifecycle ---------------------------------------------------------
 
-    def submit(self, req: Request, now: float) -> None:
-        worst = self.pool.pages_for(req.prompt_len + req.max_new_tokens)
+    def submit(self, req: Request, now: float,
+               reuse_uid: bool = False) -> None:
+        worst = self.pool.pages_for(self._worst_tokens(req))
         if req.prompt_len < 1:
             raise ValueError("empty prompt")
         if req.max_new_tokens < 1:
@@ -173,9 +201,9 @@ class Scheduler:
             raise ValueError(
                 f"deadline_s must be >= 0, got {req.deadline_s}"
             )
-        if req.prompt_len + req.max_new_tokens > self.max_context:
+        if self._worst_tokens(req) > self.max_context:
             raise ValueError(
-                f"request needs {req.prompt_len + req.max_new_tokens} "
+                f"request needs {self._worst_tokens(req)} "
                 f"context but the engine was sized for {self.max_context}"
             )
         if worst > self.pool.capacity:
@@ -183,8 +211,15 @@ class Scheduler:
                 f"request worst case is {worst} pages but the pool only "
                 f"has {self.pool.capacity}"
             )
-        req.uid = self._next_uid
-        self._next_uid += 1
+        if not (reuse_uid and req.uid is not None):
+            # reuse_uid=True: a cross-scheduler flow (the disagg
+            # fallback re-submitting a transfer-failed request onto the
+            # decode pool) keeps the uid its tracer timeline is keyed
+            # by; the CALLER owns uniqueness across the schedulers
+            # involved (disagg uids all come from the prefill
+            # scheduler's counter)
+            req.uid = self._next_uid
+            self._next_uid += 1
         if req.t_submit is None:
             # FIRST submission only — the same contract admit() keeps for
             # t_admit: a request MIGRATED between replicas (control-plane
@@ -243,7 +278,7 @@ class Scheduler:
         False verdict leaves the cache LRU order and every refcount
         untouched."""
         target = req.target_len
-        worst = self.pool.pages_for(req.prompt_len + req.max_new_tokens)
+        worst = self.pool.pages_for(self._worst_tokens(req))
         hit = None
         shared: List[int] = []
         evictable = pinned = 0
@@ -295,9 +330,22 @@ class Scheduler:
         """Read-only load + capacity view (free/evictable pages, queued
         tokens) — the router's tie-break signal. ``queued_tokens`` and
         ``active_tokens_remaining`` count work still owed: prefill
-        targets plus undecoded new-token budgets. Like
-        :meth:`can_admit`, this never mutates anything."""
+        targets plus undecoded new-token budgets — on a prefill-only
+        pool a request owes no decode, so only its prefill target
+        counts. ``transfer_tokens_owed`` is the pages-attached ledger
+        case: a TRANSFER-staged request already holds the KV of its
+        materialized prefix, so it owes only the UNMATERIALIZED tail of
+        its target plus its decode budget — counting its full prefill
+        again would double-bill work the prefill pool already paid and
+        skew routing/autoscaling load signals. Like :meth:`can_admit`,
+        this never mutates anything."""
         active = self.active()
+
+        def owed_new(r: Request) -> int:
+            if self.prefill_only:
+                return 0
+            return max(r.max_new_tokens - len(r.generated), 0)
+
         return {
             "free_slots": sum(1 for s in self.slots if s is None),
             "num_slots": self.num_slots,
@@ -307,12 +355,15 @@ class Scheduler:
             "outstanding_pages": self._outstanding_total,
             "queued_requests": len(self.queue),
             "queued_tokens": sum(
-                r.target_len + max(r.max_new_tokens - len(r.generated), 0)
-                for r in self.queue
+                r.target_len + owed_new(r) for r in self.queue
             ),
             "active_requests": len(active),
-            "active_tokens_remaining": sum(
-                max(r.max_new_tokens - len(r.generated), 0) for r in active
+            "active_tokens_remaining": sum(owed_new(r) for r in active),
+            "transfer_requests": len(self.transfers),
+            "transfer_tokens_owed": sum(
+                max(s["req"].target_len - s["tokens"], 0)
+                + owed_new(s["req"])
+                for s in self.transfers.values()
             ),
         }
 
@@ -353,7 +404,7 @@ class Scheduler:
                 break
             req = self.queue[0]
             target = req.target_len
-            worst = self.pool.pages_for(req.prompt_len + req.max_new_tokens)
+            worst = self.pool.pages_for(self._worst_tokens(req))
             fits, hit = self._admission_check(req)
             if not fits:
                 break  # FIFO head-of-line: deterministic admission order
@@ -420,6 +471,149 @@ class Scheduler:
         self.queue.insert(pos, req)
         if self.tracer is not None:
             self.tracer.on_preempt(req)
+
+    # -- disaggregated prefill/decode (serving/disagg/) --------------------
+
+    def finish_handoff(self, req: Request, now: float) -> None:
+        """Prefill-pool exit: the request's prompt KV has been EXPORTED
+        (the engine's handoff hook runs before this) — free the slot,
+        the pages, and the reservation, but do NOT finish the request:
+        it leaves this scheduler as ``Status.TRANSFER`` and lives on in
+        the decode pool. Fires the tracer's first-token hook (the first
+        token exists the moment prefill emits it — the handoff carries
+        it) and opens the ``transfer`` attribution phase; ``on_done``
+        belongs to the decode scheduler that finishes the request."""
+        if req.status is not Status.PREFILL:
+            raise ValueError(
+                f"cannot hand off a {req.status.value} request"
+            )
+        if req.t_first_token is None:
+            req.t_first_token = now
+            if self.tracer is not None:
+                self.tracer.on_first_token(req, now)
+        self._release_all(req)
+        self._outstanding_total -= req.outstanding
+        req.outstanding = 0
+        self.slots[req.slot] = None
+        req.slot = None
+        req.status = Status.TRANSFER
+        if self.tracer is not None:
+            self.tracer.on_transfer_start(req, now)
+
+    def begin_transfer(self, req: Request, now: float) -> bool:
+        """Stage an inbound cross-pool transfer: reserve the request's
+        FULL decode worst case against ``free + evictable`` capacity
+        before any page is imported, exactly like :meth:`admit` would —
+        so lazy growth during the transfer and the decode that follows
+        can never fail. Returns False (no side effects) when the
+        ledger cannot cover it right now: the transfer queue holds the
+        handoff and retries — that backpressure is the disagg engine's
+        admission control.
+
+        The staging state lives in a SCHEDULER-side record
+        (``self.transfers[uid]``), never on the request: while pages
+        stream, the same ``Request`` object is still live on the
+        PREFILL scheduler (that is the point of streaming), so its
+        ``status``/``pages``/``prefilled_len`` belong to that side
+        until :meth:`admit_with_pages` takes ownership. No cache
+        lookup happens: the pages come off the wire, not from this
+        pool's prefix cache."""
+        worst = self.pool.pages_for(self._worst_tokens(req))
+        if worst > self.pool.capacity:
+            raise ValueError(
+                f"request worst case is {worst} pages but the pool only "
+                f"has {self.pool.capacity}"
+            )
+        if self._worst_tokens(req) > self.max_context:
+            raise ValueError(
+                f"request needs {self._worst_tokens(req)} context but "
+                f"the engine was sized for {self.max_context}"
+            )
+        if req.uid in self.transfers:
+            raise ValueError(f"uid={req.uid} is already staged here")
+        evictable = (self.cache.evictable_count()
+                     if self.cache is not None else 0)
+        if (self.pool.free_count + evictable
+                - self._outstanding_total < worst):
+            return False
+        self.transfers[req.uid] = {
+            "req": req, "pages": [], "outstanding": worst, "tokens": 0,
+        }
+        self._outstanding_total += worst
+        return True
+
+    def transfer_pages(self, req: Request, n_tokens: int) -> List[int]:
+        """Lazy growth for a staged transfer: allocate destination
+        pages to cover ``n_tokens`` materialized positions (the import
+        scatters the wire payload into them) and return the stage's
+        full page list. Same never-fail contract as
+        :meth:`ensure_pages` — the reservation was made by
+        :meth:`begin_transfer`, and the cache-ledger hole is closed by
+        the same owner-retraction path."""
+        stage = self.transfers.get(req.uid)
+        if stage is None:
+            raise RuntimeError(
+                f"transfer_pages on unstaged uid={req.uid}"
+            )
+        while len(stage["pages"]) * self.pool.page_size < n_tokens:
+            stage["pages"] += self._alloc(1, owner=req)
+            stage["outstanding"] -= 1
+            self._outstanding_total -= 1
+        stage["tokens"] = max(stage["tokens"], n_tokens)
+        return stage["pages"]
+
+    def abort_transfer(self, req: Request) -> None:
+        """Transfer failed: release every imported page and the whole
+        reservation. The caller re-submits the request for a local
+        re-prefill (the disagg fallback path) once the prefill pool
+        has let go of it — ``submit`` restores the QUEUED lifecycle."""
+        stage = self.transfers.pop(req.uid, None)
+        if stage is None:
+            raise ValueError(f"uid={req.uid} is not staged here")
+        if stage["pages"]:
+            self.pool.release(stage["pages"])
+        self._outstanding_total -= stage["outstanding"]
+
+    def admit_with_pages(self, req: Request, first_token: int,
+                         now: float) -> bool:
+        """The disagg admission: bind a fully materialized transfer to
+        a free slot and SKIP prefill entirely — the pages already hold
+        the prompt's KV, so the request debits nothing beyond the tail
+        reservation :meth:`begin_transfer` made, and decoding starts on
+        the handoff's first token immediately. Returns False when no
+        slot is free (the stage keeps its pages + reservation). The
+        request object must have LEFT its prefill scheduler by now
+        (``finish_handoff`` marks it ``Status.TRANSFER``) — this is the
+        ownership handover point where the staged pages become the
+        request's own. ``t_admit`` survives from the prefill-pool
+        admission (first admission wins), so queue latency stays the
+        user-visible wait."""
+        stage = self.transfers.get(req.uid)
+        if stage is None:
+            raise ValueError(f"uid={req.uid} is not staged here")
+        if req.status is not Status.TRANSFER:
+            raise ValueError(
+                f"admit_with_pages needs a handed-off request, got "
+                f"{req.status.value} (still live on the prefill pool?)"
+            )
+        free_slots = [i for i, s in enumerate(self.slots) if s is None]
+        if not free_slots:
+            return False
+        del self.transfers[req.uid]
+        req.slot = free_slots[0]
+        self.slots[req.slot] = req
+        req.status = Status.PREFILL   # momentary: record_token -> DECODE
+        req.pages = list(stage["pages"])
+        req.outstanding = stage["outstanding"]
+        req.cow = None
+        if req.t_admit is None:
+            req.t_admit = now
+        req.prefilled_len = req.target_len
+        req.hit_tokens = 0
+        if self.tracer is not None:
+            self.tracer.on_transfer_done(req, now)
+        self.record_token(req, int(first_token), now)
+        return True
 
     def ensure_pages(self, req: Request, n_tokens: int) -> None:
         """Lazy growth to cover ``n_tokens`` cached positions (decode:

@@ -53,12 +53,18 @@ from typing import Any, Callable, Dict, List, Optional
 
 from pipegoose_tpu.telemetry.registry import MetricsRegistry, get_registry
 
-#: lifecycle phases a request's wall clock is attributed to (additive)
-COMPONENTS = ("queue_s", "prefill_s", "decode_s", "stall_s")
+#: lifecycle phases a request's wall clock is attributed to (additive).
+#: ``transfer_s`` is the disaggregated-serving phase (serving/disagg/):
+#: prefill handed off on one pool, decode not yet admitted on the other
+#: — the critical-path share of the cross-mesh KV page streaming.
+#: Always present (0.0 outside disagg) so the sum-to-e2e contract is
+#: one invariant everywhere.
+COMPONENTS = ("queue_s", "prefill_s", "transfer_s", "decode_s", "stall_s")
 
 _PHASE_TO_COMPONENT = {
     "queue": "queue_s",
     "prefill": "prefill_s",
+    "transfer": "transfer_s",
     "decode": "decode_s",
     "stall": "stall_s",
 }
@@ -81,6 +87,8 @@ class RequestTimeline:
         "hit_tokens", "prefill_tokens", "prefill_chunks", "cow_copies",
         "decode_ticks", "decode_compute_s", "prefill_compute_s",
         "spec_drafted", "spec_accepted", "preemptions",
+        "transfer_chunks", "transfer_pages", "transfer_bytes",
+        "transfer_compute_s",
         "cache_saved_est_s", "_phase", "_t_phase",
     )
 
@@ -110,6 +118,10 @@ class RequestTimeline:
         self.spec_drafted = 0
         self.spec_accepted = 0
         self.preemptions = 0
+        self.transfer_chunks = 0       # cross-pool page shipments
+        self.transfer_pages = 0
+        self.transfer_bytes = 0        # wire bytes (q+scale / bf16 / fp)
+        self.transfer_compute_s = 0.0  # measured export+import share
         self.cache_saved_est_s = 0.0
         self._phase: Optional[str] = None
         self._t_phase: Optional[float] = None
@@ -166,6 +178,10 @@ class RequestTimeline:
             "t_done": self.t_done,
             "prefill_chunks": self.prefill_chunks,
             "cow_copies": self.cow_copies,
+            "transfer_chunks": self.transfer_chunks,
+            "transfer_pages": self.transfer_pages,
+            "transfer_bytes": self.transfer_bytes,
+            "transfer_compute_s": self.transfer_compute_s,
             "decode_ticks": self.decode_ticks,
             "prefill_compute_s": self.prefill_compute_s,
             "decode_compute_s": self.decode_compute_s,
@@ -222,6 +238,16 @@ class NullRequestTracer:
                 accepted: int) -> None:
         pass
 
+    def on_transfer_start(self, req: Any, t: float) -> None:
+        pass
+
+    def on_transfer_chunk(self, req: Any, t: float, dur_s: float,
+                          tokens: int, pages: int, nbytes: int) -> None:
+        pass
+
+    def on_transfer_done(self, req: Any, t: float) -> None:
+        pass
+
     def on_done(self, req: Any, t: float) -> None:
         pass
 
@@ -254,8 +280,8 @@ class RequestTracer(NullRequestTracer):
     __slots__ = (
         "registry", "clock", "max_events", "keep_completed",
         "in_flight", "completed", "_wall_offset", "_lock",
-        "_h_queue", "_h_prefill", "_h_decode", "_h_stall", "_h_saved",
-        "_c_requests", "_c_preempts", "_c_saved",
+        "_h_queue", "_h_prefill", "_h_transfer", "_h_decode", "_h_stall",
+        "_h_saved", "_c_requests", "_c_preempts", "_c_saved",
     )
 
     enabled = True
@@ -283,6 +309,7 @@ class RequestTracer(NullRequestTracer):
         reg = self.registry
         self._h_queue = reg.histogram("serving.attrib.queue_seconds")
         self._h_prefill = reg.histogram("serving.attrib.prefill_seconds")
+        self._h_transfer = reg.histogram("serving.attrib.transfer_seconds")
         self._h_decode = reg.histogram("serving.attrib.decode_seconds")
         self._h_stall = reg.histogram("serving.attrib.stall_seconds")
         self._h_saved = reg.histogram("serving.attrib.cache_saved_seconds")
@@ -387,6 +414,7 @@ class RequestTracer(NullRequestTracer):
         c = tl.components
         self._h_queue.observe(c["queue_s"])
         self._h_prefill.observe(c["prefill_s"])
+        self._h_transfer.observe(c["transfer_s"])
         self._h_decode.observe(c["decode_s"])
         self._h_stall.observe(c["stall_s"])
         self._h_saved.observe(tl.cache_saved_est_s)
@@ -460,6 +488,44 @@ class RequestTracer(NullRequestTracer):
             tl.spec_accepted += int(accepted)
             tl.add_event("spec", t, dur_s=dur_s, drafted=int(drafted),
                          accepted=int(accepted))
+
+    # -- disagg transfer hooks (serving/disagg/) ---------------------------
+
+    def on_transfer_start(self, req: Any, t: float) -> None:
+        """Prefill handed off: the request's wall clock now belongs to
+        the cross-pool transfer until the decode pool admits it. Fired
+        by the PREFILL scheduler's ``finish_handoff`` right after the
+        first-token hook (so TTFT = queue + prefill, and transfer time
+        is its own additive component)."""
+        with self._lock:
+            tl = self._get(req, t)
+            tl.transition("transfer", t)
+            tl.add_event("transfer_start", t)
+
+    def on_transfer_chunk(self, req: Any, t: float, dur_s: float,
+                          tokens: int, pages: int, nbytes: int) -> None:
+        """One page shipment imported on the decode pool. Streamed
+        chunks land while the phase is still ``prefill`` (they overlap
+        it off the critical path); only the counters accumulate —
+        phases stay exclusive so the sum-to-e2e contract holds."""
+        with self._lock:
+            tl = self._get(req, t)
+            tl.transfer_chunks += 1
+            tl.transfer_pages += int(pages)
+            tl.transfer_bytes += int(nbytes)
+            tl.transfer_compute_s += dur_s
+            tl.add_event("transfer_chunk", t, dur_s=dur_s,
+                         tokens=int(tokens), pages=int(pages),
+                         nbytes=int(nbytes))
+
+    def on_transfer_done(self, req: Any, t: float) -> None:
+        """Decode pool admitted the transferred pages: the transfer
+        phase closes and decode opens (fired by ``admit_with_pages``
+        just before the handoff token is recorded)."""
+        with self._lock:
+            tl = self._get(req, t)
+            tl.transition("decode", t)
+            tl.add_event("transfer_done", t)
 
     # -- views -------------------------------------------------------------
 
@@ -542,6 +608,7 @@ def request_trace_events(tracer: RequestTracer, *, pid: Optional[int] = None
         pid = PID_REQUESTS
     off = tracer.wall_offset
     queue_tid = 1_000  # after any realistic slot count
+    transfer_tid = 2_000  # disagg cross-pool page streaming track
     events: List[dict] = [
         {
             "name": "process_name", "ph": "M", "pid": pid,
@@ -553,6 +620,7 @@ def request_trace_events(tracer: RequestTracer, *, pid: Optional[int] = None
         },
     ]
     seen_slots: set = set()
+    seen_transfer = False
 
     def us(t: float) -> float:
         return (t + off) * 1e6
@@ -621,6 +689,26 @@ def request_trace_events(tracer: RequestTracer, *, pid: Optional[int] = None
                            finish_reason="shed")
                 marker(f"req{uid} shed", t, queue_tid, uid=uid)
                 phase, t_open = None, t
+            elif kind == "transfer_start":
+                if phase in ("prefill", "decode"):
+                    slice_(f"req{uid} {phase}", f"request.{phase}",
+                           t_open, t, tid, uid=uid)
+                phase, t_open = "transfer", t
+                seen_transfer = True
+            elif kind == "transfer_done":
+                if phase == "transfer":
+                    slice_(f"req{uid} transfer", "request.transfer",
+                           t_open, t, transfer_tid, uid=uid,
+                           pages=tl.get("transfer_pages", 0),
+                           nbytes=tl.get("transfer_bytes", 0))
+                phase, t_open = "decode", t
+                seen_transfer = True
+            elif kind == "transfer_chunk":
+                dur = float(ev.get("dur_s", 0.0))
+                slice_(f"req{uid} xfer chunk", "request.transfer_chunk",
+                       t - dur, t, transfer_tid, uid=uid,
+                       pages=ev.get("pages"), nbytes=ev.get("nbytes"))
+                seen_transfer = True
             elif kind == "prefill_chunk":
                 dur = float(ev.get("dur_s", 0.0))
                 slice_(f"req{uid} chunk", "request.prefill_chunk",
@@ -633,9 +721,16 @@ def request_trace_events(tracer: RequestTracer, *, pid: Optional[int] = None
                            uid=uid, drafted=ev.get("drafted"),
                            accepted=ev.get("accepted"))
         if phase is not None:  # in-flight: close the open phase slice
-            track = queue_tid if phase in ("queue", "stall") else tid
+            track = (queue_tid if phase in ("queue", "stall")
+                     else transfer_tid if phase == "transfer" else tid)
             slice_(f"req{uid} {phase}", f"request.{phase}",
                    t_open, t_end, track, uid=uid, open=True)
+    if seen_transfer:
+        events.insert(1, {
+            "name": "thread_name", "ph": "M", "pid": pid,
+            "tid": transfer_tid,
+            "args": {"name": "transfer (cross-pool KV streaming)"},
+        })
     for tid in sorted(s for s in seen_slots if s != queue_tid):
         events.insert(1, {
             "name": "thread_name", "ph": "M", "pid": pid, "tid": tid,

@@ -232,6 +232,67 @@ print(f"router smoke OK: cache-aware forwarded {ca['prefill_tokens']} vs "
       f"(token-identical)")
 PY
 
+# Disagg smoke (serving/disagg/, ISSUE 13): a 2-pool CPU run — prefill
+# pool streaming int8 KV pages into a decode pool — must emit token
+# streams identical to one monolithic engine, with the tracer's new
+# `transfer` phase keeping queue+prefill+transfer+decode+stall == e2e
+# exactly. The cross-mesh handoff contract stays exercised on every CI
+# run before the tier proper.
+echo "== disagg smoke (2-pool token identity + exact attribution) =="
+python - <<'PY'
+from pipegoose_tpu.testing import force_cpu_devices
+
+force_cpu_devices(1)
+
+import jax
+import numpy as np
+
+from pipegoose_tpu.models import bloom
+from pipegoose_tpu.serving import DisaggEngine, Request, ServingEngine
+from pipegoose_tpu.telemetry import MetricsRegistry
+from pipegoose_tpu.telemetry.reqtrace import RequestTracer
+
+cfg = bloom.BloomConfig(vocab_size=64, hidden_size=64, n_layer=2, n_head=4)
+params = bloom.init_params(cfg, jax.random.PRNGKey(0))
+rng = np.random.RandomState(7)
+shared = rng.randint(1, 64, (9,))
+reqs = [(np.concatenate([shared, rng.randint(1, 64, (k,))]), n)
+        for k, n in [(2, 4), (4, 3)]]
+
+def requests():
+    return [Request(prompt=p, max_new_tokens=n) for p, n in reqs]
+
+single = ServingEngine(params, cfg, num_slots=2, num_pages=16, page_size=4,
+                       max_context=32, prefix_cache=True, prefill_chunk=8,
+                       kv_dtype="int8", registry=MetricsRegistry())
+ref, _ = single.run(requests())
+
+reg = MetricsRegistry(enabled=True)
+tracer = RequestTracer(registry=reg, keep_completed=8)
+pe = ServingEngine(params, cfg, num_slots=2, num_pages=16, page_size=4,
+                   max_context=32, prefix_cache=True, prefill_chunk=8,
+                   prefill_only=True, kv_dtype="int8",
+                   registry=MetricsRegistry())
+de = ServingEngine(params, cfg, num_slots=2, num_pages=16, page_size=4,
+                   max_context=32, prefix_cache=True, prefill_chunk=8,
+                   kv_dtype="int8", registry=MetricsRegistry(),
+                   stall_patience=10_000)
+disagg = DisaggEngine(pe, de, max_inflight=4, registry=reg, tracer=tracer)
+outs, metrics = disagg.run(requests())
+for a, b in zip(ref, outs):
+    np.testing.assert_array_equal(a.generated, b.generated,
+                                  err_msg="disagg diverged")
+for tl in tracer.completed:
+    total = sum(tl.components.values())
+    assert abs(total - tl.e2e_s) < 1e-6, (tl.uid, total, tl.e2e_s)
+    assert tl.components["transfer_s"] > 0, "transfer phase missing"
+xfer = metrics["transfer"]
+assert xfer["wire_bytes"] < xfer["fp_equiv_bytes"], xfer
+print(f"disagg smoke OK: token-identical across pools, attribution exact, "
+      f"{xfer['pages']} pages at {xfer['wire_bytes']} wire bytes "
+      f"({xfer['wire_savings_ratio']:.0%} under fp)")
+PY
+
 echo "== fast tier =="
 python -m pytest tests/ -q -m fast -p no:cacheprovider \
     --continue-on-collection-errors "$@"

@@ -4,7 +4,8 @@ config levers (remat, flash on/off) for the bloom-560m bench shape.
 Timing recipe per bench.py: loop inside jit (lax.scan), scalar fetch,
 RTT subtracted. One attach per run (tunnel is single-client).
 
-    python scripts/sweep_tpu_perf.py [kernel|model|fusedce|serving|comm|plan]
+    python scripts/sweep_tpu_perf.py \
+        [kernel|model|fusedce|serving|comm|plan|control-plane|disagg]
     python scripts/sweep_tpu_perf.py serving --prefix-replay   # ISSUE 6:
         # Zipf shared-prefix replay arms (baseline / chunked / cached /
         # cached+spec) per slot count instead of the continuous-vs-
@@ -21,6 +22,10 @@ RTT subtracted. One attach per run (tunnel is single-client).
         # multi-tenant replay through round-robin vs cache-aware
         # routing at 2 and 4 replicas — forwarded prefill tokens,
         # TTFT, tenant shares, drain zero-drop verdict
+    python scripts/sweep_tpu_perf.py disagg   # ISSUE 13: prefill pool
+        # streaming KV pages into a decode pool vs one monolithic
+        # engine — token identity, decode-pool tokens/s vs the
+        # decode-only rate, wire-vs-fp byte savings, fp + int8 KV
 """
 from __future__ import annotations
 
@@ -494,6 +499,41 @@ def control_plane_sweep():
     print(json.dumps(results))
 
 
+def disagg_sweep():
+    """Disaggregated prefill/decode (serving/disagg/, ISSUE 13): the
+    skewed replay through a prefill pool streaming int8 KV pages into
+    a decode pool vs one monolithic engine, on the real chip — token
+    identity, decode-pool tokens/s vs the monolithic decode-only rate
+    (the "prefill off the critical path" meter), TTFT p50/p99, and the
+    wire-vs-fp byte savings, at fp and int8 KV."""
+    from pipegoose_tpu.models import bloom
+    from pipegoose_tpu.serving.disagg import disagg_serving_benchmark
+
+    cfg = bloom.BloomConfig.bloom_560m(dtype=jnp.bfloat16)
+    params = bloom.init_params(cfg, jax.random.PRNGKey(1))
+    from pipegoose_tpu import telemetry
+
+    reg = telemetry.get_registry()
+    was_enabled = reg.enabled
+    results = {}
+    for label, kv in (("fp", None), ("int8kv", "int8")):
+        reg.disable()
+        try:
+            results[label] = disagg_serving_benchmark(
+                params, cfg, n_requests=12, n_prefixes=3, prefix_len=96,
+                suffix_lens=(8, 16), max_new=16, num_slots=4,
+                prefill_pages=65, decode_pages=65, page_size=32,
+                max_context=256, prefill_chunk=64, kv_dtype=kv,
+            )
+        except Exception as e:  # noqa: BLE001
+            results[label] = {"error": f"{type(e).__name__}: {e}"[:300]}
+        finally:
+            if was_enabled:
+                reg.enable()
+        print(label, json.dumps(results[label]), flush=True)
+    print(json.dumps(results))
+
+
 def serving_sweep(prefix_replay: bool = False, quant: bool = False):
     """Continuous-batching vs naive padded serving (serving/engine.py)
     across slot counts on the real chip: the decode-step savings grow
@@ -569,7 +609,8 @@ if __name__ == "__main__":
     modes = {"kernel": kernel_sweep, "model": model_sweep,
              "fusedce": fusedce_sweep, "serving": serving_sweep,
              "comm": comm_sweep, "plan": plan_sweep,
-             "control-plane": control_plane_sweep}
+             "control-plane": control_plane_sweep,
+             "disagg": disagg_sweep}
     if mode not in modes:
         raise SystemExit(f"unknown mode {mode!r}; pick one of {sorted(modes)}")
     if mode == "serving":

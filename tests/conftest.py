@@ -184,6 +184,13 @@ FAST_TESTS = {
     "tests/serving/test_quantized.py::test_greedy_parity_single_device[int8w+int8kv]",
     "tests/serving/test_quantized.py::test_memory_report_page_capacity_ratio",
     "tests/planner/test_serving_plan.py::test_int8_flips_infeasible_fp_row_to_feasible",
+    # disagg serving (ISSUE 13): the int8-wire identity cell exercises
+    # the whole stack (streaming, staging, admit_with_pages, warm
+    # cache); census + attribution pin the wire format and the new
+    # transfer phase (tp2->1, fallback, backpressure cells stay tier-1)
+    "tests/serving/test_disagg.py::test_token_identity_cold_and_warm[int8kv]",
+    "tests/serving/test_disagg.py::test_int8_wire_byte_census",
+    "tests/serving/test_disagg.py::test_attribution_sums_to_e2e_with_transfer_phase",
 }
 
 

@@ -500,3 +500,112 @@ def test_fleet_status_json_and_tenant_rows(tiny):
     # fleet registry merges the replica engines' counters
     fleet_tokens = plane.fleet.metrics().get("serving.tokens_total")
     assert fleet_tokens is not None and fleet_tokens.value > 0
+
+
+# -- clear_prefix_caches resets the router's ShadowIndex (ISSUE 13) ---------
+
+
+def test_clear_prefix_caches_resets_shadow_index(tiny):
+    """The regression pin: clearing the fleet's prefix caches must
+    clear the router-side shadows WITH them — a stale shadow would
+    keep scoring phantom prefix matches against caches that no longer
+    hold the pages, steering every post-clear request at one replica
+    for hits it cannot get."""
+    params, cfg = tiny
+    reqs = _replay_requests()
+    plane = ControlPlane(_factory(params, cfg), n_replicas=2,
+                         policy="cache_aware")
+    plane.run(reqs())
+    shadows = plane.router._shadows
+    assert shadows, "routing should have built shadow indices"
+    probe = reqs()[0].tokens
+    assert any(s.longest_match(probe) > 0 for s in shadows.values()), \
+        "a routed prompt's prefix should shadow-match before the clear"
+    plane.clear_prefix_caches()
+    for rep in plane.replicas:
+        assert rep.engine.prefix_cache.cached_pages == 0
+    for shadow in shadows.values():
+        assert shadow._blocks == 0
+        assert shadow.longest_match(probe) == 0, \
+            "phantom prefix match survived clear_prefix_caches"
+
+
+# -- disagg dispatch mode (serving/disagg/, ISSUE 13) -----------------------
+
+
+def _disagg_fleet(params, cfg, n_prefill=2, n_decode=2):
+    from pipegoose_tpu.serving import ServingEngine
+    from pipegoose_tpu.serving.control_plane import Replica
+    from pipegoose_tpu.telemetry import MetricsRegistry
+
+    prefill = [
+        Replica(f"prefill{i}", ServingEngine(
+            params, cfg, num_slots=1, num_pages=33, page_size=8,
+            max_context=96, prefix_cache=True, prefill_chunk=16,
+            prefill_only=True, registry=MetricsRegistry(),
+        ), index=i)
+        for i in range(n_prefill)
+    ]
+    decode = [
+        Replica(f"decode{i}", ServingEngine(
+            params, cfg, num_slots=1, num_pages=33, page_size=8,
+            max_context=96, prefix_cache=True, prefill_chunk=16,
+            registry=MetricsRegistry(),
+        ), index=i)
+        for i in range(n_decode)
+    ]
+    return prefill, decode
+
+
+def test_route_disagg_picks_prefill_pool_and_pins_decode_replica(tiny):
+    """The disagg dispatch mode: prefill goes to the least-owed
+    admitting prefill replica; the decode replica is PINNED
+    cache-aware at route time (shadow-covered), so same-prefix
+    requests pile onto the decode replica that will hold their KV."""
+    from pipegoose_tpu.serving.control_plane import Router
+
+    params, cfg = tiny
+    prefill, decode = _disagg_fleet(params, cfg)
+    router = Router("disagg")
+    rng = np.random.RandomState(0)
+    shared = rng.randint(1, 50, (48,))
+    r1 = Request(prompt=shared, max_new_tokens=2)
+    got = router.route_disagg(r1, prefill, decode, now=0.0, seq=0)
+    assert got is not None
+    p1, d1 = got
+    assert p1.name.startswith("prefill") and d1.name.startswith("decode")
+    # a second request with the SAME prefix pins the SAME decode
+    # replica (the shadow covers the publication lag)
+    r2 = Request(prompt=np.concatenate([shared, rng.randint(1, 50, (4,))]),
+                 max_new_tokens=2)
+    p2, d2 = router.route_disagg(r2, prefill, decode, now=1.0, seq=1)
+    assert d2 is d1, "same-prefix request must pin the same decode replica"
+    decision = router.decisions[-1]
+    assert decision["policy"] == "disagg"
+    assert decision["replica"] == d1.name
+    assert decision["prefill_replica"] == p2.name
+    assert decision["matched_tokens"] > 0
+    # route() is the wrong entry point for this policy
+    with pytest.raises(ValueError, match="route_disagg"):
+        router.route(r1, decode, now=2.0)
+
+
+def test_route_disagg_prefill_pick_prefers_least_owed(tiny):
+    from pipegoose_tpu.serving.control_plane import Router
+    from pipegoose_tpu.telemetry import MetricsRegistry
+
+    params, cfg = tiny
+    prefill, decode = _disagg_fleet(params, cfg)
+    # load prefill0 with queued work: route_disagg must pick prefill1
+    busy = Request(prompt=np.arange(1, 40, dtype=np.int64),
+                   max_new_tokens=2)
+    prefill[0].engine.sched.submit(busy, now=0.0)
+    router = Router("disagg", registry=MetricsRegistry(enabled=True))
+    r = Request(prompt=np.arange(1, 20, dtype=np.int64), max_new_tokens=2)
+    p, _ = router.route_disagg(r, prefill, decode, now=0.0)
+    assert p.name == "prefill1"
+    # no admitting prefill replica -> unplaceable
+    for rep in prefill:
+        rep.state = rep.state.__class__.DRAINING
+    assert router.route_disagg(r, prefill, decode, now=1.0) is None
+    assert router._m_unplaceable.value >= 1

@@ -1,0 +1,393 @@
+"""Disaggregated prefill/decode serving (serving/disagg/, ISSUE 13).
+
+The contract under test is the PR 10 convention: a ``DisaggEngine``
+(prefill on one pool, decode on another, KV pages streamed between
+them at wire precision) emits greedy token streams IDENTICAL to one
+``ServingEngine`` serving the same requests — across {fp, int8 KV}
+pools, {same-mesh, tp 2 -> 1 reshard}, cold and warm prefix caches,
+and through the transfer-failure fallback. Plus the wire-format byte
+census (int8 ships q + scale planes, never fp), the bounded in-flight
+queue, and the tracer's exact queue+prefill+transfer+decode+stall ==
+e2e attribution with the new ``transfer`` phase."""
+import jax
+import numpy as np
+import pytest
+
+from pipegoose_tpu.distributed import ParallelContext
+from pipegoose_tpu.models import bloom
+from pipegoose_tpu.serving import DisaggEngine, Request, ServingEngine
+from pipegoose_tpu.serving.disagg import (
+    PoolTransfer,
+    TransferError,
+    set_transfer_fault,
+)
+from pipegoose_tpu.telemetry import MetricsRegistry
+from pipegoose_tpu.telemetry.reqtrace import RequestTracer
+
+PS = 4           # page size
+CHUNK = 8        # prefill chunk = streaming boundary (2 pages/shipment)
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = bloom.BloomConfig(vocab_size=64, hidden_size=64, n_layer=2,
+                            n_head=4)
+    params = bloom.init_params(cfg, jax.random.PRNGKey(0))
+    rng = np.random.RandomState(7)
+    shared = rng.randint(1, 64, (13,))      # 3 full pages + tail @ ps=4
+    reqs = [
+        (np.concatenate([shared, rng.randint(1, 64, (k,))]), n)
+        for k, n in [(3, 6), (5, 4)]
+    ] + [
+        (shared[:10], 5),                   # strict prefix: COW mid-page
+        (rng.randint(1, 64, (7,)), 6),      # unrelated: pure miss
+    ]
+    return cfg, params, reqs
+
+
+def _requests(reqs, eos=None):
+    return [Request(prompt=p, max_new_tokens=n, eos_token_id=eos)
+            for p, n in reqs]
+
+
+def _single(params, cfg, **kw):
+    return ServingEngine(params, cfg, num_slots=2, num_pages=32,
+                         page_size=PS, max_context=32, prefix_cache=True,
+                         prefill_chunk=CHUNK, **kw)
+
+
+def _disagg(params, cfg, *, kv_dtype=None, max_inflight=4,
+            prefill_mesh=None, prefill_specs=None, tracer=None,
+            wire_dtype=None, decode_pages=32, decode_mesh=None,
+            decode_specs=None):
+    pe = ServingEngine(params, cfg, num_slots=2, num_pages=32,
+                       page_size=PS, max_context=32, prefix_cache=True,
+                       prefill_chunk=CHUNK, prefill_only=True,
+                       kv_dtype=kv_dtype, mesh=prefill_mesh,
+                       param_specs=prefill_specs,
+                       registry=MetricsRegistry())
+    de = ServingEngine(params, cfg, num_slots=2, num_pages=decode_pages,
+                       page_size=PS, max_context=32, prefix_cache=True,
+                       prefill_chunk=CHUNK, kv_dtype=kv_dtype,
+                       mesh=decode_mesh, param_specs=decode_specs,
+                       registry=MetricsRegistry(), stall_patience=10_000)
+    return DisaggEngine(pe, de, max_inflight=max_inflight,
+                        registry=MetricsRegistry(enabled=True),
+                        tracer=tracer, wire_dtype=wire_dtype)
+
+
+def _assert_identical(ref_outs, outs, label):
+    """Outputs come back in uid (= submit) order; uids themselves are
+    per-scheduler counters and keep counting across runs."""
+    assert len(ref_outs) == len(outs)
+    for a, b in zip(ref_outs, outs):
+        np.testing.assert_array_equal(
+            b.generated, a.generated,
+            err_msg=f"{label}: request {a.uid} diverged from the "
+                    f"single-engine reference",
+        )
+        assert a.finish_reason == b.finish_reason
+
+
+# --- token identity: the acceptance matrix ---------------------------------
+
+
+@pytest.mark.parametrize("kv_dtype", [None, "int8"],
+                         ids=["fp", "int8kv"])
+def test_token_identity_cold_and_warm(setup, kv_dtype):
+    """Disagg == single engine, cold cache AND warm (second run hits
+    the prefill pool's prefix cache — shared pages still export the
+    right KV)."""
+    cfg, params, reqs = setup
+    single = _single(params, cfg, kv_dtype=kv_dtype,
+                     registry=MetricsRegistry())
+    ref_outs, _ = single.run(_requests(reqs))
+    dis = _disagg(params, cfg, kv_dtype=kv_dtype)
+    cold_outs, cold_m = dis.run(_requests(reqs))
+    _assert_identical(ref_outs, cold_outs, f"{kv_dtype or 'fp'} cold")
+    warm_outs, warm_m = dis.run(_requests(reqs))
+    _assert_identical(ref_outs, warm_outs, f"{kv_dtype or 'fp'} warm")
+    # the warm run really exercised the hit path on the prefill pool
+    warm_cache = warm_m["prefill_pool"]["prefix_cache"]
+    assert warm_cache["hit_tokens"] > 0
+    assert (warm_m["prefill_pool"]["prefill_tokens"]
+            < cold_m["prefill_pool"]["prefill_tokens"])
+    # every page the decode pool read came over the wire, none prefilled
+    assert warm_m["decode_pool"]["prefill_tokens"] == 0
+    assert warm_m["transfer"]["handoffs"] == len(reqs)
+
+
+def test_token_identity_with_eos(setup):
+    """EOS mid-stream (including a first-token EOS finishing AT disagg
+    admission) keeps identity."""
+    cfg, params, reqs = setup
+    single = _single(params, cfg, registry=MetricsRegistry())
+    ref_outs, _ = single.run(_requests(reqs, eos=5))
+    dis = _disagg(params, cfg)
+    outs, _ = dis.run(_requests(reqs, eos=5))
+    _assert_identical(ref_outs, outs, "eos")
+
+
+def test_token_identity_tp2_prefill_to_tp1_decode(setup, devices):
+    """The reshard the subsystem exists for: prefill under tp=2
+    head-sharded pools, decode on a single device — the host-mediated
+    slab transfer IS the cross-mesh resharding, and the tokens match a
+    tp=1 single engine exactly."""
+    cfg, params, reqs = setup
+    single = _single(params, cfg, registry=MetricsRegistry())
+    ref_outs, _ = single.run(_requests(reqs))
+    ctx = ParallelContext(tensor_parallel_size=2, data_parallel_size=4)
+    with ctx.mesh:
+        dis = _disagg(params, cfg, prefill_mesh=ctx.mesh,
+                      prefill_specs=bloom.tp_specs(params))
+        outs, metrics = dis.run(_requests(reqs))
+    _assert_identical(ref_outs, outs, "tp2->tp1")
+    assert metrics["transfer"]["handoffs"] == len(reqs)
+
+
+@pytest.mark.parametrize("kv_dtype", [None, "int8"],
+                         ids=["fp", "int8kv"])
+def test_token_identity_tp2_to_tp1_int8(setup, devices, kv_dtype):
+    """Same reshard with the int8 wire: q + scale planes gathered off
+    the tp=2 pool and scattered into the tp=1 pool, never dequantized."""
+    cfg, params, reqs = setup
+    single = _single(params, cfg, kv_dtype=kv_dtype,
+                     registry=MetricsRegistry())
+    ref_outs, _ = single.run(_requests(reqs))
+    ctx = ParallelContext(tensor_parallel_size=2, data_parallel_size=4)
+    with ctx.mesh:
+        dis = _disagg(params, cfg, kv_dtype=kv_dtype,
+                      prefill_mesh=ctx.mesh,
+                      prefill_specs=bloom.tp_specs(params))
+        outs, _ = dis.run(_requests(reqs))
+    _assert_identical(ref_outs, outs, f"tp2->tp1 {kv_dtype or 'fp'}")
+
+
+def test_token_identity_tp2_to_tp2_same_mesh_width(setup, devices):
+    """Same-tp disagg (tp=2 pools on both sides): the import scatter
+    runs under the DESTINATION mesh's sharding too. Reference is the
+    tp=2 single engine (same-mesh comparison, the PR 10 convention)."""
+    cfg, params, reqs = setup
+    ctx = ParallelContext(tensor_parallel_size=2, data_parallel_size=4)
+    with ctx.mesh:
+        single = ServingEngine(
+            params, cfg, num_slots=2, num_pages=32, page_size=PS,
+            max_context=32, prefix_cache=True, prefill_chunk=CHUNK,
+            mesh=ctx.mesh, param_specs=bloom.tp_specs(params),
+            registry=MetricsRegistry(),
+        )
+        ref_outs, _ = single.run(_requests(reqs))
+        dis = _disagg(params, cfg,
+                      prefill_mesh=ctx.mesh,
+                      prefill_specs=bloom.tp_specs(params),
+                      decode_mesh=ctx.mesh,
+                      decode_specs=bloom.tp_specs(params))
+        outs, _ = dis.run(_requests(reqs))
+    _assert_identical(ref_outs, outs, "tp2->tp2")
+
+
+# --- wire format -----------------------------------------------------------
+
+
+def test_int8_wire_byte_census(setup):
+    """int8 transfers ship q + scale at wire size, NEVER fp: the byte
+    counter equals pages x (q bytes + scale bytes) exactly, which is
+    strictly below the fp equivalent."""
+    cfg, params, reqs = setup
+    dis = _disagg(params, cfg, kv_dtype="int8")
+    _, metrics = dis.run(_requests(reqs))
+    xfer = metrics["transfer"]
+    L, nh, hd = cfg.n_layer, cfg.n_head, cfg.head_dim
+    q_bytes = L * PS * nh * hd * 1          # int8 values
+    scale_bytes = L * PS * nh * 4           # one f32 per (L, pos, head)
+    per_page = 2 * (q_bytes + scale_bytes)  # k and v banks
+    assert xfer["pages"] > 0
+    assert xfer["wire_bytes"] == xfer["pages"] * per_page
+    fp_per_page = 2 * L * PS * nh * hd * int(np.dtype(cfg.dtype).itemsize)
+    assert xfer["fp_equiv_bytes"] == xfer["pages"] * fp_per_page
+    assert xfer["wire_bytes"] < xfer["fp_equiv_bytes"]
+    # hd=16: q+scale = (16+4)/64 of fp bytes -> 68.75% saved
+    assert xfer["wire_savings_ratio"] == pytest.approx(
+        1 - (hd + 4) / (hd * 4), abs=1e-4
+    )
+
+
+def test_bf16_wire_option_halves_fp_bytes(setup):
+    """fp pools get the opt-in bf16 wire (compressed.py convention):
+    half the bytes on the wire. (Lossy for an fp32 pool — the
+    token-identity pins run on the default exact wire.)"""
+    cfg, params, reqs = setup
+    dis = _disagg(params, cfg, wire_dtype="bf16")
+    _, metrics = dis.run(_requests(reqs))
+    xfer = metrics["transfer"]
+    assert xfer["pages"] > 0
+    assert xfer["wire_bytes"] * 2 == xfer["fp_equiv_bytes"]
+    assert xfer["wire_savings_ratio"] == pytest.approx(0.5)
+
+
+# --- failure + backpressure ------------------------------------------------
+
+
+def test_transfer_failure_falls_back_to_local_prefill(setup):
+    """An injected TransferError aborts the staging and re-prefills on
+    the decode pool — same tokens, every request finishes."""
+    cfg, params, reqs = setup
+    single = _single(params, cfg, registry=MetricsRegistry())
+    ref_outs, _ = single.run(_requests(reqs))
+    dis = _disagg(params, cfg)
+    calls = [0]
+
+    def fault(kind, uid, n_pages):
+        calls[0] += 1
+        if calls[0] == 3:                   # fail one mid-run shipment
+            raise TransferError("injected link fault")
+
+    prev = set_transfer_fault(fault)
+    try:
+        outs, metrics = dis.run(_requests(reqs))
+    finally:
+        set_transfer_fault(prev)
+    _assert_identical(ref_outs, outs, "fallback")
+    assert metrics["transfer"]["failures"] == 1
+    assert metrics["transfer"]["fallbacks"] == 1
+    # the fallback re-prefilled ON the decode pool
+    assert metrics["decode_pool"]["prefill_tokens"] > 0
+
+
+def test_every_shipment_failing_still_serves_everything(setup):
+    """Total link outage degrades to monolithic-on-the-decode-pool:
+    every request falls back, tokens identical — and the fallen-back
+    timelines still attribute their decode as DECODE (the local
+    re-prefill's completion resumes the phase; without that, the whole
+    decode would book as prefill)."""
+    cfg, params, reqs = setup
+    single = _single(params, cfg, registry=MetricsRegistry())
+    ref_outs, _ = single.run(_requests(reqs))
+    reg = MetricsRegistry(enabled=True)
+    tracer = RequestTracer(registry=reg, keep_completed=16)
+    dis = _disagg(params, cfg, tracer=tracer)
+
+    def fault(kind, uid, n_pages):
+        raise TransferError("link down")
+
+    prev = set_transfer_fault(fault)
+    try:
+        outs, metrics = dis.run(_requests(reqs))
+    finally:
+        set_transfer_fault(prev)
+    _assert_identical(ref_outs, outs, "total outage")
+    assert metrics["transfer"]["fallbacks"] == len(reqs)
+    for tl in tracer.completed:
+        assert sum(tl.components.values()) == pytest.approx(
+            tl.e2e_s, abs=1e-6)
+        # every request decoded >= 3 tokens locally after the fallback
+        assert tl.components["decode_s"] > 0, tl.uid
+
+
+def test_staged_requests_import_past_a_staging_blocked_head(setup):
+    """Deadlock regression: when a NEW request cannot reserve on the
+    decode ledger, records of ALREADY-STAGED requests queued behind it
+    must still import — finishing them is what frees the ledger for
+    the blocked head. Decode pool sized for ONE request's worst case;
+    two interleaved prefills enqueue A-chunk, B-chunk, A-final,
+    B-final — B's staging blocks after A's first import, and only
+    importing A's final past it lets the run complete."""
+    cfg, params, _ = setup
+    rng = np.random.RandomState(3)
+    reqs = [(rng.randint(1, 64, (16,)), 4), (rng.randint(1, 64, (16,)), 4)]
+    single = _single(params, cfg, registry=MetricsRegistry())
+    ref_outs, _ = single.run(_requests(reqs))
+    # decode pool: 9 usable pages; each request's worst case is 5
+    # (4 prompt + 1 decode) -> only one stages at a time
+    dis = _disagg(params, cfg, decode_pages=10, max_inflight=16)
+    outs, metrics = dis.run(_requests(reqs))
+    _assert_identical(ref_outs, outs, "blocked-head")
+    assert metrics["transfer"]["fallbacks"] == 0
+
+
+def test_backpressure_bounds_inflight_queue(setup):
+    """The queue bound pauses prefill: depth never exceeds
+    ``max_inflight - 1 + num_slots * shipments_per_handoff`` — the
+    documented soft overshoot is one handoff per prefill slot of the
+    tick already running when the queue filled (each up to
+    ceil(prompt_pages / width) records). With a tiny decode pool that
+    staggers staging, the run still completes token-identically."""
+    cfg, params, reqs = setup
+    dis = _disagg(params, cfg, max_inflight=1, decode_pages=12)
+    single = _single(params, cfg, registry=MetricsRegistry())
+    ref_outs, _ = single.run(_requests(reqs))
+    outs, metrics = dis.run(_requests(reqs))
+    _assert_identical(ref_outs, outs, "backpressure")
+    max_pages = max(-(-len(p) // PS) for p, _ in reqs)       # 4
+    per_handoff = -(-max_pages // (CHUNK // PS))             # 2
+    num_slots = 2
+    bound = 1 - 1 + num_slots * per_handoff                  # 4... + 1 slack
+    assert metrics["transfer"]["max_queue_depth"] <= bound + 1
+
+
+# --- attribution -----------------------------------------------------------
+
+
+def test_attribution_sums_to_e2e_with_transfer_phase(setup):
+    """One shared tracer across both pools: every request's
+    queue+prefill+transfer+decode+stall == e2e EXACTLY, the transfer
+    phase is nonzero, and TTFT = queue + prefill (+ stall) — the first
+    token exists at handoff, before the transfer."""
+    cfg, params, reqs = setup
+    reg = MetricsRegistry(enabled=True)
+    tracer = RequestTracer(registry=reg, keep_completed=16)
+    dis = _disagg(params, cfg, tracer=tracer)
+    outs, _ = dis.run(_requests(reqs))
+    assert not tracer.snapshot()["in_flight"]
+    done = list(tracer.completed)
+    assert len(done) == len(reqs)
+    for tl in done:
+        total = sum(tl.components.values())
+        assert total == pytest.approx(tl.e2e_s, abs=1e-6)
+        assert tl.components["transfer_s"] > 0
+        assert tl.transfer_chunks > 0 and tl.transfer_bytes > 0
+        tc = tl.ttft_components
+        assert tl.ttft_s == pytest.approx(
+            tc["queue_s"] + tc["prefill_s"] + tc["stall_s"], abs=1e-6
+        )
+    # the attribution histograms saw the transfer component
+    snap = reg.snapshot()
+    assert snap["histograms"]["serving.attrib.transfer_seconds"]["count"] \
+        == len(reqs)
+
+
+# --- construction contracts ------------------------------------------------
+
+
+def test_validation_contracts(setup):
+    cfg, params, _ = setup
+    plain = _single(params, cfg, registry=MetricsRegistry())
+    pe = ServingEngine(params, cfg, num_slots=2, num_pages=32,
+                       page_size=PS, max_context=32, prefix_cache=True,
+                       prefill_chunk=CHUNK, prefill_only=True,
+                       registry=MetricsRegistry())
+    # prefill side must be prefill_only
+    with pytest.raises(ValueError, match="prefill_only"):
+        DisaggEngine(plain, plain, registry=MetricsRegistry())
+    # kv_dtype must match across pools
+    de8 = ServingEngine(params, cfg, num_slots=2, num_pages=32,
+                        page_size=PS, max_context=32, prefix_cache=True,
+                        prefill_chunk=CHUNK, kv_dtype="int8",
+                        registry=MetricsRegistry())
+    with pytest.raises(ValueError, match="kv_dtype mismatch"):
+        DisaggEngine(pe, de8, registry=MetricsRegistry())
+    # the bf16 wire is an fp-pool option, not an int8 one
+    pe8 = ServingEngine(params, cfg, num_slots=2, num_pages=32,
+                        page_size=PS, max_context=32, prefix_cache=True,
+                        prefill_chunk=CHUNK, prefill_only=True,
+                        kv_dtype="int8", registry=MetricsRegistry())
+    with pytest.raises(ValueError, match="wire format"):
+        PoolTransfer(pe8, de8, wire_dtype="bf16")
+    # prefill_only needs the chunked path
+    with pytest.raises(ValueError, match="prefill_chunk"):
+        ServingEngine(params, cfg, num_slots=2, num_pages=32,
+                      page_size=PS, max_context=32, prefill_only=True,
+                      registry=MetricsRegistry())
+    # and a handoff hook before it runs
+    with pytest.raises(RuntimeError, match="handoff hook"):
+        pe.run([Request(prompt=np.arange(1, 6), max_new_tokens=2)])

@@ -165,3 +165,128 @@ def test_write_routes_padding_to_null_page(tiny):
     k_np = np.asarray(k_pages)
     untouched = [p for p in range(1, 8) if p not in (3, 6)]
     np.testing.assert_array_equal(k_np[:, untouched], 0.0)
+
+
+# -- int8 pools: transferred-in pages mixed with local writes (ISSUE 13) ----
+#
+# The disagg wire ships q + scale planes verbatim
+# (export_page_slab/import_page_slab); a decode-pool page table then
+# mixes transferred-in pages with locally written ones. The scale
+# plane must ride EVERY path — gather, COW copy, export/import — or
+# dequantization silently corrupts exactly one page's values.
+
+
+def _int8_pool(cfg, num_pages=9, ps=4):
+    from pipegoose_tpu.serving import init_pages
+
+    return init_pages(cfg, num_pages, ps, kv_dtype="int8")
+
+
+def _fake_cache(cfg, s, seed):
+    rng = np.random.RandomState(seed)
+    shape = (cfg.n_layer, 1, s, cfg.n_head, cfg.head_dim)
+    return {"k": jnp.asarray(rng.randn(*shape).astype(np.float32)),
+            "v": jnp.asarray(rng.randn(*shape).astype(np.float32))}
+
+
+def test_int8_export_import_roundtrip_preserves_q_and_scale():
+    from pipegoose_tpu.serving.kv_pool import (
+        export_page_slab,
+        import_page_slab,
+    )
+
+    cfg = bloom.BloomConfig(vocab_size=64, hidden_size=32, n_layer=2,
+                            n_head=2)
+    kp, vp = _int8_pool(cfg)
+    phys = np.zeros((4,), np.int32)
+    phys[:2] = [1, 2]
+    kp, vp = write_prompt_pages(kp, vp, _fake_cache(cfg, 8, 0), phys,
+                                pad=0, page_size=4)
+    ids = jnp.asarray([1, 2], jnp.int32)
+    k_slab = export_page_slab(kp, ids)
+    v_slab = export_page_slab(vp, ids)
+    # the wire is q + scale, at wire dtypes — never fp
+    assert set(k_slab) == {"q", "scale"}
+    assert k_slab["q"].dtype == jnp.int8
+    assert k_slab["scale"].dtype == jnp.float32
+    dst = jnp.asarray([5, 6], jnp.int32)
+    kp = import_page_slab(kp, k_slab, dst)
+    vp = import_page_slab(vp, v_slab, dst)
+    for bank, src_ids in ((kp, [1, 2]),):
+        np.testing.assert_array_equal(
+            np.asarray(bank["q"][:, [5, 6]]), np.asarray(bank["q"][:, src_ids])
+        )
+        np.testing.assert_array_equal(
+            np.asarray(bank["scale"][:, [5, 6]]),
+            np.asarray(bank["scale"][:, src_ids]),
+        )
+
+
+def test_int8_gather_over_mixed_transferred_and_local_pages():
+    """A page table mixing transferred-in pages (5, 6) with a locally
+    written one (3) dequantizes to exactly what the all-local table
+    (1, 2, 3) does — transferred pages are first-class pool citizens."""
+    from pipegoose_tpu.serving.kv_pool import (
+        export_page_slab,
+        import_page_slab,
+    )
+
+    cfg = bloom.BloomConfig(vocab_size=64, hidden_size=32, n_layer=2,
+                            n_head=2)
+    kp, vp = _int8_pool(cfg)
+    phys = np.zeros((4,), np.int32)
+    phys[:2] = [1, 2]
+    kp, vp = write_prompt_pages(kp, vp, _fake_cache(cfg, 8, 0), phys,
+                                pad=0, page_size=4)
+    phys_b = np.zeros((4,), np.int32)
+    phys_b[0] = 3
+    kp, vp = write_prompt_pages(kp, vp, _fake_cache(cfg, 4, 1), phys_b,
+                                pad=0, page_size=4)
+    k_slab = export_page_slab(kp, jnp.asarray([1, 2], jnp.int32))
+    v_slab = export_page_slab(vp, jnp.asarray([1, 2], jnp.int32))
+    kp = import_page_slab(kp, k_slab, jnp.asarray([5, 6], jnp.int32))
+    vp = import_page_slab(vp, v_slab, jnp.asarray([5, 6], jnp.int32))
+    mixed = jnp.asarray([[5, 6, 3]], jnp.int32)
+    local = jnp.asarray([[1, 2, 3]], jnp.int32)
+    np.testing.assert_array_equal(
+        np.asarray(gather_pages(kp, mixed)),
+        np.asarray(gather_pages(kp, local)),
+    )
+    np.testing.assert_array_equal(
+        np.asarray(gather_pages(vp, mixed)),
+        np.asarray(gather_pages(vp, local)),
+    )
+
+
+def test_int8_copy_page_of_transferred_page_carries_scale_plane():
+    """COW duplication of a transferred-in page copies its scale plane
+    WITH the values — a reader of the copy dequantizes byte-identically
+    to a reader of the source."""
+    from pipegoose_tpu.serving import copy_page
+    from pipegoose_tpu.serving.kv_pool import (
+        export_page_slab,
+        import_page_slab,
+    )
+
+    cfg = bloom.BloomConfig(vocab_size=64, hidden_size=32, n_layer=2,
+                            n_head=2)
+    kp, vp = _int8_pool(cfg)
+    phys = np.zeros((4,), np.int32)
+    phys[0] = 1
+    kp, vp = write_prompt_pages(kp, vp, _fake_cache(cfg, 4, 2), phys,
+                                pad=0, page_size=4)
+    k_slab = export_page_slab(kp, jnp.asarray([1], jnp.int32))
+    v_slab = export_page_slab(vp, jnp.asarray([1], jnp.int32))
+    kp = import_page_slab(kp, k_slab, jnp.asarray([5], jnp.int32))
+    vp = import_page_slab(vp, v_slab, jnp.asarray([5], jnp.int32))
+    kp, vp = copy_page(kp, vp, jnp.asarray(5, jnp.int32),
+                       jnp.asarray(7, jnp.int32))
+    for bank in (kp, vp):
+        np.testing.assert_array_equal(np.asarray(bank["q"][:, 7]),
+                                      np.asarray(bank["q"][:, 5]))
+        np.testing.assert_array_equal(np.asarray(bank["scale"][:, 7]),
+                                      np.asarray(bank["scale"][:, 5]))
+    np.testing.assert_array_equal(
+        np.asarray(gather_pages(kp, jnp.asarray([[7]], jnp.int32))),
+        np.asarray(gather_pages(kp, jnp.asarray([[1]], jnp.int32))),
+    )

@@ -279,3 +279,150 @@ def test_negative_deadline_rejected():
     with pytest.raises(ValueError, match="deadline_s"):
         sched.submit(Request(prompt=np.arange(1, 5, dtype=np.int64),
                              max_new_tokens=4, deadline_s=-1.0), now=0.0)
+
+
+# -- disaggregated transfer ledger (serving/disagg/, ISSUE 13) --------------
+#
+# The pages-attached ledger case: a TRANSFER-staged request reserved
+# its worst case up front, materializes pages chunk by chunk off the
+# wire, and at admit_with_pages debits ONLY its unmaterialized tail —
+# never a second full prefill. These pins are what keeps disagg
+# admission from stranding a neighbor's reservation.
+
+
+def test_begin_transfer_reserves_worst_case():
+    pool = PagePool(9, 4)                     # 8 allocatable pages
+    sched = Scheduler(2, pool, max_context=32)
+    r = _req(8, 16)                           # worst 6 pages
+    r.uid = 100                               # cross-scheduler uid
+    assert sched.begin_transfer(r, now=0.0)
+    snap = sched.capacity_snapshot()
+    assert snap["outstanding_pages"] == 6
+    assert snap["transfer_requests"] == 1
+    # owed = whole target (nothing materialized) + whole decode budget
+    assert snap["transfer_tokens_owed"] == 8 + 16
+    # a competitor sees the reservation: worst 3 > 8 - 6 free-beyond
+    sched.submit(_req(4, 8), now=0.0)
+    assert not sched.can_admit(sched.queue[0])
+    assert sched.admit(now=1.0) == []
+    # and the ledger refuses a second transfer it cannot cover
+    r2 = _req(8, 8)                           # worst 4 > 2
+    r2.uid = 101
+    assert not sched.begin_transfer(r2, now=0.0)
+    assert sched.capacity_snapshot()["outstanding_pages"] == 6
+
+
+def test_transfer_pages_materializes_and_owed_shrinks_to_tail():
+    pool = PagePool(17, 4)
+    sched = Scheduler(2, pool, max_context=64)
+    r = _req(16, 8)                           # 4 prompt pages + 2 decode
+    r.uid = 7
+    assert sched.begin_transfer(r, now=0.0)
+    pages = sched.transfer_pages(r, 8)        # first shipment: 2 pages
+    assert len(pages) == 2
+    snap = sched.capacity_snapshot()
+    # the request object is untouched — it may still be live on the
+    # prefill scheduler while pages stream (the whole point)
+    assert r.pages == [] and r.status is Status.QUEUED
+    # owed: unmaterialized tail (16 - 8) + decode budget only
+    assert snap["transfer_tokens_owed"] == 8 + 8
+    # 2 of the 6 reserved pages materialized: 4 still outstanding
+    assert snap["outstanding_pages"] == 4
+    pages = sched.transfer_pages(r, 16)       # rest of the prompt
+    assert len(pages) == 4
+    assert sched.capacity_snapshot()["transfer_tokens_owed"] == 8
+
+
+def test_admit_with_pages_skips_prefill_and_debits_only_tail():
+    pool = PagePool(17, 4)
+    sched = Scheduler(2, pool, max_context=64)
+    r = _req(16, 8)
+    r.uid = 7
+    assert sched.begin_transfer(r, now=0.0)
+    sched.transfer_pages(r, 16)
+    r.status = Status.TRANSFER                # finish_handoff marked it
+    assert sched.admit_with_pages(r, first_token=9, now=2.0)
+    assert r.status is Status.DECODE
+    assert r.generated == [9]
+    assert r.prefilled_len == 16              # the whole prompt: no prefill
+    assert len(r.pages) == 4
+    assert r.outstanding == 2                 # ONLY the decode tail
+    snap = sched.capacity_snapshot()
+    assert snap["transfer_requests"] == 0
+    assert snap["outstanding_pages"] == 2
+    assert r.t_admit == 2.0
+    # decode proceeds exactly like a locally prefilled request
+    for t in range(7):
+        sched.ensure_page(r)
+        sched.record_token(r, 7, now=3.0 + t)
+    assert r.status is Status.DONE
+    assert pool.used_count == 0               # everything reclaimed
+    assert sched.capacity_snapshot()["outstanding_pages"] == 0
+
+
+def test_admit_with_pages_needs_handoff_and_free_slot():
+    pool = PagePool(17, 4)
+    sched = Scheduler(1, pool, max_context=64)
+    r = _req(8, 4)
+    r.uid = 1
+    assert sched.begin_transfer(r, now=0.0)
+    sched.transfer_pages(r, 8)
+    with pytest.raises(ValueError, match="handed-off"):
+        sched.admit_with_pages(r, 9, now=1.0)  # still QUEUED elsewhere
+    r.status = Status.TRANSFER
+    blocker = _req(4, 4)
+    sched.submit(blocker, now=0.0)
+    sched.admit(now=0.5)                      # takes the only slot
+    assert not sched.admit_with_pages(r, 9, now=1.0)
+    assert r.uid in sched.transfers           # stage intact, retry later
+    for t in range(4):
+        sched.ensure_page(blocker)
+        sched.record_token(blocker, 7, now=1.0 + t)
+    assert sched.admit_with_pages(r, 9, now=6.0)
+
+
+def test_abort_transfer_restores_ledger_and_pages():
+    pool = PagePool(17, 4)
+    sched = Scheduler(2, pool, max_context=64)
+    r = _req(16, 8)
+    r.uid = 3
+    free0 = pool.free_count
+    assert sched.begin_transfer(r, now=0.0)
+    sched.transfer_pages(r, 12)
+    assert pool.free_count == free0 - 3
+    sched.abort_transfer(r)
+    assert pool.free_count == free0
+    assert sched.capacity_snapshot()["outstanding_pages"] == 0
+    assert sched.capacity_snapshot()["transfer_requests"] == 0
+    with pytest.raises(ValueError, match="not staged"):
+        sched.abort_transfer(r)
+
+
+def test_prefill_only_ledger_reserves_prompt_not_decode():
+    """The prefill pool's side of the same satellite: a pool that
+    never decodes must not reserve decode pages — a request whose
+    prompt fits admits even when prompt + max_new would not."""
+    pool = PagePool(5, 4)                     # 4 allocatable pages
+    sched = Scheduler(2, pool, max_context=16, prefill_only=True,
+                      chunk_tokens=8)
+    r = _req(16, 64)                          # prompt 4 pages; decode huge
+    sched.submit(r, now=0.0)                  # fits: worst = prompt only
+    (admitted,) = sched.admit(now=0.0)
+    assert admitted is r
+    snap = sched.capacity_snapshot()
+    # owed tokens: the prefill target only, no decode budget
+    assert snap["active_tokens_remaining"] == 0
+    plain = Scheduler(2, PagePool(5, 4), max_context=96)
+    with pytest.raises(ValueError, match="pool only"):
+        plain.submit(_req(16, 64), now=0.0)
+
+
+def test_submit_reuse_uid_preserves_cross_scheduler_identity():
+    sched = Scheduler(1, PagePool(9, 4), max_context=32)
+    r = _req(4, 4)
+    r.uid = 41                                # prefill-scheduler uid
+    sched.submit(r, now=0.0, reuse_uid=True)
+    assert r.uid == 41
+    fresh = _req(4, 4)
+    sched.submit(fresh, now=0.0)
+    assert fresh.uid == 0                     # default: own counter

@@ -54,14 +54,16 @@ def test_components_are_contiguous_segments_and_sum_to_e2e(reg):
     tr.on_done(r, 6.0)                       # decode += 1.0
     (row,) = tr.attribution_summary()["requests"]
     assert row["components"] == {
-        "queue_s": 1.0, "prefill_s": 2.0, "decode_s": 2.0, "stall_s": 1.0,
+        "queue_s": 1.0, "prefill_s": 2.0, "transfer_s": 0.0,
+        "decode_s": 2.0, "stall_s": 1.0,
     }
     assert row["e2e_s"] == 6.0
     assert sum(row["components"].values()) == pytest.approx(row["e2e_s"])
     # TTFT decomposes from the accumulator snapshot at the first token
     assert row["ttft_s"] == 2.0
     assert row["ttft_components"] == {
-        "queue_s": 1.0, "prefill_s": 1.0, "decode_s": 0.0, "stall_s": 0.0,
+        "queue_s": 1.0, "prefill_s": 1.0, "transfer_s": 0.0,
+        "decode_s": 0.0, "stall_s": 0.0,
     }
     assert row["preemptions"] == 1
     # cache-savings estimate: prefill paid 2.0s for 12 forwarded tokens,
@@ -263,3 +265,93 @@ def test_set_clock_reanchors_wall_offset(reg):
     tr.set_clock(lambda: -1000.0)
     assert tr.wall_offset != off0
     tr.set_clock(tr.clock)  # same object: no-op
+
+
+# -- disagg transfer phase (serving/disagg/, ISSUE 13) ----------------------
+
+
+def test_transfer_phase_is_additive_and_exact(reg):
+    """The disagg lifecycle: queue -> prefill -> (first token at
+    handoff) -> transfer -> decode. TTFT excludes the transfer (the
+    token exists at handoff); the five components still sum to e2e
+    exactly."""
+    tr, t = _tracer(reg)
+    r = _req(0)
+    tr.on_submit(r, 0.0)
+    r.slot = 0
+    tr.on_admit(r, 1.0)                      # queue = 1.0
+    tr.on_prefill_chunk(r, 1.5, dur_s=0.4, tokens=8)
+    # streamed chunk lands DURING prefill: counters only, no transition
+    tr.on_transfer_chunk(r, 1.6, dur_s=0.05, tokens=8, pages=2,
+                         nbytes=4096)
+    tr.on_first_token(r, 2.0)                # prefill = 1.0
+    tr.on_transfer_start(r, 2.0)             # decode += 0.0
+    tr.on_transfer_chunk(r, 2.5, dur_s=0.1, tokens=4, pages=1,
+                         nbytes=2048)
+    tr.on_transfer_done(r, 3.0)              # transfer = 1.0
+    r.finish_reason = "length"
+    tr.on_done(r, 5.0)                       # decode += 2.0
+    (row,) = tr.attribution_summary()["requests"]
+    assert row["components"] == {
+        "queue_s": 1.0, "prefill_s": 1.0, "transfer_s": 1.0,
+        "decode_s": 2.0, "stall_s": 0.0,
+    }
+    assert sum(row["components"].values()) == pytest.approx(row["e2e_s"])
+    assert row["ttft_s"] == 2.0              # queue + prefill, no transfer
+    tl = tr.completed[-1]
+    assert tl.transfer_chunks == 2
+    assert tl.transfer_pages == 3
+    assert tl.transfer_bytes == 4096 + 2048
+    assert tl.transfer_compute_s == pytest.approx(0.15)
+    # the attribution histogram saw the new component
+    snap = reg.snapshot()
+    assert snap["histograms"]["serving.attrib.transfer_seconds"]["count"] == 1
+
+
+def test_transfer_failure_books_requeue_as_queue_time(reg):
+    """The fallback path: transfer fails, the request re-submits on the
+    decode pool — the post-failure wait books as queue, the sum stays
+    exact."""
+    tr, t = _tracer(reg)
+    r = _req(1)
+    tr.on_submit(r, 0.0)
+    r.slot = 0
+    tr.on_admit(r, 1.0)
+    tr.on_first_token(r, 2.0)
+    tr.on_transfer_start(r, 2.0)
+    tr.on_submit(r, 3.0)                     # fallback resubmit: transfer=1
+    tr.on_admit(r, 4.0)                      # queue += 1
+    tr.on_resume(r, 5.0)                     # (re-)prefill = 1
+    r.finish_reason = "length"
+    tr.on_done(r, 6.0)                       # decode += 1
+    (row,) = tr.attribution_summary()["requests"]
+    assert row["components"]["transfer_s"] == 1.0
+    assert row["components"]["queue_s"] == 2.0
+    assert sum(row["components"].values()) == pytest.approx(row["e2e_s"])
+
+
+def test_perfetto_transfer_track(reg):
+    """transfer_start/chunk/done render on a dedicated transfer track
+    with a named thread row."""
+    tr, t = _tracer(reg)
+    r = _req(2)
+    tr.on_submit(r, 0.0)
+    r.slot = 1
+    tr.on_admit(r, 1.0)
+    tr.on_first_token(r, 2.0)
+    tr.on_transfer_start(r, 2.0)
+    tr.on_transfer_chunk(r, 2.5, dur_s=0.1, tokens=4, pages=1,
+                         nbytes=2048)
+    tr.on_transfer_done(r, 3.0)
+    r.finish_reason = "length"
+    tr.on_done(r, 4.0)
+    evs = request_trace_events(tr)
+    xfer = [e for e in evs if e.get("cat") == "request.transfer"]
+    assert len(xfer) == 1 and xfer[0]["tid"] == 2_000
+    assert xfer[0]["dur"] == pytest.approx(1e6)      # 1 s in µs
+    chunks = [e for e in evs if e.get("cat") == "request.transfer_chunk"]
+    assert len(chunks) == 1 and chunks[0]["tid"] == 2_000
+    assert chunks[0]["args"]["nbytes"] == 2048
+    rows = [e["args"]["name"] for e in evs
+            if e.get("ph") == "M" and e["name"] == "thread_name"]
+    assert any(name.startswith("transfer") for name in rows)

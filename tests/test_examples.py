@@ -38,6 +38,8 @@ CASES = [
                                "/tmp/pipegoose_reqtrace_demo_test"]),
     ("comm_overlap_demo.py", ["--fake-devices", "8", "--tp", "2",
                               "--dp", "4"]),
+    ("disagg_serving_demo.py", ["--fake-devices", "8", "--tp-prefill", "2",
+                                "--requests", "4"]),
     ("plan_parallelism_demo.py", ["--fake-devices", "8", "--top-k", "5"]),
     ("elastic_training_demo.py", ["--fake-devices", "8", "--tp", "2",
                                   "--dp", "4", "--out-dir",
