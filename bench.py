@@ -697,6 +697,27 @@ def run_bench(force_cpu: bool) -> None:
         if os.environ.get("BENCH_CHILD"):
             emit(results)
 
+    # the best PLAIN variant (comm variants carry their own mesh/step
+    # shape) + its one-step train fn: shared by the mesh-doctor
+    # artifact (shape-only compile) and the BENCH_HISTORY profile (real
+    # execution) below — ONE definition of "the benched step"
+    ok_variants = [
+        k for k, v in results.items() if "error" not in v and k in variants
+    ]
+    best_variant = (
+        max(ok_variants, key=lambda k: results[k]["tokens_per_sec"])
+        if ok_variants else None
+    )
+
+    def bench_one_step(cfg, opt):
+        def one_step(params, opt_state, ids):
+            loss, grads = jax.value_and_grad(bloom.loss_fn)(
+                params, ids, None, ids, cfg
+            )
+            updates, opt_state = opt.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, loss
+        return one_step
+
     # mesh-doctor artifact (BENCH_DOCTOR_JSON, default bench_doctor.json;
     # empty disables): the benched step's ACTUAL shardings + per-device
     # HBM table (telemetry/doctor.py), recorded per bench run so a
@@ -704,50 +725,34 @@ def run_bench(force_cpu: bool) -> None:
     # as a slower number. Shape-only AOT compile — nothing executes, and
     # a doctor failure never discards the measurements above.
     doctor_path = os.environ.get("BENCH_DOCTOR_JSON", "bench_doctor.json")
-    # comm variants carry their own mesh/step shape — the single-device
-    # AOT doctor below only understands the plain `variants` table
-    ok_variants = [
-        k for k, v in results.items() if "error" not in v and k in variants
-    ]
-    if doctor_path and ok_variants:
+    if doctor_path and best_variant is not None:
         try:
-            import optax as _optax
-
             from pipegoose_tpu.telemetry import doctor as _doctor
             from pipegoose_tpu.telemetry.exporters import atomic_write_text
 
-            best_v = max(ok_variants,
-                         key=lambda k: results[k]["tokens_per_sec"])
-            dcfg, _, dseq = variants[best_v]
-            dbatch = results[best_v]["batch"]
+            dcfg, _, dseq = variants[best_variant]
+            dbatch = results[best_variant]["batch"]
             p_sds = jax.eval_shape(
                 lambda k: bloom.init_params(dcfg, k), jax.random.PRNGKey(0)
             )
-            dopt = _optax.adam(1e-4)
+            dopt = optax.adam(1e-4)
             o_sds = jax.eval_shape(dopt.init, p_sds)
             ids_sds = jax.ShapeDtypeStruct((dbatch, dseq), jnp.int32)
 
-            def one_step(params, opt_state, ids):
-                loss, grads = jax.value_and_grad(bloom.loss_fn)(
-                    params, ids, None, ids, dcfg
-                )
-                updates, opt_state = dopt.update(grads, opt_state, params)
-                return _optax.apply_updates(params, updates), opt_state, loss
-
             report = _doctor.diagnose(
-                jax.jit(one_step, donate_argnums=(0, 1)),
+                jax.jit(bench_one_step(dcfg, dopt), donate_argnums=(0, 1)),
                 p_sds, o_sds, ids_sds,
                 labels=("params", "opt_state", "batch"),
             )
             _doctor.set_doctor_gauges(report, registry=reg)
             atomic_write_text(doctor_path, json.dumps({
-                "variant": best_v, "device": device_kind,
+                "variant": best_variant, "device": device_kind,
                 "batch": dbatch, "seq": dseq,
                 "report": report.to_json(),
             }, indent=1))
             if tel is not None:
                 reg.event(
-                    "bench.doctor", variant=best_v, path=doctor_path,
+                    "bench.doctor", variant=best_variant, path=doctor_path,
                     replicated_bytes=report.sharding.replicated_bytes,
                     resharding_bytes=report.sharding.resharding_bytes,
                     hbm_peak_bytes=report.memory.peak_bytes,
@@ -834,6 +839,82 @@ def run_bench(force_cpu: bool) -> None:
         serving = serving_block()
     except Exception as e:  # noqa: BLE001
         serving = {"error": f"{type(e).__name__}: {e}"[:300]}
+
+    # perf-trajectory history (BENCH_HISTORY_JSONL, default
+    # BENCH_HISTORY.jsonl; empty disables): ONE summary row per bench
+    # run — run id, per-arm tokens/s, best-variant MFU, and the
+    # MEASURED component fractions of one profiled train step
+    # (telemetry/xprof.py) — appended so the repo's perf trajectory is
+    # machine-readable. The perf sentinel (telemetry/sentinel.py) reads
+    # the tail as its baseline window and stamps a regression verdict
+    # on the row ("idle time 2.1x baseline") before it is written.
+    # Non-fatal like the doctor/plan artifacts.
+    history_path = os.environ.get("BENCH_HISTORY_JSONL",
+                                  "BENCH_HISTORY.jsonl")
+    if history_path:
+        try:
+            from pipegoose_tpu.telemetry.sentinel import PerfSentinel
+            from pipegoose_tpu.telemetry.xprof import profile_step
+
+            row = {
+                "run_id": time.strftime("%Y%m%dT%H%M%SZ", time.gmtime()),
+                "device": device_kind,
+                "arms": {
+                    k: v["tokens_per_sec"] for k, v in results.items()
+                    if "error" not in v
+                },
+            }
+            if best_variant is not None:
+                row["best_variant"] = best_variant
+                row["tokens_per_s"] = results[best_variant]["tokens_per_sec"]
+                row["mfu"] = results[best_variant]["mfu"]
+                hcfg, _, hseq = variants[best_variant]
+                hbatch = results[best_variant]["batch"]
+                hparams = bloom.init_params(hcfg, jax.random.PRNGKey(0))
+                hopt = optax.adam(1e-4)
+                hopt_state = hopt.init(hparams)
+                hids = jnp.asarray(np.random.RandomState(0).randint(
+                    0, hcfg.vocab_size, (hbatch, hseq)))
+                # the SAME step the doctor artifact above AOT-compiled,
+                # this time executed for real under the profiler
+                prof = profile_step(
+                    jax.jit(bench_one_step(hcfg, hopt),
+                            donate_argnums=(0, 1)),
+                    hparams, hopt_state, hids, steps=2, warmup=2,
+                    update_args=lambda out, a: (out[0], out[1], a[2]),
+                )
+                row["profile"] = {
+                    "source": prof.source,
+                    "wall_step_s": prof.wall_step_s,
+                    "compute_s": prof.compute_s,
+                    "comm_s": prof.comm_s,
+                    "idle_s": prof.idle_s,
+                    "comm_by_axes": prof.comm_by_axes,
+                    "compute_fraction": round(prof.compute_fraction, 4),
+                    "comm_fraction": round(prof.comm_fraction, 4),
+                    "idle_fraction": round(prof.idle_fraction, 4),
+                    "measured_mfu": prof.mfu,
+                }
+            # baseline = same-device healthy rows only: a CPU-fallback
+            # run judged against a TPU trajectory (or vice versa) would
+            # stamp a bogus regression into the history forever
+            sentinel = PerfSentinel.from_history(
+                history_path, device=device_kind, window=8
+            )
+            verdict = sentinel.observe(row)
+            if verdict is not None:
+                reason = getattr(verdict, "reason",
+                                 None) or verdict.get("reason")
+                row["perf_regression"] = reason
+                sys.stderr.write(f"bench perf sentinel: REGRESSION vs "
+                                 f"history tail — {reason}\n")
+            with open(history_path, "a") as hf:
+                hf.write(json.dumps(row) + "\n")
+            if tel is not None:
+                reg.event("bench.history", path=history_path,
+                          regression=row.get("perf_regression"))
+        except Exception as e:  # noqa: BLE001
+            sys.stderr.write(f"bench history failed (non-fatal): {e}\n")
     if tel is not None:
         reg.event("bench.serving", **{
             k: v for k, v in serving.items() if not isinstance(v, dict)

@@ -24,6 +24,7 @@ from pipegoose_tpu.planner.cost import CostModel, hbm_check, score_breakdown
 from pipegoose_tpu.planner.planner import (
     best_layout_at,
     evaluate_candidate,
+    last_plan_report,
     plan_layout_at,
     run_plan,
     set_planner_gauges,
@@ -60,6 +61,7 @@ __all__ = [
     "plan_layout_at",
     "find_candidate",
     "hbm_check",
+    "last_plan_report",
     "mesh_factorizations",
     "run_plan",
     "score_breakdown",

@@ -34,6 +34,20 @@ from pipegoose_tpu.telemetry import doctor
 
 logger = logging.getLogger("pipegoose_tpu.planner")
 
+# the most recent PlanReport produced by run_plan in this process —
+# what the ops server's /debug/plan serves when wired to
+# last_plan_report (bench.py, the CLI, and ElasticRecovery's
+# planner-backed replan all route through run_plan, so one cache
+# covers every producer)
+_LAST_PLAN_REPORT: Optional[PlanReport] = None
+
+
+def last_plan_report() -> Optional[PlanReport]:
+    """The newest :class:`PlanReport` this process produced (None until
+    the first ``run_plan``) — pass ``plan=last_plan_report`` to
+    ``OpsServer`` for a live ``/debug/plan``."""
+    return _LAST_PLAN_REPORT
+
 
 def evaluate_candidate(
     builder: Any,
@@ -151,6 +165,8 @@ def run_plan(
     for p in pruned:
         logger.info("planner: pruned %s — %s", p.name, p.prune_reason)
     set_planner_gauges(report, registry=registry)
+    global _LAST_PLAN_REPORT
+    _LAST_PLAN_REPORT = report
     return report
 
 

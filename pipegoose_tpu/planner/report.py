@@ -161,6 +161,84 @@ class PlanReport:
         cur.measured = m
         return cur
 
+    def record_profile(
+        self, candidate: Candidate, profile: Any
+    ) -> Optional[CandidateResult]:
+        """Attach a measured ``telemetry.xprof.StepProfile`` to the
+        matching candidate — the component-level measurement
+        (compute / per-axis collective / idle seconds) next to the
+        aggregate tokens/s ``record_measurement`` tracks. Derives
+        ``tokens_per_sec`` from the profile's fenced wall when the
+        caller has not recorded one, so a profile alone closes the
+        predicted-vs-measured loop. Returns the updated result, None if
+        the candidate is not in the plan."""
+        cur = self.find(candidate)
+        if cur is None:
+            return None
+        pj = profile.to_json() if hasattr(profile, "to_json") \
+            else dict(profile)
+        m = dict(cur.measured or {})
+        m["profile"] = pj
+        wall = float(pj.get("wall_step_s") or 0.0)
+        if not m.get("tokens_per_sec") and wall > 0:
+            m["tokens_per_sec"] = self.tokens_per_step / wall
+        return self.record_measurement(candidate, m)
+
+    def calibration_observations(self) -> List[Dict[str, Any]]:
+        """The ``CostModel.calibrate`` input: one observation per
+        candidate carrying a recorded profile (static breakdown +
+        measured components + the overlap flag)."""
+        return [
+            {
+                "profile": c.measured["profile"],
+                "breakdown": c.breakdown,
+                "overlap_tp": bool(getattr(c.candidate, "overlap_tp",
+                                           False)),
+            }
+            for c in self.candidates
+            if c.measured and c.measured.get("profile")
+        ]
+
+    def calibrate_cost_model(self) -> Any:
+        """Fit this plan's cost model to its recorded profiles
+        (``CostModel.calibrate``) and return the calibrated model —
+        feed it back through :meth:`rescore` to close the loop."""
+        from pipegoose_tpu.planner.cost import CostModel
+
+        return CostModel.from_json(self.cost_model).calibrate(
+            self.calibration_observations()
+        )
+
+    def rescore(self, cost_model: Any) -> "PlanReport":
+        """Re-score every feasible candidate (with an embedded doctor
+        report) under a new cost model — the calibration loop's second
+        half — refreshing scores, breakdowns, the stored model, and the
+        measured-over-predicted ratios, then re-sorting. Candidates
+        without a doctor report keep their old score (their compiled
+        schedule is gone; logged via the returned report's
+        ``cost_model["calibration"]`` provenance, never silently
+        rescored from nothing)."""
+        from pipegoose_tpu.planner.cost import score_breakdown
+
+        for c in self.candidates:
+            if not c.feasible or c.doctor is None:
+                continue
+            bubble = float(c.breakdown.get("bubble_fraction", 0.0))
+            c.breakdown = score_breakdown(
+                c.candidate, c.doctor, cost_model,
+                tokens_per_step=self.tokens_per_step,
+                bubble_fraction=bubble,
+            )
+            c.score = float(c.breakdown["score"])
+            if c.measured and c.measured.get("tokens_per_sec") and c.score:
+                c.measured["predicted_tokens_per_sec"] = c.score
+                c.measured["measured_over_predicted"] = (
+                    float(c.measured["tokens_per_sec"]) / c.score
+                )
+        self.cost_model = cost_model.to_json()
+        self.sort()
+        return self
+
     def predicted_vs_measured(self) -> Dict[str, Any]:
         """Summary of every measured candidate: per-candidate ratios
         plus whether the predicted-best and measured-best agree — the

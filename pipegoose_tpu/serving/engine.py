@@ -183,7 +183,8 @@ class ServingEngine:
                  weight_dtype: Optional[str] = None,
                  kv_dtype: Optional[str] = None,
                  weight_group_size: int = 32,
-                 prefill_only: bool = False):
+                 prefill_only: bool = False,
+                 sentinel=None):
         """``recorder``: optional ``telemetry.FlightRecorder`` — every
         decode step lands in its ring, and the no-decode-progress
         watchdog dumps a black box through it before raising.
@@ -238,7 +239,15 @@ class ServingEngine:
         self.recorder = recorder
         self.stall_patience = stall_patience
         self.tracer = tracer
+        # ``sentinel``: optional ``telemetry.sentinel.PerfSentinel`` —
+        # every finished run's tokens/s + decode-step/idle split is
+        # compared against its rolling baseline, and a regression fires
+        # a perf_regression black box naming the component. Default
+        # None keeps finish_run at one attribute read + branch
+        # (guard-tested < 5 µs, the tracer/recorder contract).
+        self.sentinel = sentinel
         self.last_doctor_report = None   # refreshed by doctor()/doctor_chunk()
+        self.last_step_profile = None    # refreshed by profile()
         self._run: Optional[_RunState] = None   # live steppable run
         if recorder is not None and tracer is not None:
             # a decode_stall (or any) black box then embeds the live
@@ -564,6 +573,53 @@ class ServingEngine:
         set_doctor_gauges(report, registry=registry or self.registry)
         self.last_doctor_report = report
         return report
+
+    def profile(self, steps: int = 3, warmup: int = 2,
+                trace_dir: Optional[str] = None, registry=None):
+        """Measured device-time attribution (telemetry/xprof.py) of the
+        compiled paged DECODE step — the runtime twin of
+        :meth:`doctor`: runs the real step on a synthetic full-slot
+        batch whose page tables point at the NULL page (so the writes
+        land in the page whose content is garbage by design and no live
+        request's KV is touched), under the XLA profiler, and returns
+        the ``StepProfile`` splitting the fenced step into compute /
+        per-axis collectives / idle. Cached on ``last_step_profile``
+        (the ops server's ``/debug/profile`` provider). Not callable
+        mid-run — the step donates the KV pages and the engine adopts
+        the final buffers afterwards."""
+        from pipegoose_tpu.telemetry.xprof import profile_step
+
+        if self._run is not None:
+            raise RuntimeError("profile() cannot run during a serving run")
+        i32 = jnp.int32
+        tokens = jnp.zeros((self.num_slots,), i32)
+        table = jnp.zeros((self.num_slots, self.table_width), i32)
+        seq_lens = jnp.zeros((self.num_slots,), i32)
+        final: dict = {}
+
+        def update(out, cur):
+            # out = (next_tokens, k_pages, v_pages); the pages were
+            # donated — thread (and finally adopt) the new buffers
+            final["k"], final["v"] = out[1], out[2]
+            return (cur[0], cur[1], out[1], out[2], cur[4], cur[5])
+
+        try:
+            profile = profile_step(
+                self._step, self.params, tokens, self.k_pages, self.v_pages,
+                table, seq_lens,
+                steps=steps, warmup=warmup, update_args=update,
+                mesh=self.mesh, trace_dir=trace_dir,
+                registry=registry or self.registry,
+            )
+        finally:
+            # the FIRST executed call already donated the stored page
+            # buffers: adopt the newest generation even when trace
+            # parsing/export raises, or the engine's next decode step
+            # would touch deleted arrays
+            if final:
+                self.k_pages, self.v_pages = final["k"], final["v"]
+        self.last_step_profile = profile
+        return profile
 
     def memory_report(self, registry=None) -> dict:
         """Host-side HBM census of the engine's RESIDENT state — the
@@ -1341,8 +1397,42 @@ class ServingEngine:
                     rs.spec_accepted / rs.spec_drafted, 4)
                 if rs.spec_drafted else 0.0,
             }
+        self._sentinel_observe(rs, wall)
         self._run = None
         return outputs, metrics
+
+    def _sentinel_observe(self, rs, wall: float) -> None:
+        """Per-run perf-sentinel hook: with no sentinel attached (the
+        default) the cost is this one attribute read + branch — the
+        disabled-path guard test times exactly this call. With one, the
+        run's throughput and its decode-step vs idle split feed the
+        rolling baseline; a regression dumps a perf_regression black
+        box naming the component ("idle time 3.2x baseline")."""
+        s = self.sentinel
+        if s is None:
+            return
+        if rs.steps == 0:
+            # a run with no decode steps — everything deadline-shed, or
+            # a prefill-only/handoff run — is the DEGRADED-BUT-HEALTHY
+            # mode (docs/robustness.md), not a perf sample: tokens/s=0
+            # and idle=wall would fire a spurious perf_regression
+            # against a per-step baseline it isn't comparable to
+            return
+        steps = rs.steps
+        s.observe(
+            {
+                "decode_step_s": rs.step_time / steps,
+                # host-side time between decode steps (queue handling,
+                # prefill waits, stalls) — the component a host stall
+                # or scheduler regression inflates
+                "idle_s": max(wall - rs.step_time, 0.0) / steps,
+            },
+            step=rs.steps,
+            tokens_per_s=rs.generated_total / wall if wall > 0 else 0.0,
+            context={"num_slots": self.num_slots,
+                     "decode_steps": rs.steps,
+                     "wall_s": wall},
+        )
 
 
 QUANT_BENCH_ARMS = {

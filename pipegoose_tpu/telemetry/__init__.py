@@ -26,6 +26,12 @@ health stats fused into the compiled train step (``health_stats``,
 black-box dumps, feeding ``FailureDetector``/``AutoRecovery``), and
 Perfetto/Chrome trace export (``ChromeTraceExporter``,
 ``pipeline_trace_events``, the ``pipeline.bubble_fraction`` gauge).
+The MEASURED layer closes the loop: ``profile_step``/``StepProfile``
+(telemetry/xprof.py) attribute a real step's device time to compute /
+per-mesh-axis collectives / idle from XLA profiler traces, and
+``PerfSentinel`` (telemetry/sentinel.py) watches runs against a
+rolling baseline, firing ``perf_regression`` black boxes that name
+the regressed component.
 
 See docs/observability.md for the metric catalog and the MFU
 methodology.
@@ -101,7 +107,16 @@ from pipegoose_tpu.telemetry.registry import (
     enable,
     get_registry,
 )
+from pipegoose_tpu.telemetry.sentinel import (
+    PerfSentinel,
+    read_bench_history,
+)
 from pipegoose_tpu.telemetry.spans import current_span_path, span
+from pipegoose_tpu.telemetry.xprof import (
+    StepProfile,
+    profile_step,
+    set_profile_gauges,
+)
 
 __all__ = [
     "ChromeTraceExporter",
@@ -119,9 +134,11 @@ __all__ = [
     "PEAK_DCI_BYTES",
     "PEAK_FLOPS",
     "PEAK_ICI_BYTES",
+    "PerfSentinel",
     "PrometheusTextfileExporter",
     "RequestTimeline",
     "RequestTracer",
+    "StepProfile",
     "SLOMonitor",
     "SLOTarget",
     "ShardingRegressionError",
@@ -150,9 +167,12 @@ __all__ = [
     "router_trace_events",
     "peak_flops_for",
     "pipeline_trace_events",
+    "profile_step",
+    "read_bench_history",
     "register_pipeline_gauges",
     "request_trace_events",
     "set_doctor_gauges",
+    "set_profile_gauges",
     "estimated_wire_bytes",
     "wire_bytes_by_axes",
     "wire_bytes_by_op",

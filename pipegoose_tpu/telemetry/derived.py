@@ -21,6 +21,7 @@ copy.
 from __future__ import annotations
 
 import re
+import warnings
 from typing import Any, Callable, Dict, Optional
 
 import jax
@@ -93,9 +94,29 @@ HBM_BW_BYTES: Dict[str, float] = {
     "cpu": 50e9,
 }
 
+# mesh axes that ride the data-center network instead of ICI — the ONE
+# definition both the planner cost model (CostModel.dci_axes default)
+# and the measured fabric-utilization attribution (telemetry/xprof.py)
+# key their bandwidth choice on. "diloco" is the cross-slice outer
+# loop (optim/diloco.py).
+DCI_AXES: tuple = ("diloco",)
+
+
+# documented fallbacks for device kinds absent from the spec tables —
+# finite, clearly-not-real numbers (the "cpu" placeholder philosophy)
+# so an unknown chip plans/meters with the same code path instead of
+# dividing by zero. The lookup WARNS when it falls back: a silent
+# default would let a typo'd --device-kind quietly score every layout
+# against the wrong machine.
+DEFAULT_PEAK_FLOPS = 1e12
+DEFAULT_ICI_BYTES = 10e9
+DEFAULT_DCI_BYTES = 1e9
+DEFAULT_HBM_BYTES = 16 * 1024**3
+DEFAULT_HBM_BW_BYTES = 100e9
+
 
 def _kind_lookup(table: Dict[str, float], device_kind: Optional[str],
-                 default: float) -> float:
+                 default: float, table_name: str = "") -> float:
     if device_kind is None:
         dev = jax.devices()[0]
         device_kind = getattr(dev, "device_kind", dev.platform)
@@ -103,37 +124,54 @@ def _kind_lookup(table: Dict[str, float], device_kind: Optional[str],
     for k, v in table.items():
         if k in kind:
             return v
+    warnings.warn(
+        f"unknown device kind {device_kind!r}: no {table_name or 'spec-table'}"
+        f" entry matches — falling back to the documented default "
+        f"{default:g} (plans/meters against this kind are placeholders, "
+        f"not hardware numbers)",
+        stacklevel=3,
+    )
     return default
 
 
 def peak_flops_for(device_kind: Optional[str] = None) -> float:
     """Peak FLOP/s for a device-kind string (substring match, like
-    bench.py always did); defaults to the first visible device."""
-    return _kind_lookup(PEAK_FLOPS, device_kind, 1e12)
+    bench.py always did); defaults to the first visible device. Unknown
+    kinds fall back LOUDLY (UserWarning) to ``DEFAULT_PEAK_FLOPS``."""
+    return _kind_lookup(PEAK_FLOPS, device_kind, DEFAULT_PEAK_FLOPS,
+                        "PEAK_FLOPS")
 
 
 def ici_bytes_per_s_for(device_kind: Optional[str] = None) -> float:
     """Per-chip intra-slice interconnect bandwidth (B/s) for a
-    device-kind string; defaults to the first visible device."""
-    return _kind_lookup(PEAK_ICI_BYTES, device_kind, 10e9)
+    device-kind string; defaults to the first visible device. Unknown
+    kinds fall back LOUDLY to ``DEFAULT_ICI_BYTES``."""
+    return _kind_lookup(PEAK_ICI_BYTES, device_kind, DEFAULT_ICI_BYTES,
+                        "PEAK_ICI_BYTES")
 
 
 def dci_bytes_per_s_for(device_kind: Optional[str] = None) -> float:
-    """Per-chip cross-slice (data-center network) bandwidth (B/s)."""
-    return _kind_lookup(PEAK_DCI_BYTES, device_kind, 1e9)
+    """Per-chip cross-slice (data-center network) bandwidth (B/s).
+    Unknown kinds fall back LOUDLY to ``DEFAULT_DCI_BYTES``."""
+    return _kind_lookup(PEAK_DCI_BYTES, device_kind, DEFAULT_DCI_BYTES,
+                        "PEAK_DCI_BYTES")
 
 
 def hbm_bytes_for(device_kind: Optional[str] = None) -> float:
     """Per-chip HBM capacity (bytes) from the spec table — the planner's
     feasibility budget where the backend reports no live ``bytes_limit``
-    (fake CPU devices report none)."""
-    return _kind_lookup(HBM_BYTES, device_kind, 16 * 1024**3)
+    (fake CPU devices report none). Unknown kinds fall back LOUDLY to
+    ``DEFAULT_HBM_BYTES``."""
+    return _kind_lookup(HBM_BYTES, device_kind, DEFAULT_HBM_BYTES,
+                        "HBM_BYTES")
 
 
 def hbm_bw_bytes_per_s_for(device_kind: Optional[str] = None) -> float:
     """Per-chip HBM bandwidth (B/s) — the memory-bound decode cost
-    model's denominator (planner/serving.py)."""
-    return _kind_lookup(HBM_BW_BYTES, device_kind, 100e9)
+    model's denominator (planner/serving.py). Unknown kinds fall back
+    LOUDLY to ``DEFAULT_HBM_BW_BYTES``."""
+    return _kind_lookup(HBM_BW_BYTES, device_kind, DEFAULT_HBM_BW_BYTES,
+                        "HBM_BW_BYTES")
 
 
 def mfu(flops_per_step: float, step_seconds: float,

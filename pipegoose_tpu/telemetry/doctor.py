@@ -67,6 +67,9 @@ INTENTIONAL_PRIMITIVES = frozenset({
 })
 
 _OP_NAME_RE = re.compile(r'op_name="([^"]*)"')
+_INSTR_NAME_RE = re.compile(
+    r"^\s*(?:ROOT\s+)?%?([A-Za-z0-9_.-]+)\s*=", re.MULTILINE
+)
 _RG_IOTA_RE = re.compile(
     r"replica_groups=\[([0-9,]+)\]<=\[([0-9,]+)\](?:T\(([0-9,]+)\))?"
 )
@@ -105,6 +108,11 @@ class CollectiveInfo:
     mesh_axes: Optional[Tuple[str, ...]]  # axes the groups span, if resolvable
     source: str                       # last metadata op_name component, "" if none
     intentional: bool                 # user collective primitive vs GSPMD-inserted
+    # HLO instruction name ("all-reduce.2") — the key the measured
+    # profiler attribution (telemetry/xprof.py) joins trace events on,
+    # so a profiled collective's device time lands on THIS schedule row.
+    # "" on reports from artifacts written before the field existed.
+    name: str = ""
 
 
 @dataclasses.dataclass
@@ -170,6 +178,7 @@ class ShardingReport:
                 mesh_axes=tuple(c["mesh_axes"]) if c.get("mesh_axes") else None,
                 source=c.get("source", ""),
                 intentional=bool(c["intentional"]),
+                name=c.get("name", ""),
             ) for c in d["collectives"]],
         )
 
@@ -293,11 +302,18 @@ class DoctorReport:
     # backend reports no cost analysis, and on reports deserialized
     # from artifacts written before the field existed.
     cost_flops: Optional[float] = None
+    # distinct HLO instructions of the compiled module — the static
+    # driver of per-step dispatch cost (a calibrated planner cost model
+    # prices host/thunk dispatch per instruction; telemetry/xprof.py
+    # measures the same count from its own HLO parse). None on older
+    # artifacts and backends without HLO text export.
+    hlo_instructions: Optional[int] = None
 
     def to_json(self) -> dict:
         return {"sharding": self.sharding.to_json(),
                 "memory": self.memory.to_json(),
-                "cost_flops": self.cost_flops}
+                "cost_flops": self.cost_flops,
+                "hlo_instructions": self.hlo_instructions}
 
     @classmethod
     def from_json(cls, d: dict) -> "DoctorReport":
@@ -307,7 +323,10 @@ class DoctorReport:
         return cls(sharding=ShardingReport.from_json(d["sharding"]),
                    memory=MemoryReport.from_json(d["memory"]),
                    cost_flops=(None if d.get("cost_flops") is None
-                               else float(d["cost_flops"])))
+                               else float(d["cost_flops"])),
+                   hlo_instructions=(
+                       None if d.get("hlo_instructions") is None
+                       else int(d["hlo_instructions"])))
 
     def format_table(self, max_rows: int = 32) -> str:
         return (self.sharding.format_table(max_rows=max_rows)
@@ -506,6 +525,15 @@ def _groups_to_axes(
     return None
 
 
+def hlo_instruction_names(hlo_text: str) -> set:
+    """Distinct HLO instruction names of a module's text — the join
+    key between the compiled schedule and profiler trace op events
+    (telemetry/xprof.py), and the static dispatch-cost driver
+    (``DoctorReport.hlo_instructions``). ONE definition: the profiler
+    and the planner must count with the same rule."""
+    return set(_INSTR_NAME_RE.findall(hlo_text))
+
+
 def _source_primitive(line: str) -> str:
     m = _OP_NAME_RE.search(line)
     if not m:
@@ -528,12 +556,14 @@ def parse_collective_schedule(
             axes = _groups_to_axes(_parse_groups(c["line"]), mesh_axes or {})
         except (ValueError, IndexError):
             axes = None
+        nm = _INSTR_NAME_RE.match(c["line"])
         out.append(CollectiveInfo(
             op=c["op"],
             bytes=c["bytes"],
             mesh_axes=axes,
             source=src,
             intentional=src in INTENTIONAL_PRIMITIVES,
+            name=nm.group(1) if nm else "",
         ))
     return out
 
@@ -773,8 +803,10 @@ def diagnose(
         cost_flops = float(f) if f is not None else None
     except Exception:  # noqa: BLE001 - cost analysis is advisory
         pass
+    n_instr = len(hlo_instruction_names(hlo)) if hlo else None
     return DoctorReport(sharding=sharding_report, memory=memory_report,
-                        cost_flops=cost_flops)
+                        cost_flops=cost_flops,
+                        hlo_instructions=n_instr or None)
 
 
 # -- wire-byte estimation --------------------------------------------------

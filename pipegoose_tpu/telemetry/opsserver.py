@@ -23,6 +23,15 @@ background HTTP endpoint over the same telemetry objects:
                           stuck and where is its latency going".
 - ``GET /debug/doctor``   the last mesh-doctor ``DoctorReport`` as JSON
                           (the compiled program's sharding plan).
+- ``GET /debug/profile``  the last measured ``StepProfile``
+                          (telemetry/xprof.py) as JSON — where the
+                          step's device time went: compute vs per-axis
+                          collectives vs idle, measured MFU.
+- ``GET /debug/plan``     the last planner ``PlanReport``
+                          (pipegoose_tpu/planner/) as JSON — the ranked
+                          layout space, scores, prune reasons
+                          (``planner.last_plan_report`` is the natural
+                          provider).
 - ``GET /debug/fleet``    the control plane's live fleet status
                           (serving/control_plane/): per-replica state +
                           load, router stats, per-tenant fair-share
@@ -48,6 +57,17 @@ from pipegoose_tpu.utils.procindex import RankFilter as _RankFilter
 
 PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
+# /debug endpoints that serve an attached report/provider verbatim:
+# path -> (OpsServer attribute, 404-message label). One branch serves
+# all of them; adding the next debug surface is one row here plus a
+# constructor knob.
+_PROVIDER_ENDPOINTS = {
+    "/debug/doctor": ("_doctor", "doctor report"),
+    "/debug/profile": ("_profile", "step profile"),
+    "/debug/plan": ("_plan", "plan report"),
+    "/debug/fleet": ("_fleet", "fleet status provider"),
+}
+
 
 class OpsServer:
     """Background ops HTTP endpoint (see module docstring).
@@ -58,6 +78,11 @@ class OpsServer:
     ``tracer``: optional ``RequestTracer`` behind ``/debug/requests``.
     ``doctor``: a ``DoctorReport`` or a zero-arg callable returning one
     (e.g. ``lambda: engine.last_doctor_report``).
+    ``profile``: a ``StepProfile`` or a zero-arg callable returning one
+    (e.g. ``lambda: engine.last_step_profile``) behind
+    ``/debug/profile``.
+    ``plan``: a ``PlanReport`` or a zero-arg callable returning one
+    (e.g. ``planner.last_plan_report``) behind ``/debug/plan``.
     ``fleet``: a JSON-able dict or a zero-arg callable returning one
     (e.g. ``control_plane.fleet_status``) behind ``/debug/fleet`` —
     per-replica state + load, router stats, per-tenant shares, the
@@ -75,6 +100,8 @@ class OpsServer:
         recorder: Optional[Any] = None,
         tracer: Optional[Any] = None,
         doctor: Optional[Any] = None,
+        profile: Optional[Any] = None,
+        plan: Optional[Any] = None,
         fleet: Optional[Any] = None,
     ):
         self.registry = registry if registry is not None else get_registry()
@@ -85,6 +112,8 @@ class OpsServer:
         self.recorder = recorder
         self.tracer = tracer
         self._doctor = doctor
+        self._profile = profile
+        self._plan = plan
         self._fleet = fleet
         self._lock = threading.Lock()
         # SLOMonitor mutates per-target state on evaluate(), so
@@ -99,35 +128,39 @@ class OpsServer:
 
     # -- wiring ------------------------------------------------------------
 
+    def _resolve_provider(self, attr: str) -> Optional[Any]:
+        """ONE provider-or-value resolution for every /debug endpoint:
+        a zero-arg callable (that isn't itself a report object) is
+        invoked per request, anything else is served as-is; a raising
+        provider resolves to None (404, never a 500 storm)."""
+        with self._lock:
+            p = getattr(self, attr)
+        if callable(p) and not hasattr(p, "to_json"):
+            try:
+                return p()
+            except Exception:  # noqa: BLE001 - provider failure != 500 storm
+                return None
+        return p
+
     def set_doctor_report(self, report: Any) -> None:
         """Attach (or replace) the report behind ``/debug/doctor``."""
         with self._lock:
             self._doctor = report
 
-    def _doctor_report(self) -> Optional[Any]:
+    def set_profile(self, profile: Any) -> None:
+        """Attach (or replace) the provider behind ``/debug/profile``."""
         with self._lock:
-            d = self._doctor
-        if callable(d) and not hasattr(d, "to_json"):
-            try:
-                return d()
-            except Exception:  # noqa: BLE001 - provider failure != 500 storm
-                return None
-        return d
+            self._profile = profile
+
+    def set_plan(self, plan: Any) -> None:
+        """Attach (or replace) the provider behind ``/debug/plan``."""
+        with self._lock:
+            self._plan = plan
 
     def set_fleet(self, fleet: Any) -> None:
         """Attach (or replace) the provider behind ``/debug/fleet``."""
         with self._lock:
             self._fleet = fleet
-
-    def _fleet_status(self) -> Optional[Any]:
-        with self._lock:
-            f = self._fleet
-        if callable(f):
-            try:
-                return f()
-            except Exception:  # noqa: BLE001 - provider failure != 500 storm
-                return None
-        return f
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -266,26 +299,21 @@ def _make_handler(ops: OpsServer):
                                               "attached"})
                     else:
                         self._send_json(200, payload)
-                elif path == "/debug/doctor":
-                    report = ops._doctor_report()
+                elif path in _PROVIDER_ENDPOINTS:
+                    attr, label = _PROVIDER_ENDPOINTS[path]
+                    report = ops._resolve_provider(attr)
                     if report is None:
-                        self._send_json(404, {"error": "no doctor report "
+                        self._send_json(404, {"error": f"no {label} "
                                               "attached"})
                     else:
                         payload = (report.to_json()
                                    if hasattr(report, "to_json") else report)
                         self._send_json(200, payload)
-                elif path == "/debug/fleet":
-                    payload = ops._fleet_status()
-                    if payload is None:
-                        self._send_json(404, {"error": "no fleet status "
-                                              "provider attached"})
-                    else:
-                        self._send_json(200, payload)
                 elif path == "/":
                     self._send_json(200, {
                         "endpoints": ["/metrics", "/healthz",
                                       "/debug/requests", "/debug/doctor",
+                                      "/debug/profile", "/debug/plan",
                                       "/debug/fleet"],
                     })
                 else:

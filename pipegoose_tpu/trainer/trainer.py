@@ -53,6 +53,9 @@ class Trainer:
         # step-end callback round, cleared right after so the trainer
         # never pins a batch past its step
         self.last_batch: Any = None
+        # refreshed by profile() — the ops server's /debug/profile
+        # provider (lambda: trainer.last_step_profile)
+        self.last_step_profile: Any = None
 
         from pipegoose_tpu.parallel.hybrid import (
             build_hybrid_train_step,
@@ -290,6 +293,60 @@ class Trainer:
         )
         set_doctor_gauges(report, registry=registry)
         return report
+
+    def profile(
+        self,
+        batch: Any,
+        steps: int = 3,
+        warmup: int = 2,
+        trace_dir: Optional[str] = None,
+        registry: Any = None,
+    ):
+        """Measured device-time attribution (telemetry/xprof.py) of
+        THIS trainer's compiled train step — the runtime twin of
+        :meth:`doctor`: runs the real step ``warmup + steps`` times
+        under the XLA profiler on ``batch`` (REAL arrays — unlike the
+        doctor, the step executes) and returns the
+        :class:`~pipegoose_tpu.telemetry.xprof.StepProfile` splitting
+        each fenced step into compute / per-mesh-axis collectives /
+        idle, with measured MFU.
+
+        The profiled steps are REAL optimizer steps: params and
+        optimizer state advance (the step donates its buffers, so the
+        trainer adopts the final ones), exactly as ``fit`` over the
+        same batches would — ``state.step`` is not bumped, since no
+        callbacks ran. The result is cached on ``last_step_profile``
+        (the ops server's ``/debug/profile`` provider)."""
+        from pipegoose_tpu.telemetry.xprof import profile_step
+
+        args: tuple = (self.params, self.opt_state, batch)
+        if self.with_rng:
+            args = args + (jax.random.PRNGKey(0),)
+        final: dict = {}
+
+        def update(out, cur):
+            # out = (params, opt_state, loss[, health]); batch and rng
+            # (when present) repeat — profiling measures the step, not
+            # the data pipeline
+            final["params"], final["opt_state"] = out[0], out[1]
+            return (out[0], out[1]) + tuple(cur[2:])
+
+        try:
+            profile = profile_step(
+                self._step_fn, *args, steps=steps, warmup=warmup,
+                update_args=update, mesh=self.parallel_context.mesh,
+                trace_dir=trace_dir, registry=registry,
+            )
+        finally:
+            # the compiled step DONATED the params/opt-state buffers on
+            # every call: adopt the final generation — even when trace
+            # parsing raises mid-profile — or the trainer's next step
+            # would touch deleted arrays
+            if final:
+                self.params = final["params"]
+                self.opt_state = final["opt_state"]
+        self.last_step_profile = profile
+        return profile
 
     def fit(
         self,

@@ -62,9 +62,17 @@ def device_memory_stats(device: Optional[Any] = None) -> dict:
     return dict(stats)
 
 
-def trace(logdir: str, **kwargs):
+def trace(logdir: str, perfetto: bool = False, **kwargs):
     """jax.profiler timeline trace (TensorBoard/Perfetto viewable) —
-    thin re-export of jax.profiler.trace for API discoverability."""
+    thin re-export of jax.profiler.trace for API discoverability.
+
+    ``perfetto=True`` additionally writes the Perfetto-compatible
+    ``perfetto_trace.json.gz`` conversion next to the raw
+    ``*.trace.json.gz`` (sugar for ``create_perfetto_trace=True``,
+    which remains passable directly). The raw artifact is what
+    ``telemetry/xprof.py`` parses for measured step attribution."""
+    if perfetto:
+        kwargs.setdefault("create_perfetto_trace", True)
     return jax.profiler.trace(logdir, **kwargs)
 
 

@@ -293,6 +293,72 @@ print(f"disagg smoke OK: token-identical across pools, attribution exact, "
       f"({xfer['wire_savings_ratio']:.0%} under fp)")
 PY
 
+# Profile smoke (telemetry/xprof.py, ISSUE 14): measured step
+# attribution of a tiny hybrid step on fake CPU devices — the
+# compute + per-axis-collective + idle components must sum to the
+# fenced step wall time within 5%, the profiled collective set must
+# agree op-for-op with the mesh doctor's compiled schedule, and the
+# StepProfile JSON must round-trip. The measured mirror of the doctor
+# gates above stays exercised on every CI run.
+echo "== profile smoke (measured step attribution) =="
+python - <<'PY'
+import json
+
+from pipegoose_tpu.testing import force_cpu_devices
+
+force_cpu_devices(8)
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from pipegoose_tpu.distributed import ParallelContext
+from pipegoose_tpu.models import bloom
+from pipegoose_tpu.optim.zero import DistributedOptimizer
+from pipegoose_tpu.parallel import make_hybrid_train_step
+from pipegoose_tpu.telemetry import diagnose
+from pipegoose_tpu.telemetry.xprof import StepProfile, profile_step
+
+cfg = bloom.BloomConfig(vocab_size=64, hidden_size=32, n_layer=2, n_head=2)
+params = bloom.init_params(cfg, jax.random.PRNGKey(0))
+ctx = ParallelContext(tensor_parallel_size=2, data_parallel_size=4)
+try:
+    specs = bloom.tp_specs(params)
+    opt = DistributedOptimizer(optax.adam(1e-3), axis_name="data")
+    init_fn, make_step = make_hybrid_train_step(
+        lambda p, ids: bloom.loss_fn(p, ids, None, ids, cfg,
+                                     tp_axis="tensor"),
+        specs, opt, ctx,
+    )
+    opt_state = init_fn(params)
+    step = make_step(params)
+    ids = jnp.asarray(np.random.RandomState(0).randint(1, 64, (8, 8)))
+    prof = profile_step(
+        step, params, opt_state, ids, steps=3,
+        update_args=lambda out, a: (out[0], out[1], a[2]),
+        mesh=ctx.mesh,
+    )
+    assert prof.source == "device_trace", prof.source
+    total = prof.compute_s + prof.comm_s + prof.idle_s
+    assert abs(total - prof.wall_step_s) <= 0.05 * prof.wall_step_s, (
+        total, prof.wall_step_s, prof.residual_s)
+    # op-for-op agreement with the doctor's compiled schedule
+    rep = diagnose(step, params, opt_state, ids, mesh=ctx.mesh)
+    sched = {c.name for c in rep.sharding.collectives}
+    measured = {c["name"] for c in prof.collectives}
+    assert measured == sched, (sorted(measured ^ sched))
+    rt = StepProfile.from_json(json.loads(json.dumps(prof.to_json())))
+    assert rt.comm_by_axes == prof.comm_by_axes
+    assert abs(rt.wall_step_s - prof.wall_step_s) < 1e-12
+finally:
+    ctx.destroy()
+print(f"profile smoke OK: {len(prof.collectives)} collectives matched "
+      f"op-for-op, compute/comm/idle = "
+      f"{prof.compute_fraction:.0%}/{prof.comm_fraction:.0%}/"
+      f"{prof.idle_fraction:.0%} of {prof.wall_step_s*1e3:.1f}ms")
+PY
+
 echo "== fast tier =="
 python -m pytest tests/ -q -m fast -p no:cacheprovider \
     --continue-on-collection-errors "$@"

@@ -67,6 +67,7 @@ FAST_FILES = {
     "tests/telemetry/test_reqtrace.py",         # request tracing + attribution
     "tests/telemetry/test_slo.py",              # SLO burn-rate monitor
     "tests/telemetry/test_opsserver.py",        # live ops endpoint
+    "tests/telemetry/test_sentinel.py",         # perf-regression sentinel
     "tests/trainer/test_logger.py",             # rank-0 logging (host-only)
     "tests/utils/test_profiler.py",             # cost analysis arithmetic
     "tests/test_lint_jit_safety.py",            # jit-safety AST lint gate
@@ -191,6 +192,19 @@ FAST_TESTS = {
     "tests/serving/test_disagg.py::test_token_identity_cold_and_warm[int8kv]",
     "tests/serving/test_disagg.py::test_int8_wire_byte_census",
     "tests/serving/test_disagg.py::test_attribution_sums_to_e2e_with_transfer_phase",
+    # measured step attribution + calibration (ISSUE 14): pure trace
+    # parsing/joining + the hand-computed calibration fits + the
+    # sentinel branch guard (the compiling profile e2e, the engine
+    # host-stall e2e, and the bench-variant rank-agreement pin stay
+    # tier-1; ci_fast.sh runs a dedicated profile smoke)
+    "tests/telemetry/test_xprof.py::test_attribute_op_times_buckets_and_joins_schedule",
+    "tests/telemetry/test_xprof.py::test_op_events_module_filter_and_name_fallback",
+    "tests/telemetry/test_xprof.py::test_step_profile_json_round_trip_and_components",
+    "tests/telemetry/test_doctor.py::test_collective_schedule_extracts_instruction_names",
+    "tests/telemetry/test_derived.py::test_unknown_device_kind_falls_back_loudly",
+    "tests/planner/test_planner.py::test_cost_model_calibrate_fits_constants_from_profiles",
+    "tests/planner/test_planner.py::test_record_profile_and_rescore_flip_ranking_to_measured",
+    "tests/serving/test_engine.py::test_sentinel_observe_disabled_under_5us",
 }
 
 

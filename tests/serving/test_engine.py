@@ -279,3 +279,146 @@ def test_recorder_rings_decode_steps(setup, tmp_path):
     steps = [r for r in rec.records if r["kind"] == "serving.step"]
     assert len(steps) == metrics["decode_steps"]
     assert all(r["dur_s"] > 0 and r["active"] >= 1 for r in steps)
+
+
+# -- perf sentinel integration (ISSUE 14) ----------------------------------
+
+
+def test_sentinel_observe_disabled_under_5us(setup):
+    """The established branch-guard contract: with no sentinel attached
+    (the default) the finish_run hook costs one attribute read + branch
+    — < 5 µs median, measured over batches like the registry guard."""
+    import time
+
+    from types import SimpleNamespace
+
+    cfg, params, _ = setup
+    eng = ServingEngine(params, cfg, num_slots=2, num_pages=8,
+                        page_size=4, max_context=32)
+    assert eng.sentinel is None
+    rs = SimpleNamespace(steps=3, step_time=0.01, generated_total=6)
+    n = 2000
+    samples = []
+    for _ in range(15):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            eng._sentinel_observe(rs, 1.0)
+        samples.append((time.perf_counter() - t0) / n)
+    assert sorted(samples)[len(samples) // 2] < 5e-6
+
+
+def test_sentinel_attached_outputs_token_identical(setup):
+    """The sentinel only reads host-side run aggregates: attaching one
+    must leave the served token streams byte-identical."""
+    from pipegoose_tpu.telemetry import PerfSentinel
+
+    cfg, params, prompts = setup
+    def reqs():
+        return [Request(prompt=p, max_new_tokens=4) for p in prompts[:2]]
+
+    ref_eng = ServingEngine(params, cfg, num_slots=2, num_pages=16,
+                            page_size=4, max_context=32)
+    ref, _ = ref_eng.run(reqs())
+    eng = ServingEngine(params, cfg, num_slots=2, num_pages=16,
+                        page_size=4, max_context=32,
+                        sentinel=PerfSentinel(min_baseline=1))
+    got, _ = eng.run(reqs())
+    for a, b in zip(ref, got):
+        np.testing.assert_array_equal(a.generated, b.generated)
+    assert eng.sentinel.baseline_size == 1
+
+
+def test_sentinel_names_regressed_component_on_host_stall(setup, tmp_path):
+    """Sentinel e2e (ISSUE 14 acceptance): healthy baseline runs, then
+    an injected slowdown through the chaos ``host_stall`` seam — the
+    perf_regression black box must fire and NAME the regressed
+    component (the stall lands in the per-step idle time)."""
+    import json
+    import os
+
+    from pipegoose_tpu.telemetry import FlightRecorder, PerfSentinel
+    from pipegoose_tpu.testing.chaos import (
+        ChaosMonkey,
+        ChaosSchedule,
+        Injection,
+    )
+
+    cfg, params, prompts = setup
+    rec = FlightRecorder(str(tmp_path), capacity=8)
+    sent = PerfSentinel(recorder=rec, window=4, min_baseline=2,
+                        ratio_threshold=1.5)
+    eng = ServingEngine(params, cfg, num_slots=2, num_pages=16,
+                        page_size=4, max_context=32,
+                        sentinel=sent, recorder=rec)
+
+    def reqs():
+        return [Request(prompt=p, max_new_tokens=4) for p in prompts[:2]]
+
+    for _ in range(3):
+        eng.run(reqs())
+    assert sent.regressions == 0, sent.last_verdict
+
+    monkey = ChaosMonkey(
+        ChaosSchedule([Injection(2, "host_stall", (("stall_s", 0.3),))]),
+        recorder=rec,
+    )
+    eng.run(reqs(), tick_hook=monkey.tick_hook)
+    assert sent.regressions == 1
+    trig = rec.take_trigger()
+    assert trig is not None and trig.name == "perf_regression"
+    assert "idle time" in trig.reason and "baseline" in trig.reason
+    assert trig.dump_path and os.path.exists(trig.dump_path)
+    box = json.load(open(trig.dump_path))
+    comps = {r["component"]
+             for r in box["trigger"]["details"]["regressions"]}
+    assert "idle_s" in comps
+    # the chaos injection is ringed next to the detection
+    kinds = [r.get("kind") for r in box["records"]]
+    assert "chaos.injection" in kinds
+
+
+def test_engine_profile_attributes_decode_step(setup):
+    """ServingEngine.profile(): measured attribution of the compiled
+    decode step over the null page — components sum to the fenced wall,
+    the engine adopts the donated page buffers, and serving afterwards
+    stays token-identical."""
+    cfg, params, prompts = setup
+    eng = ServingEngine(params, cfg, num_slots=2, num_pages=16,
+                        page_size=4, max_context=32)
+    prof = eng.profile(steps=2)
+    assert prof.source == "device_trace"
+    total = prof.compute_s + prof.comm_s + prof.idle_s
+    assert abs(total - prof.wall_step_s) <= 0.05 * prof.wall_step_s
+    assert prof.compute_s > 0
+    assert eng.last_step_profile is prof
+    ref_eng = ServingEngine(params, cfg, num_slots=2, num_pages=16,
+                            page_size=4, max_context=32)
+    ref, _ = ref_eng.run([Request(prompt=prompts[0], max_new_tokens=4)])
+    got, _ = eng.run([Request(prompt=prompts[0], max_new_tokens=4)])
+    np.testing.assert_array_equal(ref[0].generated, got[0].generated)
+    with pytest.raises(RuntimeError, match="profile"):
+        eng.start_run([])
+        try:
+            eng.profile(steps=1)
+        finally:
+            eng.abort_run()
+
+
+def test_sentinel_skips_runs_with_no_decode_steps(setup):
+    """A run that decoded nothing — everything deadline-shed, or a
+    prefill-only handoff run — is the degraded-but-healthy mode, not a
+    perf sample: it must neither fire a spurious regression
+    (tokens/s=0) nor enter the baseline."""
+    from types import SimpleNamespace
+
+    from pipegoose_tpu.telemetry import PerfSentinel
+
+    cfg, params, _ = setup
+    sent = PerfSentinel(min_baseline=1)
+    eng = ServingEngine(params, cfg, num_slots=2, num_pages=8,
+                        page_size=4, max_context=32, sentinel=sent)
+    sent._hist.append({"tokens_per_s": 100.0, "decode_step_s": 0.01,
+                       "idle_s": 0.001})
+    eng._sentinel_observe(
+        SimpleNamespace(steps=0, step_time=0.0, generated_total=0), 2.0)
+    assert sent.regressions == 0 and sent.baseline_size == 1

@@ -167,3 +167,37 @@ def test_iter_collectives_line_level():
         ("all-reduce", 32, False),
         ("all-gather", 32, True),
     ]
+
+
+def test_unknown_device_kind_falls_back_loudly():
+    """ISSUE 14 satellite: every `*_for` peer-table lookup must fall
+    back to its DOCUMENTED default on an unknown device kind — and WARN
+    naming the table, never return a silent zero (a typo'd
+    --device-kind would otherwise score every layout against garbage).
+    Pinned for PEAK_FLOPS / ICI / DCI / HBM (+ HBM bandwidth)."""
+    cases = [
+        (derived.peak_flops_for, derived.DEFAULT_PEAK_FLOPS, "PEAK_FLOPS"),
+        (derived.ici_bytes_per_s_for, derived.DEFAULT_ICI_BYTES,
+         "PEAK_ICI_BYTES"),
+        (derived.dci_bytes_per_s_for, derived.DEFAULT_DCI_BYTES,
+         "PEAK_DCI_BYTES"),
+        (derived.hbm_bytes_for, derived.DEFAULT_HBM_BYTES, "HBM_BYTES"),
+        (derived.hbm_bw_bytes_per_s_for, derived.DEFAULT_HBM_BW_BYTES,
+         "HBM_BW_BYTES"),
+    ]
+    for fn, default, table in cases:
+        with pytest.warns(UserWarning, match=table):
+            got = fn("martian accelerator v9")
+        assert got == default and got > 0
+
+
+def test_known_device_kinds_never_warn():
+    import warnings as _w
+
+    for kind in ("TPU v5e", "TPU v5 lite", "v5p slice", "cpu-fallback",
+                 "TPU v4"):
+        with _w.catch_warnings():
+            _w.simplefilter("error")
+            assert derived.peak_flops_for(kind) > 0
+            assert derived.ici_bytes_per_s_for(kind) > 0
+            assert derived.hbm_bytes_for(kind) > 0

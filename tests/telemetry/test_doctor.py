@@ -559,3 +559,30 @@ def test_wire_bytes_conventions_2d_mesh(devices):
         "collective-permute")
     assert c.bytes == 128 and c.mesh_axes == ("tensor",)
     assert D.estimated_wire_bytes(c, ax) == 128
+
+
+def test_collective_schedule_extracts_instruction_names():
+    """ISSUE 14: `CollectiveInfo.name` carries the HLO instruction name
+    — the join key the measured profiler attribution
+    (telemetry/xprof.py) matches trace op events on — and artifacts
+    written before the field existed deserialize with ''."""
+    hlo = "\n".join([
+        '  %all-reduce.2 = f32[8,16]{1,0} all-reduce(f32[8,16] %x), '
+        'replica_groups={{0,1},{2,3},{4,5},{6,7}}, '
+        'metadata={op_name="jit(f)/psum"}',
+        "  ROOT all-gather.7 = f32[8,8]{0,1} all-gather(f32[8,4] %c), "
+        "replica_groups=[4,2]<=[8], dimensions={1}",
+    ])
+    sched = D.parse_collective_schedule(hlo, {"data": 4, "tensor": 2})
+    assert [c.name for c in sched] == ["all-reduce.2", "all-gather.7"]
+    # round trip keeps the name; a pre-field artifact loads with ""
+    rep = D.ShardingReport(mesh_axes={"data": 4, "tensor": 2}, n_devices=8,
+                           buffers=[], collectives=sched)
+    rt = D.ShardingReport.from_json(rep.to_json())
+    assert [c.name for c in rt.collectives] == ["all-reduce.2",
+                                                "all-gather.7"]
+    old = rep.to_json()
+    for c in old["collectives"]:
+        del c["name"]
+    assert [c.name for c in
+            D.ShardingReport.from_json(old).collectives] == ["", ""]

@@ -176,3 +176,51 @@ def test_parse_prometheus_text_rejects_malformed():
         "# TYPE a counter\na 1.0\nb{le=\"0.5\"} 2\n\n"
     )
     assert out == {"a": 1.0, 'b{le="0.5"}': 2.0}
+
+
+def test_debug_profile_serves_last_step_profile(reg):
+    """/debug/profile (ISSUE 14): provider-or-callable like
+    /debug/doctor — 404 unset, JSON of the StepProfile when wired."""
+    with OpsServer(registry=reg, port=0) as srv:
+        code, _ = _get(srv.url + "/debug/profile")
+        assert code == 404
+        srv.set_profile({"compute_s": 0.004, "source": "device_trace"})
+        code, body = _get(srv.url + "/debug/profile")
+        assert code == 200
+        assert json.loads(body)["compute_s"] == 0.004
+
+    class FakeProfile:
+        def to_json(self):
+            return {"compute_s": 0.001, "comm_s": 0.002}
+
+    holder = {"p": None}
+    with OpsServer(registry=reg, port=0,
+                   profile=lambda: holder["p"]) as srv:
+        code, _ = _get(srv.url + "/debug/profile")
+        assert code == 404            # provider returns None until set
+        holder["p"] = FakeProfile()   # e.g. engine.profile() ran
+        code, body = _get(srv.url + "/debug/profile")
+        assert code == 200 and json.loads(body)["comm_s"] == 0.002
+
+
+def test_debug_plan_serves_last_plan_report(reg):
+    """/debug/plan (ISSUE 14): same pattern; `planner.last_plan_report`
+    is the natural provider."""
+    with OpsServer(registry=reg, port=0) as srv:
+        code, _ = _get(srv.url + "/debug/plan")
+        assert code == 404
+
+    class FakePlan:
+        def to_json(self):
+            return {"candidates": [], "device_kind": "cpu"}
+
+    with OpsServer(registry=reg, port=0, plan=lambda: FakePlan()) as srv:
+        code, body = _get(srv.url + "/debug/plan")
+        assert code == 200 and json.loads(body)["device_kind"] == "cpu"
+
+
+def test_root_lists_profile_and_plan_endpoints(reg):
+    with OpsServer(registry=reg, port=0) as srv:
+        _, body = _get(srv.url + "/")
+        eps = json.loads(body)["endpoints"]
+        assert "/debug/profile" in eps and "/debug/plan" in eps

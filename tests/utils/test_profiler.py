@@ -87,3 +87,33 @@ def test_device_memory_stats_says_why_unavailable():
     out = profiler.device_memory_stats(jax.devices()[0])
     if "bytes_in_use" not in out:
         assert out == {"unavailable": "cpu"}
+
+
+def test_trace_perfetto_leaves_parseable_artifact(tmp_path):
+    """`trace(dir, perfetto=True)` (ISSUE 14 satellite): the thin
+    re-export passes `create_perfetto_trace` through, and a traced tiny
+    jit leaves BOTH artifacts — the raw `*.trace.json.gz` the measured
+    attribution layer (telemetry/xprof.py) parses, and the
+    `perfetto_trace.json.gz` conversion for ui.perfetto.dev."""
+    import glob
+    import os
+
+    from pipegoose_tpu.telemetry.xprof import (
+        find_trace_file,
+        load_trace_events,
+    )
+
+    f = jax.jit(lambda x: (x @ x).sum())
+    x = jnp.ones((64, 64))
+    float(f(x))  # compile outside the trace
+    with profiler.trace(str(tmp_path), perfetto=True):
+        float(f(x))
+    raw = find_trace_file(str(tmp_path))
+    assert raw is not None and raw.endswith(".trace.json.gz")
+    events = load_trace_events(raw)
+    assert any(e.get("ph") == "X" for e in events)
+    perfetto = glob.glob(
+        os.path.join(str(tmp_path), "plugins", "profile", "*",
+                     "perfetto_trace.json.gz")
+    )
+    assert perfetto, "perfetto conversion missing"
