@@ -15,6 +15,7 @@ from pipegoose_tpu.serving.disagg import (
     disagg_serving_benchmark,
 )
 from pipegoose_tpu.serving.engine import (
+    ReplicaFault,
     RequestOutput,
     ServingEngine,
     make_skewed_replay,
@@ -42,6 +43,7 @@ __all__ = [
     "PagePool",
     "PrefixCache",
     "PrefixHit",
+    "ReplicaFault",
     "Request",
     "RequestOutput",
     "Scheduler",

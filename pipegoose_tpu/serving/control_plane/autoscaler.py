@@ -65,11 +65,18 @@ class Autoscaler:
         self._last_action_tick: Optional[int] = None
 
     def decide(self, tick: int, n_serving: int, backlog: int,
-               now: Optional[float] = None) -> Optional[str]:
+               now: Optional[float] = None,
+               n_failed: int = 0) -> Optional[str]:
         """One evaluation: returns "up", "down", or None. ``n_serving``
         counts SERVING replicas (draining ones are already leaving),
         ``backlog`` the control plane's undispatched ingress — scaling
-        down while requests queue would immediately re-breach."""
+        down while requests queue would immediately re-breach.
+        ``n_failed`` is the UNCOMPENSATED unplanned capacity loss (the
+        control plane's ``_capacity_gap``: failures minus scale-ups/
+        rejoins since): any loss is an immediate scale-up signal — the
+        burn rate would discover it eventually, but only after users
+        paid the latency — and a fleet carrying a failure never scales
+        DOWN (the backlog guard's crash sibling)."""
         cfg = self.config
         if (self._last_action_tick is not None
                 and tick < self._last_action_tick):
@@ -86,14 +93,19 @@ class Autoscaler:
                  for name, t in status.get("targets", {}).items()}
         decision = None
         reason = ""
-        if burns and max(burns.values()) >= cfg.scale_up_burn:
+        if n_failed > 0 and n_serving < cfg.max_replicas:
+            decision = "up"
+            reason = (f"{n_failed} failed replica(s): unplanned "
+                      f"capacity loss")
+        elif burns and max(burns.values()) >= cfg.scale_up_burn:
             if n_serving < cfg.max_replicas:
                 hot = max(burns, key=burns.get)
                 decision = "up"
                 reason = (f"target {hot!r} burning {burns[hot]:.2f}x >= "
                           f"{cfg.scale_up_burn}x")
             # at max: nothing to add — shedding stays the pressure valve
-        elif (burns and backlog == 0 and n_serving > cfg.min_replicas
+        elif (burns and backlog == 0 and n_failed == 0
+                and n_serving > cfg.min_replicas
                 and max(burns.values()) <= cfg.scale_down_burn):
             decision = "down"
             reason = (f"all burns <= {cfg.scale_down_burn}x and no "
@@ -107,5 +119,6 @@ class Autoscaler:
                 "burns": burns,
                 "n_serving": n_serving,
                 "backlog": backlog,
+                "n_failed": n_failed,
             })
         return decision

@@ -45,7 +45,7 @@ from pipegoose_tpu.serving.control_plane.replica import (
 from pipegoose_tpu.serving.control_plane.router import Router
 from pipegoose_tpu.serving.control_plane.tenants import TenantLedger
 from pipegoose_tpu.serving.engine import RequestOutput
-from pipegoose_tpu.serving.scheduler import Request
+from pipegoose_tpu.serving.scheduler import Request, Status
 from pipegoose_tpu.telemetry.fleet import FleetRegistry
 from pipegoose_tpu.telemetry.registry import MetricsRegistry
 from pipegoose_tpu.telemetry.slo import SLOTarget
@@ -68,6 +68,15 @@ def per_tenant_slo_targets(
     ]
 
 
+#: uid block reserved per replica: replica i mints uids from
+#: i * UID_STRIDE, so a salvage resubmit with ``reuse_uid`` can never
+#: collide with a live uid on the survivor it lands on — the "caller
+#: owns cross-scheduler uniqueness" contract Scheduler.submit states,
+#: made true by construction (a replica would have to serve a million
+#: requests in one process to leak into its neighbor's block).
+UID_STRIDE = 1_000_000
+
+
 class ControlPlane:
     """Front door over N replicas (module docstring).
 
@@ -88,14 +97,48 @@ class ControlPlane:
                  autoscaler: Optional[Autoscaler] = None,
                  registry: Optional[MetricsRegistry] = None,
                  stall_patience: int = 200,
-                 affinity_slack_tokens: int = 192):
+                 affinity_slack_tokens: int = 192,
+                 recorder: Optional[Any] = None,
+                 suspect_after_ticks: int = 5,
+                 failed_after_ticks: int = 20,
+                 probation_ticks: int = 8):
+        """``recorder``: optional ``telemetry.FlightRecorder`` — every
+        replica failure dumps ONE ``replica_failure`` black box naming
+        the replica and the salvaged/resubmitted/lost uids; an
+        UNRECOVERED failure (admitted work lost, or no survivors) stays
+        pending so ``/healthz`` flips 503. ``suspect_after_ticks`` /
+        ``failed_after_ticks``: the heartbeat thresholds of the health
+        state machine (ticks with work but no progress before
+        SERVING->SUSPECT and ->FAILED; must satisfy suspect < failed <
+        stall_patience so a single wedged replica is quarantined long
+        before the whole-fleet watchdog gives up).
+        ``probation_ticks``: dispatch cooldown after :meth:`rejoin`."""
         if n_replicas < 1:
             raise ValueError(f"n_replicas must be >= 1, got {n_replicas}")
         if stall_patience < 1:
             raise ValueError(
                 f"stall_patience must be >= 1, got {stall_patience}"
             )
+        if not 1 <= suspect_after_ticks < failed_after_ticks:
+            raise ValueError(
+                f"need 1 <= suspect_after_ticks ({suspect_after_ticks}) "
+                f"< failed_after_ticks ({failed_after_ticks})"
+            )
+        if failed_after_ticks >= stall_patience:
+            raise ValueError(
+                f"failed_after_ticks ({failed_after_ticks}) must be < "
+                f"stall_patience ({stall_patience}): the fleet watchdog "
+                f"must never fire before a wedged replica is quarantined"
+            )
+        if probation_ticks < 0:
+            raise ValueError(
+                f"probation_ticks must be >= 0, got {probation_ticks}"
+            )
         self.replica_factory = replica_factory
+        self.recorder = recorder
+        self.suspect_after_ticks = suspect_after_ticks
+        self.failed_after_ticks = failed_after_ticks
+        self.probation_ticks = probation_ticks
         self.registry = (registry if registry is not None
                          else MetricsRegistry(enabled=True))
         self.router = Router(policy, registry=self.registry,
@@ -113,6 +156,14 @@ class ControlPlane:
         self._seq = 0                        # control-plane dispatch ids
         self._order: Dict[int, int] = {}     # id(req) -> submit order
         self._outputs: Dict[int, RequestOutput] = {}  # submit order -> out
+        # crash salvage: requests flagged here re-submit with
+        # reuse_uid=True (the resubmit-from-prompt degradation keeps
+        # the uid its tracer timeline is keyed by)
+        self._reuse: set = set()
+        # unplanned capacity loss not yet compensated: +1 per replica
+        # failure, -1 per scale_up/rejoin — the autoscaler's
+        # "FAILED counts as capacity loss" signal
+        self._capacity_gap = 0
         reg = self.registry
         self._m_replicas = reg.gauge("control_plane.replicas_serving")
         self._m_dispatched = reg.counter("control_plane.dispatched_total")
@@ -120,6 +171,10 @@ class ControlPlane:
         self._m_drains = reg.counter("control_plane.drains_total")
         self._m_scaleups = reg.counter("control_plane.scaleups_total")
         self._m_shed = reg.counter("control_plane.shed_total")
+        self._m_failures = reg.counter("serving.fleet.failures_total")
+        self._m_salvaged = reg.counter("serving.fleet.salvaged_total")
+        self._m_resubmitted = reg.counter("serving.fleet.resubmitted_total")
+        self._m_lost = reg.counter("serving.fleet.lost_total")
         for _ in range(n_replicas):
             self._add_replica()
 
@@ -138,6 +193,11 @@ class ControlPlane:
                 f"tokens, which monolithic prefill cannot resume"
             )
         rep = Replica(name, engine, registry=reg, index=self._next_replica - 1)
+        # fleet-unique uid blocks (see UID_STRIDE): outputs are keyed by
+        # submit ORDER so this changes nothing user-visible, but tracer
+        # timelines and reuse_uid salvage stay collision-free fleet-wide
+        engine.sched._next_uid = max(engine.sched._next_uid,
+                                     rep.index * UID_STRIDE)
         self.replicas.append(rep)
         self.fleet.add_member(name, reg)
         if self._running:
@@ -150,12 +210,52 @@ class ControlPlane:
         return [r for r in self.replicas
                 if r.state is ReplicaState.SERVING]
 
+    def failed_replicas(self) -> List[Replica]:
+        return [r for r in self.replicas
+                if r.state is ReplicaState.FAILED]
+
     def scale_up(self) -> Replica:
         """Add one replica (autoscaler "up", or the operator). The new
         engine compiles its programs on first use — on real fleets the
-        factory hands back a pre-warmed engine."""
+        factory hands back a pre-warmed engine. Closes one unit of
+        unplanned capacity gap when a failure opened one."""
         rep = self._add_replica()
         self._m_scaleups.inc()
+        self._capacity_gap = max(0, self._capacity_gap - 1)
+        return rep
+
+    def rejoin(self, name: str, *,
+               probation_ticks: Optional[int] = None) -> Replica:
+        """Bring a FAILED replica back: clear its injected fault, flip
+        it to SERVING **on probation** (ticked, but not routed fresh
+        ingress for ``probation_ticks``), and restart its steppable run
+        when one is live. The replica's scheduler must be empty —
+        salvage emptied it on the clean path; residue means the failure
+        left state this rejoin cannot trust."""
+        match = [r for r in self.replicas if r.name == name]
+        if not match:
+            raise ValueError(f"no replica named {name!r}")
+        rep = match[0]
+        sched = rep.engine.sched
+        if (rep.salvage_degraded or not sched.all_done()
+                or sched._outstanding_total != 0 or sched.transfers):
+            # a CLEAN salvage leaves all of these empty; the degraded
+            # path scrubs slots/queue by hand, so all_done() alone
+            # would wave a corrupted admission ledger back in
+            raise ValueError(
+                f"replica {name!r} still holds scheduler state (or its "
+                f"salvage was degraded) — a partially salvaged failure "
+                f"cannot rejoin (replace it with scale_up instead)"
+            )
+        rep.engine.inject_fault(None)
+        rep.rejoin(self.probation_ticks if probation_ticks is None
+                   else probation_ticks)
+        self._capacity_gap = max(0, self._capacity_gap - 1)
+        if self._running and not rep.engine.run_in_progress:
+            rep.engine.start_run((), now=self._now)
+            if rep not in self._started:
+                self._started.append(rep)
+        self._m_replicas.set(float(len(self.serving_replicas())))
         return rep
 
     def start_drain(self, name: Optional[str] = None) -> Replica:
@@ -212,32 +312,65 @@ class ControlPlane:
 
     # -- the loop ----------------------------------------------------------
 
-    def _dispatch(self, now: float) -> int:
-        """Place migrated requests first, then one DRR batch of fresh
-        ingress. A request no replica can admit right now goes back
-        where it came from and retries next tick."""
+    def _dispatchable(self, rep: Replica, tick: int) -> bool:
+        """The health-aware dispatch gate: SERVING (past probation)
+        flows freely; SUSPECT is PROBED with exponential backoff (ONE
+        routed request per probe window — the retry that discovers
+        recovery without piling fresh work on a maybe-dead replica);
+        FAILED/DRAINING/STOPPED never receive work."""
+        if rep.state is ReplicaState.SERVING:
+            return rep.probation_ticks_left == 0
+        if rep.state is ReplicaState.SUSPECT:
+            return rep.probe_allowed(tick)
+        return False
+
+    def _place(self, req: Request, rep: Replica, cands: List[Replica],
+               tick: int) -> List[Replica]:
+        """Submit ``req`` on ``rep`` and return the candidate set for
+        the REST of this tick: placing on a SUSPECT replica consumes
+        its probe window (backoff doubles) and removes it from the
+        remaining candidates — one probe request per window, never a
+        whole batch piled onto a maybe-dead replica."""
+        rep.engine.submit_request(
+            req, reuse_uid=id(req) in self._reuse
+        )
+        self._reuse.discard(id(req))
+        rep.inflight[id(req)] = req
+        if rep.state is ReplicaState.SUSPECT:
+            rep.note_probe(tick)
+            return [c for c in cands if c is not rep]
+        return cands
+
+    def _dispatch(self, now: float, tick: int) -> int:
+        """Place migrated/salvaged requests first, then one DRR batch
+        of fresh ingress. A request no replica can admit right now goes
+        back where it came from and retries next tick."""
+        cands = [rep for rep in self.replicas
+                 if self._dispatchable(rep, tick)]
         placed = 0
         still: List[Request] = []
         for req in self._migrated:
-            rep = self.router.route(req, self.replicas, now, seq=self._seq)
+            rep = self.router.route(req, cands, now, seq=self._seq)
             if rep is None:
                 still.append(req)
                 continue
             self._seq += 1
-            rep.engine.submit_request(req)
+            cands = self._place(req, rep, cands, tick)
             placed += 1
         self._migrated = still
         if self._migrated:
             return placed   # re-placement backlog first, fresh traffic waits
+        # fresh-batch sizing counts HEALTHY capacity only: a suspect's
+        # free slots must not inflate the DRR batch it may never serve
         free_slots = sum(
             rep.engine.sched.capacity_snapshot()["free_slots"]
-            for rep in self.serving_replicas()
+            for rep in cands if rep.state is ReplicaState.SERVING
         )
         if free_slots < 1:
             return placed
         batch = self.ledger.next_batch(free_slots)
         for i, req in enumerate(batch):
-            rep = self.router.route(req, self.replicas, now, seq=self._seq)
+            rep = self.router.route(req, cands, now, seq=self._seq)
             if rep is None:
                 # requeue the WHOLE unplaced tail, not just the failed
                 # head — every batch member was already popped from its
@@ -248,7 +381,7 @@ class ControlPlane:
                     self.ledger.requeue_front(r)
                 break
             self._seq += 1
-            rep.engine.submit_request(req)
+            cands = self._place(req, rep, cands, tick)
             self._m_dispatched.inc()
             placed += 1
         return placed
@@ -303,6 +436,151 @@ class ControlPlane:
                 tenant=req.tenant,
             )
 
+    # -- unplanned failure: detection fan-in + in-flight salvage -----------
+
+    def _output_from(self, req: Request) -> RequestOutput:
+        """Plane-side output builder for a request that FINISHED on a
+        replica whose run can no longer build it (the engine was
+        aborted by the failure path) — mirrors the engine's own
+        ``_build_output`` arithmetic."""
+        e2e = req.t_done - req.t_submit
+        if req.finish_reason == "shed":
+            return RequestOutput(
+                uid=req.uid, prompt=np.asarray(req.prompt),
+                generated=np.asarray(req.generated, np.int64),
+                finish_reason="shed", queue_latency_s=e2e, ttft_s=None,
+                decode_tokens_per_s=None, e2e_latency_s=e2e,
+                tenant=req.tenant,
+            )
+        decode_s = max(req.t_done - req.t_admit, 1e-9)
+        return RequestOutput(
+            uid=req.uid, prompt=np.asarray(req.prompt),
+            generated=np.asarray(req.generated, np.int64),
+            finish_reason=req.finish_reason,
+            queue_latency_s=req.t_admit - req.t_submit,
+            ttft_s=(req.t_first_token - req.t_submit
+                    if req.t_first_token is not None else None),
+            decode_tokens_per_s=len(req.generated) / decode_s,
+            e2e_latency_s=e2e, tenant=req.tenant,
+        )
+
+    def _salvage_reset(self, req: Request, sched: Any) -> None:
+        """Resubmit-from-prompt degradation: the request's scheduler-
+        side state is unreachable (harvest raised), so scrub what we
+        can reach, DROP the harvested tokens (greedy determinism
+        re-emits them token-identically from the prompt) and flag the
+        request for a reuse_uid re-submission. Every step is
+        best-effort — the scheduler may be arbitrarily broken."""
+        try:
+            if req.slot is not None and sched.slots[req.slot] is req:
+                sched.slots[req.slot] = None
+        except Exception:  # noqa: BLE001 - dead scheduler, best effort
+            pass
+        try:
+            sched.queue.remove(req)
+        except Exception:  # noqa: BLE001
+            pass
+        req.generated = []
+        req.clear_residency()
+        self._reuse.add(id(req))
+
+    def _fail_replica(self, rep: Replica, tick: int, reason: str) -> None:
+        """Quarantine ``rep`` and salvage its admitted work: mark
+        FAILED, drop its router shadow, best-effort abort its run, then
+        harvest every request the PLANE knows it owns (``rep.inflight``
+        — independent of the dead scheduler) and re-queue them ahead of
+        fresh ingress. Per request: finished-but-untaken ones emit
+        their output directly; live ones preempt/withdraw cleanly
+        (pages released, generated tokens kept — the re-prefill path
+        resumes at the pending token, token-identical); a request whose
+        scheduler state is unreachable degrades to resubmit-from-prompt
+        with ``reuse_uid`` (still token-identical by greedy
+        determinism, wait books as stall). One ``replica_failure``
+        black box names the replica, every uid by disposition, and the
+        router verdict; a fully recovered failure (nothing lost,
+        survivors serving) consumes its own trigger so ``/healthz``
+        flips only on an UNRECOVERED failure."""
+        rep.mark_failed(reason)
+        self.router.drop_replica(rep.name)
+        self._m_failures.inc()
+        self._capacity_gap += 1
+        try:
+            rep.engine.abort_run()
+        except Exception:  # noqa: BLE001 - best effort on a dead engine
+            pass
+        sched = rep.engine.sched
+        salvaged: List[int] = []
+        resubmitted: List[int] = []
+        completed: List[int] = []
+        lost: List[int] = []
+        harvest = sorted(rep.inflight.values(), key=self._seq_for)
+        for req in harvest:
+            try:
+                if req.status is Status.DONE and req.finish_reason:
+                    # finished before the crash, output never taken
+                    self._observe_finished(req, self._output_from(req))
+                    completed.append(req.uid)
+                    continue
+                if req.status in (Status.PREFILL, Status.DECODE):
+                    sched.preempt(req)
+                if req.status is Status.QUEUED:
+                    sched.withdraw(req)
+                salvaged.append(req.uid)
+            except Exception:  # noqa: BLE001 - unreachable state path
+                rep.salvage_degraded = True   # rejoin refuses from here
+                try:
+                    self._salvage_reset(req, sched)
+                    resubmitted.append(req.uid)
+                except Exception:  # noqa: BLE001 - truly gone
+                    lost.append(req.uid)
+                    continue
+            self._migrated.append(req)
+        rep.inflight.clear()
+        rep.salvaged_out += len(salvaged) + len(resubmitted)
+        self._m_salvaged.inc(len(salvaged))
+        self._m_resubmitted.inc(len(resubmitted))
+        self._m_lost.inc(len(lost))
+        self._m_replicas.set(float(len(self.serving_replicas())))
+        if self.recorder is None:
+            return
+        recovered = not lost and bool(self.serving_replicas())
+        # an EARLIER unconsumed trigger (a previous unrecovered failure,
+        # a decode stall...) must survive this dump: fire_trigger
+        # overwrites last_trigger, and the recovered path below would
+        # otherwise consume-and-clear a problem that is still real
+        pending = self.recorder.last_trigger
+        trig = self.recorder.fire_trigger(
+            "replica_failure",
+            f"replica {rep.name} failed at tick {tick}: {reason} — "
+            f"salvaged {len(salvaged)}, resubmitted {len(resubmitted)}, "
+            f"completed {len(completed)}, lost {len(lost)}",
+            tick,
+            details={
+                "replica": rep.name,
+                "reason": reason,
+                "salvaged_uids": salvaged,
+                "resubmitted_uids": resubmitted,
+                "completed_uids": completed,
+                "lost_uids": lost,
+                "recovered": recovered,
+                "router": {
+                    "verdict": "quarantined",
+                    "shadow_dropped": True,
+                    "serving_replicas": [
+                        r.name for r in self.serving_replicas()
+                    ],
+                },
+            },
+        )
+        if recovered and self.recorder.last_trigger is trig:
+            # the black box stays on disk; only the PENDING flag (the
+            # /healthz signal) clears — admitted work is safe on the
+            # survivors, so the fleet is degraded, not down. An earlier
+            # still-pending trigger is put back, not discarded.
+            self.recorder.take_trigger()
+            if pending is not None:
+                self.recorder.last_trigger = pending
+
     def _autoscale(self, tick: int, now: float) -> None:
         if self.autoscaler is None:
             return
@@ -313,6 +591,7 @@ class ControlPlane:
             # churn the backlog guard exists to prevent
             self.ledger.pending() + len(self._migrated),
             now=now,
+            n_failed=self._capacity_gap,
         )
         if decision == "up":
             self.scale_up()
@@ -336,10 +615,12 @@ class ControlPlane:
         self._outputs = {}
         self._order = {}
         self._migrated = []
+        self._reuse = set()
         t0 = now()
         try:
             self._started = [rep for rep in self.replicas
-                             if rep.state is not ReplicaState.STOPPED]
+                             if rep.state not in (ReplicaState.STOPPED,
+                                                  ReplicaState.FAILED)]
             for rep in self._started:
                 rep.engine.start_run((), now=now)
             for req in requests:
@@ -352,17 +633,53 @@ class ControlPlane:
                     tick_hook(self, tick)
                 self._autoscale(tick, now())
                 self._shed_expired(now())
-                placed = self._dispatch(now())
+                placed = self._dispatch(now(), tick)
                 progressed = placed > 0
                 for rep in self.replicas:
-                    if rep.state is ReplicaState.STOPPED:
+                    if rep.state in (ReplicaState.STOPPED,
+                                     ReplicaState.FAILED):
                         continue
+                    if rep.probation_ticks_left > 0:
+                        rep.probation_ticks_left -= 1
                     eng = rep.engine
-                    if not eng.sched.all_done():
-                        progressed = eng.tick_once() or progressed
+                    had_work = not eng.sched.all_done()
+                    ticked = False
+                    if had_work:
+                        try:
+                            ticked = eng.tick_once()
+                        except Exception as e:  # noqa: BLE001 - crash
+                            # detection: ReplicaFault (the seam), the
+                            # engine's own stall watchdog, anything
+                            # escaping a replica tick — quarantine +
+                            # salvage instead of taking the fleet down
+                            self._fail_replica(
+                                rep, tick,
+                                f"tick_once raised "
+                                f"{type(e).__name__}: {e}",
+                            )
+                            progressed = True  # handling IS progress
+                            continue
+                    took = False
                     for req, out in eng.take_finished():
+                        rep.inflight.pop(id(req), None)
                         self._observe_finished(req, out)
+                        took = True
+                    if ticked or took:
+                        rep.note_progress()
                         progressed = True
+                    elif had_work:
+                        # heartbeat miss with work pending: the wedge
+                        # ladder (SERVING -> SUSPECT -> FAILED)
+                        n = rep.note_no_progress()
+                        if n >= self.failed_after_ticks:
+                            self._fail_replica(
+                                rep, tick,
+                                f"wedged: no progress for {n} ticks "
+                                f"with work pending",
+                            )
+                            progressed = True
+                        elif n >= self.suspect_after_ticks:
+                            rep.mark_suspect(tick)
                     rep.maybe_stop()
                 if progressed:
                     idle_ticks = 0
@@ -381,6 +698,7 @@ class ControlPlane:
                     # drain any completion the last tick left behind
                     # before closing the run
                     for req, out in rep.engine.take_finished():
+                        rep.inflight.pop(id(req), None)
                         self._observe_finished(req, out)
                     _, metrics = rep.engine.finish_run()
                     per_replica[rep.name] = metrics
@@ -388,10 +706,15 @@ class ControlPlane:
                     per_replica[rep.name] = rep.final_metrics
         except BaseException:
             # the stall watchdog (or a raising tick_hook) must not
-            # wedge the fleet: abort every replica's steppable run so
-            # a retry can start_run again
+            # wedge the fleet: abort every replica's steppable run so a
+            # retry can start_run again — best-effort PER replica (one
+            # raising abort_run must not skip the rest, or they wedge
+            # forever on "run already in progress")
             for rep in self._started:
-                rep.engine.abort_run()
+                try:
+                    rep.engine.abort_run()
+                except Exception:  # noqa: BLE001 - teardown best effort
+                    pass
             raise
         finally:
             self._running = False
@@ -429,6 +752,8 @@ class ControlPlane:
         return {
             "replicas": [rep.status() for rep in self.replicas],
             "serving": len(self.serving_replicas()),
+            "failed": len(self.failed_replicas()),
+            "capacity_gap": self._capacity_gap,
             "router": self.router.stats(),
             "tenants": self.ledger.stats(),
             "migrated_pending": len(self._migrated),

@@ -1,13 +1,33 @@
 """Replica lifecycle: one ServingEngine behind the router.
 
 A replica is an independently meshed engine — its own scheduler, page
-pool, and prefix cache — wrapped with the state machine the router and
-autoscaler act on:
+pool, and prefix cache — wrapped with the state machine the router,
+autoscaler, and crash-recovery path act on:
 
     SERVING ──start_drain──> DRAINING ──(emptied)──> STOPPED
+       │ ▲                      │
+       │ └──(progress)──┐       │ (tick raised / wedged while draining)
+       ▼                │       ▼
+    SUSPECT ──(no progress x failed_after / tick raised)──> FAILED
+                                                              │
+                                  (rejoin: fault cleared) ────┘
+                                   -> SERVING on probation
 
 - **SERVING** accepts routed requests and ticks every control-plane
   iteration.
+- **SUSPECT** is a replica that stopped making progress while it still
+  had work (heartbeat miss). It keeps ticking — it may recover — but
+  fresh dispatch to it is PROBED with exponential backoff instead of
+  flowing freely; one progressing tick restores SERVING and resets the
+  backoff.
+- **FAILED** is the quarantine: a tick raised (crash) or the heartbeat
+  stayed flat past ``failed_after`` (wedge). The control plane
+  best-effort-aborts the engine's run, drops the router's shadow index
+  for it, and SALVAGES its admitted requests onto the survivors
+  (plane.py). A failed replica can :meth:`ControlPlane.rejoin` after
+  the operator clears the fault — it re-enters SERVING **on
+  probation**: ticked, but not routed fresh ingress until the
+  probation cooldown elapses.
 - **DRAINING** stops accepting. The control plane immediately preempts
   its in-flight requests (pages released, shared prefix pages survive
   in the cache) and withdraws its queue; the migrated requests re-admit
@@ -18,7 +38,7 @@ autoscaler act on:
   aggregate metrics captured in ``final_metrics``.
 
 This module is the structural seam ROADMAP item 2 (disaggregated
-prefill/decode pools) will hang from: a pool is a set of replicas with
+prefill/decode pools) hangs from: a pool is a set of replicas with
 a role tag, and cross-mesh KV streaming replaces the re-prefill
 migration path.
 """
@@ -30,8 +50,16 @@ from typing import Any, Dict, List, Optional
 
 class ReplicaState(enum.Enum):
     SERVING = "serving"
+    SUSPECT = "suspect"
+    FAILED = "failed"
     DRAINING = "draining"
     STOPPED = "stopped"
+
+
+#: probe-backoff cap (ticks): a SUSPECT replica is probed at 1, 2, 4,
+#: ... up to this many ticks apart — bounded so a recovered replica is
+#: rediscovered within one cap interval, not "eventually"
+MAX_PROBE_BACKOFF = 64
 
 
 class Replica:
@@ -49,17 +77,98 @@ class Replica:
         self.state = ReplicaState.SERVING
         self.dispatched = 0            # requests routed here, lifetime
         self.migrated_out = 0          # requests drained away
+        self.salvaged_out = 0          # requests salvaged off a failure
         self.final_metrics: Optional[dict] = None
+        # health bookkeeping (the control plane's heartbeat writes it):
+        # consecutive ticks with work but no progress, the SUSPECT probe
+        # backoff, the post-rejoin probation countdown, and the plane-
+        # side ledger of every request currently owned by this replica
+        # (id(req) -> req, insertion-ordered) — salvage harvests from
+        # HERE, so a crashed scheduler cannot hide its admitted work.
+        self.no_progress_ticks = 0
+        self.probe_backoff = 1
+        self.next_probe_tick = 0
+        self.probation_ticks_left = 0
+        self.failure_reason: Optional[str] = None
+        # set when salvage had to take the resubmit-from-prompt
+        # degradation (the scheduler raised mid-harvest): the engine's
+        # internal state can no longer be trusted, so rejoin refuses —
+        # replace the replica with scale_up instead
+        self.salvage_degraded = False
+        self.inflight: Dict[int, Any] = {}
 
     @property
     def accepting(self) -> bool:
-        return self.state is ReplicaState.SERVING
+        """Router-facing: may fresh work be placed here at all? SUSPECT
+        stays True — the PLANE's backoff filter decides WHEN a suspect
+        is probed (it has the tick clock; this property does not)."""
+        return self.state in (ReplicaState.SERVING, ReplicaState.SUSPECT)
 
     @property
     def busy(self) -> bool:
-        return (self.state is not ReplicaState.STOPPED
+        return (self.state not in (ReplicaState.STOPPED,
+                                   ReplicaState.FAILED)
                 and self.engine.run_in_progress
                 and not self.engine.sched.all_done())
+
+    # -- health transitions (driven by ControlPlane's heartbeat) -----------
+
+    def note_progress(self) -> bool:
+        """A tick made progress: reset the heartbeat, and recover a
+        SUSPECT back to SERVING (backoff reset). Returns True on the
+        SUSPECT->SERVING recovery transition."""
+        self.no_progress_ticks = 0
+        if self.state is ReplicaState.SUSPECT:
+            self.state = ReplicaState.SERVING
+            self.probe_backoff = 1
+            self.next_probe_tick = 0
+            return True
+        return False
+
+    def note_no_progress(self) -> int:
+        self.no_progress_ticks += 1
+        return self.no_progress_ticks
+
+    def mark_suspect(self, tick: int) -> None:
+        if self.state is ReplicaState.SERVING:
+            self.state = ReplicaState.SUSPECT
+            self.probe_backoff = 1
+            self.next_probe_tick = tick  # first probe allowed right away
+
+    def mark_failed(self, reason: str) -> None:
+        self.state = ReplicaState.FAILED
+        self.failure_reason = reason
+
+    def probe_allowed(self, tick: int) -> bool:
+        """SUSPECT dispatch gate, side-effect-free: is a probe window
+        open at ``tick``? The backoff advances only when a probe
+        request is actually PLACED (:meth:`note_probe`) — an idle fleet
+        must not burn through the backoff ladder without ever sending a
+        probe."""
+        return tick >= self.next_probe_tick
+
+    def note_probe(self, tick: int) -> None:
+        """One probe request was routed here: close the window and
+        double the interval to the next one (capped); recovery
+        (:meth:`note_progress`) resets it."""
+        self.next_probe_tick = tick + self.probe_backoff
+        self.probe_backoff = min(self.probe_backoff * 2, MAX_PROBE_BACKOFF)
+
+    def rejoin(self, probation_ticks: int) -> None:
+        """FAILED -> SERVING on probation (the control plane clears the
+        engine fault and restarts the run; this just flips the state)."""
+        if self.state is not ReplicaState.FAILED:
+            raise ValueError(
+                f"replica {self.name!r} is {self.state.value}, not failed"
+            )
+        self.state = ReplicaState.SERVING
+        self.failure_reason = None
+        self.no_progress_ticks = 0
+        self.probe_backoff = 1
+        self.next_probe_tick = 0
+        self.probation_ticks_left = int(probation_ticks)
+
+    # -- planned lifecycle -------------------------------------------------
 
     def start_drain(self) -> List[Any]:
         """Flip to DRAINING and give up every request: active ones are
@@ -68,7 +177,7 @@ class Replica:
         requests — each still carries its generated tokens and its
         original submit/admit timestamps, so re-admission elsewhere
         resumes the exact greedy stream (token-identity pinned)."""
-        if self.state is not ReplicaState.SERVING:
+        if self.state not in (ReplicaState.SERVING, ReplicaState.SUSPECT):
             raise ValueError(
                 f"replica {self.name!r} is {self.state.value}, not serving"
             )
@@ -78,6 +187,8 @@ class Replica:
             sched.preempt(req)
         migrated = [sched.withdraw(req) for req in list(sched.queue)]
         self.migrated_out += len(migrated)
+        for req in migrated:
+            self.inflight.pop(id(req), None)
         return migrated
 
     def maybe_stop(self) -> bool:
@@ -100,8 +211,16 @@ class Replica:
             "state": self.state.value,
             "dispatched": self.dispatched,
             "migrated_out": self.migrated_out,
+            "salvaged_out": self.salvaged_out,
+            "no_progress_ticks": self.no_progress_ticks,
         }
-        if self.state is not ReplicaState.STOPPED:
+        if self.failure_reason is not None:
+            out["failure_reason"] = self.failure_reason
+        if self.probation_ticks_left:
+            out["probation_ticks_left"] = self.probation_ticks_left
+        if self.state is ReplicaState.SUSPECT:
+            out["probe_backoff"] = self.probe_backoff
+        if self.state not in (ReplicaState.STOPPED, ReplicaState.FAILED):
             out["load"] = self.engine.sched.capacity_snapshot()
             if cache is not None:
                 out["cache"] = {

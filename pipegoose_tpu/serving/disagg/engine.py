@@ -50,7 +50,7 @@ from pipegoose_tpu.serving.disagg.transfer import (
     TransferQueue,
 )
 from pipegoose_tpu.serving.disagg.workers import DecodeWorker, PrefillWorker
-from pipegoose_tpu.serving.scheduler import Request
+from pipegoose_tpu.serving.scheduler import Request, Status
 from pipegoose_tpu.telemetry.registry import get_registry
 
 
@@ -71,10 +71,31 @@ class DisaggEngine:
                  max_inflight: int = 8,
                  wire_dtype: Optional[str] = None,
                  registry=None, tracer=None,
-                 stall_patience: int = 1000):
+                 stall_patience: int = 1000,
+                 recorder=None,
+                 max_shipment_age_s: Optional[float] = None,
+                 prefill_fail_patience: int = 50):
+        """``recorder``: optional ``telemetry.FlightRecorder`` — a
+        prefill-pool death dumps one ``replica_failure`` black box
+        naming the pool and every resubmitted uid (the fleet failure
+        contract, docs/robustness.md). ``max_shipment_age_s``: the
+        transfer queue's stuck-shipment timeout (``TransferQueue
+        (max_age_s=)``) — an aged-out shipment fails into the
+        per-shipment fallback instead of blocking the queue.
+        ``prefill_fail_patience``: consecutive no-progress prefill
+        ticks (with work pending and queue room) before the prefill
+        pool is declared WEDGED and the pool-level fallback fires —
+        must stay well under ``stall_patience`` so a dead prefill pool
+        degrades to local re-prefill instead of stalling the run."""
         if stall_patience < 1:
             raise ValueError(
                 f"stall_patience must be >= 1, got {stall_patience}"
+            )
+        if not 1 <= prefill_fail_patience < stall_patience:
+            raise ValueError(
+                f"need 1 <= prefill_fail_patience "
+                f"({prefill_fail_patience}) < stall_patience "
+                f"({stall_patience})"
             )
         self.registry = registry if registry is not None else get_registry()
         reg = self.registry
@@ -86,13 +107,19 @@ class DisaggEngine:
         self._h_bytes = reg.histogram("serving.transfer.bytes")
         self._h_lat = reg.histogram("serving.transfer.seconds")
         self._m_qdepth = reg.gauge("serving.transfer.queue_depth")
+        self._m_qage = reg.gauge("serving.transfer.queue_age_seconds")
+        self._m_pool_failures = reg.counter("serving.fleet.failures_total")
+        self._m_resubmitted = reg.counter("serving.fleet.resubmitted_total")
         self.stall_patience = stall_patience
+        self.prefill_fail_patience = prefill_fail_patience
+        self.recorder = recorder
+        self.prefill_pool_failed: Optional[str] = None  # failure reason
         # plain host tallies next to the registry instruments, so the
         # run metrics stay truthful even under a disabled registry
         self.total_handoffs = self.total_pages = self.total_bytes = 0
         self.transfer = PoolTransfer(prefill_engine, decode_engine,
                                      wire_dtype=wire_dtype)
-        self.queue = TransferQueue(max_inflight)
+        self.queue = TransferQueue(max_inflight, max_age_s=max_shipment_age_s)
         self.prefill = PrefillWorker(prefill_engine, self.queue,
                                      self.transfer)
         self.decode = DecodeWorker(decode_engine, self.transfer,
@@ -115,11 +142,115 @@ class DisaggEngine:
             self.total_handoffs += 1
             self._m_handoffs.inc()
 
+    # -- prefill-pool failure: the pool-level fallback ---------------------
+
+    def _fail_prefill_pool(self, reason: str, tick: int) -> list:
+        """The per-shipment fallback, promoted to POOL level: the
+        prefill pool died (tick raised, or wedged past
+        ``prefill_fail_patience``), so every request it still owed —
+        queued shipments, staged-but-incomplete transfers, failures
+        awaiting a final record that will never come, and requests
+        still queued/mid-prefill on its scheduler — re-prefills LOCALLY
+        on the decode pool (``reuse_uid`` keeps each tracer timeline;
+        greedy determinism keeps every token identical, test-pinned).
+        Requests already staged COMPLETE keep their materialized pages
+        and admit normally; requests already decoding are untouched.
+        One ``replica_failure`` black box names the pool and every
+        resubmitted uid. Returns the prefill side's finished-but-
+        untaken (request, output) pairs — deadline sheds buffered in
+        the run state that abort_run would otherwise silently drop."""
+        pe = self.prefill.engine
+        de = self.decode.engine
+        self.prefill_pool_failed = reason
+        self._m_pool_failures.inc()
+        finished: list = []
+        try:
+            if pe.run_in_progress:
+                finished = pe.take_finished()
+        except Exception:  # noqa: BLE001 - best effort on a dead engine
+            finished = []
+        try:
+            pe.abort_run()
+        except Exception:  # noqa: BLE001 - best effort on a dead engine
+            pass
+        self.prefill.reset_streams()
+        affected: Dict[int, Request] = {}
+        # in-flight shipments can never complete coherently — drop them,
+        # remembering their owners
+        for rec in self.queue.clear():
+            affected[rec.req.uid] = rec.req
+        # staged-but-incomplete transfers: the final record will never
+        # come — release the staged pages + reservation now
+        for uid, st in list(self.decode._staged.items()):
+            if not st["complete"]:
+                req = st["req"]
+                del self.decode._staged[uid]
+                try:
+                    de.sched.abort_transfer(req)
+                except Exception:  # noqa: BLE001 - ledger best effort
+                    pass
+                affected[uid] = req
+        # per-shipment failures already waiting for their final record
+        for uid, req in list(self.decode._failed.items()):
+            del self.decode._failed[uid]
+            affected[uid] = req
+        # requests still living on the prefill scheduler (queued or
+        # mid-prefill) — harvest them off it, best effort per request
+        sched = pe.sched
+        for req in list(sched.active()) + list(sched.queue):
+            try:
+                if req.status in (Status.PREFILL, Status.DECODE):
+                    sched.preempt(req)
+                if req.status is Status.QUEUED:
+                    sched.withdraw(req)
+            except Exception:  # noqa: BLE001 - unreachable prefill-side
+                # state: scrub the fields the decode-pool re-prefill
+                # must not inherit (a prefill-only request holds no
+                # generated tokens, so nothing is lost)
+                req.clear_residency()
+            affected[req.uid] = req
+        for uid in sorted(affected):
+            self.decode._fallback(affected[uid])
+        self._m_resubmitted.inc(len(affected))
+        if self.recorder is not None:
+            # an earlier unconsumed trigger must survive this dump (the
+            # control-plane convention): remember it, fire, consume only
+            # OUR trigger, restore the earlier one
+            pending = self.recorder.last_trigger
+            trig = self.recorder.fire_trigger(
+                "replica_failure",
+                f"prefill pool failed at tick {tick}: {reason} — "
+                f"{len(affected)} request(s) re-prefill locally on the "
+                f"decode pool",
+                tick,
+                details={
+                    "pool": "prefill",
+                    "reason": reason,
+                    "resubmitted_uids": sorted(affected),
+                    "lost_uids": [],
+                    "router": {
+                        "verdict": "per-shipment fallback promoted to "
+                                   "pool level: decode pool serves "
+                                   "everything locally",
+                    },
+                },
+            )
+            if self.recorder.last_trigger is trig:
+                # nothing lost and the decode pool carries on: degraded,
+                # not down — the black box stays on disk, only the
+                # pending /healthz flag clears (the fleet convention)
+                self.recorder.take_trigger()
+                if pending is not None:
+                    self.recorder.last_trigger = pending
+        return finished
+
     # -- the loop ----------------------------------------------------------
 
     def _busy(self) -> bool:
         pe, de = self.prefill.engine, self.decode.engine
-        return (not pe.sched.all_done() or len(self.queue) > 0
+        return ((self.prefill_pool_failed is None
+                 and not pe.sched.all_done())
+                or len(self.queue) > 0
                 or self.decode.pending > 0 or not de.sched.all_done())
 
     def run(self, requests: Sequence[Request], now=time.perf_counter,
@@ -142,8 +273,9 @@ class DisaggEngine:
                       self.total_bytes)
         f0, fb0 = self.decode.failures, self.decode.fallbacks
         self.queue.reset_depth_mark()   # per-run high-water, like the rest
+        self.prefill_pool_failed = None
         t0 = now()
-        tick = stalled = 0
+        tick = stalled = pe_idle = 0
         try:
             for req in requests:
                 pe.submit_request(req)
@@ -152,12 +284,42 @@ class DisaggEngine:
                 if tick_hook is not None:
                     tick_hook(self, tick)
                 progressed = False
-                if not pe.sched.all_done() and self.queue.has_room():
+                pe_alive = self.prefill_pool_failed is None
+                if (pe_alive and not pe.sched.all_done()
+                        and self.queue.has_room()):
                     # queue full = backpressure: the prefill pool
                     # pauses instead of racing ahead of a decode pool
                     # that cannot stage reservations yet
-                    progressed = pe.tick_once() or progressed
-                progressed = self.prefill.stream_ready(now) > 0 or progressed
+                    try:
+                        ticked = pe.tick_once()
+                    except Exception as e:  # noqa: BLE001 - pool crash
+                        for _, out in self._fail_prefill_pool(
+                            f"tick_once raised {type(e).__name__}: {e}",
+                            tick,
+                        ):
+                            outputs[out.uid] = out
+                        pe_alive = False
+                        progressed = True  # failure handling IS progress
+                    else:
+                        progressed = ticked or progressed
+                        if ticked:
+                            pe_idle = 0
+                        else:
+                            # heartbeat miss with work pending and queue
+                            # room: the prefill-pool wedge ladder
+                            pe_idle += 1
+                            if pe_idle >= self.prefill_fail_patience:
+                                for _, out in self._fail_prefill_pool(
+                                    f"wedged: no prefill progress for "
+                                    f"{pe_idle} ticks with work pending",
+                                    tick,
+                                ):
+                                    outputs[out.uid] = out
+                                pe_alive = False
+                                progressed = True
+                if pe_alive:
+                    progressed = (self.prefill.stream_ready(now) > 0
+                                  or progressed)
                 progressed = self.decode.service(self.queue, now) > 0 \
                     or progressed
                 progressed = self.decode.admit_ready(now) > 0 or progressed
@@ -166,10 +328,12 @@ class DisaggEngine:
                 for req, out in de.take_finished():
                     outputs[out.uid] = out
                     progressed = True
-                for req, out in pe.take_finished():
-                    outputs[out.uid] = out   # prefill-side sheds only
-                    progressed = True
+                if pe_alive:
+                    for req, out in pe.take_finished():
+                        outputs[out.uid] = out   # prefill-side sheds only
+                        progressed = True
                 self._m_qdepth.set(float(len(self.queue)))
+                self._m_qage.set(self.queue.oldest_age(now()))
                 if progressed:
                     stalled = 0
                 else:
@@ -183,7 +347,10 @@ class DisaggEngine:
                             f"done={pe.sched.all_done()}, decode "
                             f"done={de.sched.all_done()}"
                         )
-            _, pmetrics = pe.finish_run()
+            if pe.run_in_progress:
+                _, pmetrics = pe.finish_run()
+            else:  # pool death aborted it
+                pmetrics = {"failed": self.prefill_pool_failed}
             _, dmetrics = de.finish_run()
         except BaseException:
             pe.abort_run()
@@ -206,6 +373,9 @@ class DisaggEngine:
             "shed_requests": sum(
                 1 for o in outs if o.finish_reason == "shed"
             ),
+            # None on a healthy run; the failure reason after the
+            # pool-level fallback served everything locally
+            "prefill_pool_failed": self.prefill_pool_failed,
             "transfer": {
                 "handoffs": self.total_handoffs - h0,
                 "pages": self.total_pages - p0,

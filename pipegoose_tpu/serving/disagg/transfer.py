@@ -111,14 +111,28 @@ class TransferQueue:
     prefill engine's tick and the streaming exports, so a slow decode
     pool stalls prefill instead of growing host memory. (The final
     handoff of a chunk already mid-tick may overshoot by one record
-    per prefill slot — a soft bound, pinned by test.)"""
+    per prefill slot — a soft bound, pinned by test.)
 
-    def __init__(self, max_inflight: int = 8):
+    ``max_age_s`` is the stuck-shipment timeout: a record older than
+    this when the decode worker services it (a staging-blocked head
+    the decode ledger can NEVER cover, a hung link) raises
+    :class:`TransferError` into the existing per-shipment fallback —
+    the request re-prefills locally — instead of blocking the queue
+    until the whole-run stall watchdog gives up. ``None`` (default)
+    disables the timeout; backpressure alone bounds the wait."""
+
+    def __init__(self, max_inflight: int = 8,
+                 max_age_s: Optional[float] = None):
         if max_inflight < 1:
             raise ValueError(
                 f"max_inflight must be >= 1, got {max_inflight}"
             )
+        if max_age_s is not None and max_age_s <= 0:
+            raise ValueError(
+                f"max_age_s must be > 0 (or None), got {max_age_s}"
+            )
         self.max_inflight = int(max_inflight)
+        self.max_age_s = max_age_s
         self._q: Deque[PageHandoff] = deque()
         self.max_depth = 0             # high-water mark (test + bench)
 
@@ -147,6 +161,27 @@ class TransferQueue:
     def reset_depth_mark(self) -> None:
         """Start a fresh high-water measurement (per-run reporting)."""
         self.max_depth = len(self._q)
+
+    def oldest_age(self, now: float) -> float:
+        """Age of the oldest queued shipment (0.0 when empty) — the
+        ``serving.transfer.queue_age_seconds`` gauge's source."""
+        if not self._q:
+            return 0.0
+        return max(now - self._q[0].t_created, 0.0)
+
+    def expired(self, rec: PageHandoff, now: float) -> bool:
+        """Has ``rec`` outlived the stuck-shipment timeout?"""
+        return (self.max_age_s is not None
+                and now - rec.t_created > self.max_age_s)
+
+    def clear(self) -> List[PageHandoff]:
+        """Drop every queued shipment and return the dropped records —
+        the POOL-LEVEL failure path (a dead prefill pool's in-flight
+        shipments can never complete coherently; the affected requests
+        re-prefill locally on the decode pool instead)."""
+        dropped = list(self._q)
+        self._q.clear()
+        return dropped
 
 
 def _host(slab):

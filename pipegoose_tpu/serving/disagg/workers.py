@@ -28,7 +28,7 @@ gather/scatter (transfer.py).
 """
 from __future__ import annotations
 
-from typing import Dict, Optional, Set
+from typing import Dict, Optional
 
 from pipegoose_tpu.serving.disagg.transfer import (
     PageHandoff,
@@ -105,6 +105,12 @@ class PrefillWorker:
         self._push(req, start, total, final=True,
                    first_token=first_token, t=t)
 
+    def reset_streams(self) -> None:
+        """Forget per-request streaming progress — the prefill-pool
+        failure path (every affected request restarts from its prompt
+        on the decode pool; nothing already shipped stays valid)."""
+        self._streamed.clear()
+
     def _push(self, req: Request, p0: int, p1: int, *, final: bool,
               first_token: Optional[int], t: float) -> None:
         ids = req.pages[p0:p1]
@@ -136,7 +142,10 @@ class DecodeWorker:
         self.owner = owner                     # DisaggEngine (metrics)
         self._staged: Dict[int, dict] = {}     # uid -> {req, first_token,
         #                                        complete}
-        self._failed: Set[int] = set()         # uids awaiting fallback
+        # uid -> req awaiting fallback (the request rides along so a
+        # POOL-level failure — the final record never arriving — can
+        # still fall back without a queue record in hand)
+        self._failed: Dict[int, Request] = {}
         self.fallbacks = 0
         self.failures = 0
 
@@ -165,8 +174,25 @@ class DecodeWorker:
                 # — only then may the fallback re-own the request
                 queue.remove(rec)
                 if rec.final:
-                    self._failed.discard(req.uid)
+                    del self._failed[req.uid]
                     self._fallback(req)
+                continue
+            if queue.expired(rec, now()):
+                # stuck-shipment timeout (TransferQueue.max_age_s): a
+                # record nobody could service in time — typically a
+                # staging-blocked head whose reservation the decode
+                # ledger can never cover — fails into the SAME
+                # per-shipment fallback instead of blocking the queue
+                # until the run-level stall watchdog gives up
+                queue.remove(rec)
+                self._fail(
+                    req,
+                    TransferError(
+                        f"shipment for uid={req.uid} aged out "
+                        f"(> {queue.max_age_s}s in the transfer queue)"
+                    ),
+                    final_seen=rec.final,
+                )
                 continue
             if req.uid not in self._staged:
                 if staging_blocked or not sched.begin_transfer(req, now()):
@@ -237,7 +263,7 @@ class DecodeWorker:
         if final_seen:
             self._fallback(req)        # prefill pool already released it
         else:
-            self._failed.add(req.uid)  # wait for the final record
+            self._failed[req.uid] = req  # wait for the final record
 
     def _fallback(self, req: Request) -> None:
         """Local re-prefill: the decode engine's own paged prefill

@@ -96,6 +96,14 @@ from pipegoose_tpu.telemetry.registry import Histogram, get_registry
 from pipegoose_tpu.telemetry.spans import span
 
 
+class ReplicaFault(RuntimeError):
+    """An unplanned replica failure (the deterministic fault seam's
+    crash kind, or a real exception escaping ``tick_once``). The
+    control plane's contract on catching one: quarantine the replica
+    (FAILED), best-effort ``abort_run``, and SALVAGE its admitted
+    requests onto the survivors (serving/control_plane/plane.py)."""
+
+
 @dataclass
 class RequestOutput:
     uid: int
@@ -249,6 +257,12 @@ class ServingEngine:
         self.last_doctor_report = None   # refreshed by doctor()/doctor_chunk()
         self.last_step_profile = None    # refreshed by profile()
         self._run: Optional[_RunState] = None   # live steppable run
+        # deterministic failure seam (testing/chaos.py replica_crash /
+        # replica_wedge): None | "crash" (tick_once raises ReplicaFault
+        # every call until cleared) | "wedge" (tick_once returns without
+        # doing any work — the engine looks alive but makes no progress,
+        # which is exactly what the control plane's heartbeat must catch)
+        self._fault: Optional[str] = None
         if recorder is not None and tracer is not None:
             # a decode_stall (or any) black box then embeds the live
             # request timelines: the dump NAMES the stuck request
@@ -1053,8 +1067,25 @@ class ServingEngine:
         accumulators drop, the engine becomes reusable. Requests still
         in the scheduler are NOT touched — callers owning them (the
         control plane's drain path) withdraw first. No-op when no run
-        is in progress."""
+        is in progress. The injected fault (if any) stays armed: a
+        crashed replica stays crashed until :meth:`inject_fault`
+        explicitly clears it (the rejoin path)."""
         self._run = None
+
+    def inject_fault(self, kind: Optional[str]) -> None:
+        """Arm (or clear, ``kind=None``) the deterministic failure
+        seam: ``"crash"`` makes every subsequent :meth:`tick_once`
+        raise :class:`ReplicaFault`; ``"wedge"`` makes it return
+        without doing any work — alive on the wire, dead in fact. The
+        chaos harness's ``replica_crash`` / ``replica_wedge`` kinds arm
+        this; the control plane's health state machine is what must
+        notice."""
+        if kind not in (None, "crash", "wedge"):
+            raise ValueError(
+                f"unknown fault kind {kind!r} (expected None, 'crash' "
+                f"or 'wedge')"
+            )
+        self._fault = kind
 
     def start_run(self, requests: Sequence[Request] = (),
                   now=time.perf_counter, tick_hook=None) -> None:
@@ -1108,6 +1139,19 @@ class ServingEngine:
         rs = self._run
         if rs is None:
             raise RuntimeError("tick_once needs start_run first")
+        if self._fault == "crash":
+            raise ReplicaFault(
+                "injected replica crash (testing/chaos.py fault seam)"
+            )
+        if self._fault == "wedge":
+            # no work, no state change — but the engine's OWN stall
+            # watchdog still counts, so a standalone run() eventually
+            # raises instead of livelocking; a control plane's health
+            # heartbeat catches the wedge much earlier
+            rs.stalled += 1
+            if rs.stalled >= self.stall_patience:
+                self._stall(rs.steps, rs.now() - rs.t0)
+            return False
         reg = self.registry
         now = rs.now
         rs.tick += 1

@@ -105,6 +105,19 @@ class Request:
     t_done: Optional[float] = None
     ttft_observed: bool = False
 
+    def clear_residency(self) -> None:
+        """Scrub the scheduler-residency fields (slot, pages,
+        reservation, COW, prefill progress) WITHOUT touching identity,
+        tokens, or timestamps — the crash-salvage paths' best-effort
+        reset before re-submitting a request harvested off a broken
+        scheduler onto a healthy one (the normal lifecycle resets these
+        through preempt/admit; this is for when those paths raised)."""
+        self.slot = None
+        self.pages = []
+        self.outstanding = 0
+        self.cow = None
+        self.prefilled_len = self.hit_tokens = 0
+
     @property
     def prompt_len(self) -> int:
         return int(np.asarray(self.prompt).shape[0])
@@ -213,11 +226,16 @@ class Scheduler:
             )
         if not (reuse_uid and req.uid is not None):
             # reuse_uid=True: a cross-scheduler flow (the disagg
-            # fallback re-submitting a transfer-failed request onto the
-            # decode pool) keeps the uid its tracer timeline is keyed
-            # by; the CALLER owns uniqueness across the schedulers
-            # involved (disagg uids all come from the prefill
-            # scheduler's counter)
+            # fallback and the crash-salvage resubmit path re-submitting
+            # a request onto another pool) keeps the uid its tracer
+            # timeline is keyed by; the CALLER owns uniqueness across
+            # the schedulers involved — disagg uids all come from the
+            # prefill scheduler's counter, and the control plane mints
+            # each replica's uids from a disjoint block (UID_STRIDE).
+            # The local counter deliberately does NOT jump past a
+            # reused uid: jumping would leak this scheduler's counter
+            # into another replica's block, recreating the very
+            # collision the blocks exist to prevent.
             req.uid = self._next_uid
             self._next_uid += 1
         if req.t_submit is None:

@@ -17,6 +17,7 @@ from pipegoose_tpu.testing.chaos import (  # noqa: F401
     ChaosSchedule,
     Injection,
     TransientIOFault,
+    TransientTransferFault,
     schedule_fingerprint,
     tear_checkpoint,
 )
@@ -30,6 +31,7 @@ __all__ = [
     "ChaosSchedule",
     "Injection",
     "TransientIOFault",
+    "TransientTransferFault",
     "schedule_fingerprint",
     "tear_checkpoint",
     "fake_cluster",

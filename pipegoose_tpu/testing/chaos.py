@@ -53,6 +53,23 @@ Injection kinds (``KINDS``):
 ``ckpt_io_error``    arm ``utils/checkpoint.py``'s save-attempt fault
                      hook with ``fail_times`` transient ``OSError``s —
                      the bounded-retry+backoff path must absorb them.
+``replica_crash``    arm the serving-engine fault seam on one fleet
+                     replica (``ServingEngine.inject_fault("crash")``)
+                     — its next ``tick_once`` raises ``ReplicaFault``;
+                     the control plane's health state machine must
+                     quarantine it and SALVAGE its admitted requests
+                     (serving/control_plane/plane.py).
+``replica_wedge``    same seam, ``"wedge"``: the replica's ticks return
+                     without doing work — alive on the wire, dead in
+                     fact — exercising the heartbeat's
+                     SUSPECT -> FAILED ladder instead of the crash
+                     shortcut.
+``transfer_flap``    arm the disagg transfer fault seam
+                     (serving/disagg/transfer.py ``set_transfer_fault``)
+                     with ``fail_times`` transient ``TransferError``s —
+                     each failed shipment must abort its staging and
+                     fall back to a local re-prefill on the decode
+                     pool, token-identically.
 
 Host-side by design (and jit-safety-allowlisted): injections run in
 callback/tick context, never inside compiled code.
@@ -73,11 +90,26 @@ KINDS: Tuple[str, ...] = (
     "host_stall",
     "torn_checkpoint",
     "ckpt_io_error",
+    # fleet-serving kinds (appended, never inserted: the seeded draw
+    # order follows this tuple, so adding a kind must not perturb the
+    # steps of kinds drawn before it — byte-determinism pin)
+    "replica_crash",
+    "replica_wedge",
+    "transfer_flap",
 )
 
 #: kinds applied by the serving tick hook (matched on engine tick
 #: number); the rest are trainer-callback injections (matched on step)
-SERVING_KINDS: Tuple[str, ...] = ("host_stall",)
+SERVING_KINDS: Tuple[str, ...] = ("host_stall", "transfer_flap")
+
+#: kinds applied by the FLEET hook (``ControlPlane.run(tick_hook=
+#: monkey.fleet_hook)``), matched on the control-plane tick number
+FLEET_KINDS: Tuple[str, ...] = (
+    "replica_crash",
+    "replica_wedge",
+    "transfer_flap",
+    "host_stall",
+)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -142,10 +174,15 @@ class ChaosSchedule:
         host_stall: int = 0,
         torn_checkpoint: int = 0,
         ckpt_io_error: int = 0,
+        replica_crash: int = 0,
+        replica_wedge: int = 0,
+        transfer_flap: int = 0,
         n_lose: int = 1,
         module_groups: Sequence[str] = ("embed",),
         stall_s: float = 0.05,
         fail_times: int = 1,
+        n_replicas: int = 2,
+        flap_times: int = 1,
         min_step: int = 1,
     ) -> "ChaosSchedule":
         """Draw ``<kind>=count`` injections at distinct steps in
@@ -161,6 +198,9 @@ class ChaosSchedule:
             "host_stall": host_stall,
             "torn_checkpoint": torn_checkpoint,
             "ckpt_io_error": ckpt_io_error,
+            "replica_crash": replica_crash,
+            "replica_wedge": replica_wedge,
+            "transfer_flap": transfer_flap,
         }
         span = max_step - min_step + 1
         total = sum(counts.values())
@@ -188,8 +228,15 @@ class ChaosSchedule:
                     args = _args(stall_s=float(stall_s))
                 elif kind == "torn_checkpoint":
                     args = _args()
-                else:  # ckpt_io_error
+                elif kind == "ckpt_io_error":
                     args = _args(fail_times=int(fail_times))
+                elif kind in ("replica_crash", "replica_wedge"):
+                    # victim drawn per injection: the index is resolved
+                    # modulo the LIVE candidates at fire time, so the
+                    # same schedule applies to any fleet size
+                    args = _args(replica=int(rng.randint(n_replicas)))
+                else:  # transfer_flap
+                    args = _args(fail_times=int(flap_times))
                 injections.append(Injection(step, kind, args))
         return cls(injections, seed=seed, max_step=max_step)
 
@@ -233,6 +280,28 @@ class TransientIOFault:
             raise OSError(
                 f"chaos: injected transient checkpoint I/O error "
                 f"({self.fired} so far)"
+            )
+
+
+class TransientTransferFault:
+    """Shipment-import fault: raises ``TransferError`` for the first
+    ``times`` imports, then passes — what ``transfer_flap`` arms on
+    serving/disagg/transfer.py's :func:`set_transfer_fault` seam. The
+    hook signature is the seam's ``(kind, uid, n_pages)``."""
+
+    def __init__(self, times: int):
+        self.remaining = int(times)
+        self.fired = 0
+
+    def __call__(self, kind: str, uid: int, n_pages: int) -> None:
+        if self.remaining > 0:
+            from pipegoose_tpu.serving.disagg.transfer import TransferError
+
+            self.remaining -= 1
+            self.fired += 1
+            raise TransferError(
+                f"chaos: injected transfer flap on {kind} of uid={uid} "
+                f"({n_pages} pages, {self.fired} so far)"
             )
 
 
@@ -298,10 +367,14 @@ class ChaosMonkey:
         self.checkpoint_dir = checkpoint_dir
         self.applied: List[Injection] = []
         self.io_faults: List[TransientIOFault] = []
-        # hook installed before our first arm — disarm restores it, so
-        # the monkey never clobbers an externally installed fault seam
+        self.transfer_faults: List[TransientTransferFault] = []
+        # hooks installed before our first arm — disarm restores them,
+        # so the monkey never clobbers an externally installed fault
+        # seam (one flag per seam: ckpt I/O and disagg transfer)
         self._prev_hook: Optional[Any] = None
         self._armed = False
+        self._prev_xfer_hook: Optional[Any] = None
+        self._xfer_armed = False
         # fire-once bookkeeping: recovery REWINDS the step counter, so
         # the steps after a rollback replay through the schedule again —
         # re-injecting would make every recovery replay its own cause
@@ -403,6 +476,36 @@ class ChaosMonkey:
         time.sleep(float(inj.kwargs.get("stall_s", 0.05)))
         self._log(inj)
 
+    # -- fleet-serving applications ----------------------------------------
+
+    def _apply_transfer_flap(self, inj: Injection) -> None:
+        from pipegoose_tpu.serving.disagg.transfer import set_transfer_fault
+
+        fault = TransientTransferFault(int(inj.kwargs.get("fail_times", 1)))
+        self.transfer_faults.append(fault)
+        prev = set_transfer_fault(fault)
+        if not self._xfer_armed:  # remember only the EXTERNAL hook
+            self._prev_xfer_hook = prev
+            self._xfer_armed = True
+        self._log(inj)
+
+    def _apply_replica_fault(self, plane: Any, inj: Injection,
+                             kind: str) -> None:
+        from pipegoose_tpu.serving.control_plane.replica import ReplicaState
+
+        victims = [r for r in plane.replicas
+                   if r.state in (ReplicaState.SERVING,
+                                  ReplicaState.SUSPECT,
+                                  ReplicaState.DRAINING)]
+        if not victims:
+            self._log(inj, skipped="no live replica to fault")
+            return
+        victim = victims[int(inj.kwargs.get("replica", 0)) % len(victims)]
+        victim.engine.inject_fault(kind)
+        # `victim`, not `replica`: the injection's own arg (the drawn
+        # index) already rides the record as `replica`
+        self._log(inj, victim=victim.name, fault=kind)
+
     # -- trainer callback interface (duck-typed, see class docstring) ------
 
     def on_fit_start(self, trainer: Any) -> None:
@@ -454,25 +557,57 @@ class ChaosMonkey:
         self.disarm()
 
     def disarm(self) -> None:
-        """Restore the pre-arm checkpoint I/O fault hook (idempotent) —
-        a schedule's faults cannot outlive the run that armed them, and
-        an externally installed hook is put back, not clobbered."""
+        """Restore the pre-arm checkpoint-I/O and transfer fault hooks
+        (idempotent) — a schedule's faults cannot outlive the run that
+        armed them, and an externally installed hook is put back, not
+        clobbered."""
         from pipegoose_tpu.utils.checkpoint import set_io_fault_hook
 
         if self._armed:
             set_io_fault_hook(self._prev_hook)
             self._prev_hook = None
             self._armed = False
+        if self._xfer_armed:
+            from pipegoose_tpu.serving.disagg.transfer import (
+                set_transfer_fault,
+            )
 
-    # -- serving tick hook -------------------------------------------------
+            set_transfer_fault(self._prev_xfer_hook)
+            self._prev_xfer_hook = None
+            self._xfer_armed = False
+
+    # -- serving tick hooks ------------------------------------------------
 
     def tick_hook(self, engine: Any, tick: int) -> None:
-        """``ServingEngine.run(tick_hook=...)`` seam: apply
-        serving-capable injections whose ``step`` matches the engine
-        tick. One method instead of a lambda so tests can pass the
-        monkey around whole."""
+        """``ServingEngine.run(tick_hook=...)`` /
+        ``DisaggEngine.run(tick_hook=...)`` seam: apply serving-capable
+        injections whose ``step`` matches the engine tick. One method
+        instead of a lambda so tests can pass the monkey around
+        whole."""
         for inj in self._take(tick, SERVING_KINDS):
             if inj.kind == "host_stall":
+                self._apply_host_stall(inj)
+            else:  # transfer_flap
+                self._apply_transfer_flap(inj)
+
+    def fleet_hook(self, plane: Any, tick: int) -> None:
+        """``ControlPlane.run(tick_hook=...)`` seam: apply fleet-level
+        injections whose ``step`` matches the control-plane tick —
+        ``replica_crash``/``replica_wedge`` arm the named (modulo live
+        fleet size) replica's engine fault seam; ``transfer_flap`` and
+        ``host_stall`` behave as in :meth:`tick_hook`. The failure this
+        causes is DETECTED by the plane's health state machine next
+        tick; the ring then shows the ``chaos.injection`` record right
+        next to the ``replica_failure`` black box it provoked."""
+        for inj in self._take(tick, FLEET_KINDS):
+            if inj.kind in ("replica_crash", "replica_wedge"):
+                self._apply_replica_fault(
+                    plane, inj,
+                    "crash" if inj.kind == "replica_crash" else "wedge",
+                )
+            elif inj.kind == "transfer_flap":
+                self._apply_transfer_flap(inj)
+            else:  # host_stall
                 self._apply_host_stall(inj)
 
     # -- forensics ---------------------------------------------------------

@@ -293,6 +293,70 @@ print(f"disagg smoke OK: token-identical across pools, attribution exact, "
       f"({xfer['wire_savings_ratio']:.0%} under fp)")
 PY
 
+# Crash-recovery smoke (serving/control_plane/ + testing/chaos.py,
+# ISSUE 15): a SEEDED replica_crash mid-run on a 2-replica fleet must
+# be detected by the health state machine, the dead replica
+# quarantined, and every admitted request SALVAGED onto the survivor —
+# outputs token-identical to the no-crash fleet, zero requests lost.
+echo "== crash-recovery smoke (2 replicas, seeded replica_crash) =="
+python - <<'PY'
+import tempfile
+
+from pipegoose_tpu.testing import (
+    ChaosMonkey,
+    ChaosSchedule,
+    force_cpu_devices,
+    schedule_fingerprint,
+)
+
+force_cpu_devices(1)
+
+import jax
+import numpy as np
+
+from pipegoose_tpu.models import bloom
+from pipegoose_tpu.serving import Request, ServingEngine, make_skewed_replay
+from pipegoose_tpu.serving.control_plane import ControlPlane
+from pipegoose_tpu.telemetry import FlightRecorder
+
+cfg = bloom.BloomConfig(vocab_size=64, hidden_size=32, n_layer=2, n_head=2)
+params = bloom.init_params(cfg, jax.random.PRNGKey(0))
+replay = make_skewed_replay(n_requests=10, n_prefixes=3, prefix_len=32,
+                            suffix_lens=(2, 4), max_new=2, vocab=64,
+                            seed=0, n_tenants=2)
+reqs = lambda: [Request(prompt=p, max_new_tokens=m, tenant=t)
+                for p, m, t in replay]
+
+def factory(name, registry):
+    return ServingEngine(params, cfg, num_slots=1, num_pages=33,
+                         page_size=8, max_context=96, prefix_cache=True,
+                         registry=registry)
+
+out = tempfile.mkdtemp(prefix="crash_smoke_")
+recorder = FlightRecorder(out, capacity=64)
+plane = ControlPlane(factory, n_replicas=2, recorder=recorder)
+clean, _ = plane.run(reqs())
+schedule = ChaosSchedule.seeded(99, max_step=6, min_step=4,
+                                replica_crash=1, n_replicas=2)
+assert schedule_fingerprint(schedule) == schedule_fingerprint(
+    ChaosSchedule.seeded(99, max_step=6, min_step=4, replica_crash=1,
+                         n_replicas=2)), "seeded schedule not reproducible"
+monkey = ChaosMonkey(schedule, recorder=recorder)
+crashed, metrics = plane.run(reqs(), tick_hook=monkey.fleet_hook)
+assert len(monkey.applied) == 1, monkey.applied_json()
+assert len(crashed) == len(clean) == 10, (len(clean), len(crashed))
+for a, b in zip(clean, crashed):
+    np.testing.assert_array_equal(a.generated, b.generated,
+                                  err_msg="crash recovery diverged")
+assert plane._m_failures.value == 1.0, "crash was not detected"
+assert plane._m_lost.value == 0.0, "admitted requests were lost"
+assert plane.fleet_status()["failed"] == 1
+assert recorder.last_trigger is None, "recovered failure left /healthz red"
+print(f"crash-recovery smoke OK: replica failed + quarantined, "
+      f"{int(plane._m_salvaged.value + plane._m_resubmitted.value)} "
+      f"request(s) salvaged, outputs token-identical, 0 lost")
+PY
+
 # Profile smoke (telemetry/xprof.py, ISSUE 14): measured step
 # attribution of a tiny hybrid step on fake CPU devices — the
 # compute + per-axis-collective + idle components must sum to the

@@ -205,6 +205,16 @@ FAST_TESTS = {
     "tests/planner/test_planner.py::test_cost_model_calibrate_fits_constants_from_profiles",
     "tests/planner/test_planner.py::test_record_profile_and_rescore_flip_ranking_to_measured",
     "tests/serving/test_engine.py::test_sentinel_observe_disabled_under_5us",
+    # fleet crash recovery (ISSUE 15): the health-state-machine /
+    # probe-backoff / capacity-loss / seeded-chaos-kind unit nodes plus
+    # ONE representative salvage e2e (wedge ladder, crash-during-drain,
+    # resubmit degradation, healthz flip, rejoin stay tier-1; the
+    # teardown + ledger satellites ride their whole-file fast entries)
+    "tests/serving/test_fleet_failure.py::test_replica_health_transitions_and_probe_backoff",
+    "tests/serving/test_fleet_failure.py::test_autoscaler_failed_replicas_are_a_capacity_loss_signal",
+    "tests/serving/test_fleet_failure.py::test_chaos_schedule_new_kinds_seeded_byte_identical",
+    "tests/serving/test_fleet_failure.py::test_replica_crash_salvages_token_identical",
+    "tests/serving/test_disagg.py::test_transfer_queue_age_and_clear_unit",
 }
 
 

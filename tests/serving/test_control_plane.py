@@ -609,3 +609,35 @@ def test_route_disagg_prefill_pick_prefers_least_owed(tiny):
         rep.state = rep.state.__class__.DRAINING
     assert router.route_disagg(r, prefill, decode, now=1.0) is None
     assert router._m_unplaceable.value >= 1
+
+
+def test_exception_teardown_aborts_remaining_replicas_past_a_raising_abort(tiny):
+    """Satellite regression (ISSUE 15): the BaseException teardown's
+    abort loop must be best-effort PER replica — one replica whose
+    abort_run raises must not skip the replicas behind it, or they
+    stay wedged on 'run already in progress' forever."""
+    params, cfg = tiny
+    plane = ControlPlane(_factory(params, cfg), n_replicas=2)
+    reqs = _replay_requests(n=6)
+    rep0 = plane.replicas[0]
+    orig_abort = rep0.engine.abort_run
+
+    def bad_abort():
+        raise RuntimeError("abort_run failed")
+
+    rep0.engine.abort_run = bad_abort
+
+    def boom(p, tick):
+        if tick == 2:
+            raise RuntimeError("injected hook failure")
+
+    try:
+        with pytest.raises(RuntimeError, match="injected hook failure"):
+            plane.run(reqs(), tick_hook=boom)
+    finally:
+        rep0.engine.abort_run = orig_abort
+    # the replica BEHIND the raising abort was still aborted
+    assert not plane.replicas[1].engine.run_in_progress
+    rep0.engine.abort_run()            # operator clears the wedged one
+    outs, _ = plane.run(reqs())        # fleet reusable end to end
+    assert len(outs) >= 6

@@ -59,7 +59,7 @@ def _single(params, cfg, **kw):
 def _disagg(params, cfg, *, kv_dtype=None, max_inflight=4,
             prefill_mesh=None, prefill_specs=None, tracer=None,
             wire_dtype=None, decode_pages=32, decode_mesh=None,
-            decode_specs=None):
+            decode_specs=None, **engine_kw):
     pe = ServingEngine(params, cfg, num_slots=2, num_pages=32,
                        page_size=PS, max_context=32, prefix_cache=True,
                        prefill_chunk=CHUNK, prefill_only=True,
@@ -73,7 +73,8 @@ def _disagg(params, cfg, *, kv_dtype=None, max_inflight=4,
                        registry=MetricsRegistry(), stall_patience=10_000)
     return DisaggEngine(pe, de, max_inflight=max_inflight,
                         registry=MetricsRegistry(enabled=True),
-                        tracer=tracer, wire_dtype=wire_dtype)
+                        tracer=tracer, wire_dtype=wire_dtype,
+                        **engine_kw)
 
 
 def _assert_identical(ref_outs, outs, label):
@@ -391,3 +392,158 @@ def test_validation_contracts(setup):
     # and a handoff hook before it runs
     with pytest.raises(RuntimeError, match="handoff hook"):
         pe.run([Request(prompt=np.arange(1, 6), max_new_tokens=2)])
+
+
+# --- prefill-pool death: the pool-level fallback (ISSUE 15) ----------------
+
+
+def _balanced(sched, pool):
+    """The ledger-consistency pin: no stranded reservations or
+    transfer records once a scheduler has drained."""
+    snap = sched.capacity_snapshot()
+    assert snap["outstanding_pages"] == 0, snap
+    assert snap["transfer_requests"] == 0, snap
+    assert snap["transfer_tokens_owed"] == 0, snap
+    assert snap["active_requests"] == 0 and snap["queued_requests"] == 0
+    # every non-cache page is back on the free list (cache-published
+    # pages legitimately stay resident at refcount 1)
+    cached = (sched.cache.cached_pages if sched.cache is not None else 0)
+    assert pool.free_count + cached == pool.capacity, (
+        pool.free_count, cached, pool.capacity)
+
+
+def test_prefill_pool_crash_promotes_fallback_to_pool_level(setup,
+                                                            tmp_path):
+    """A prefill-pool DEATH (tick raises) promotes the per-shipment
+    fallback to pool level: every staged + queued + mid-prefill +
+    future request re-prefills locally on the decode pool, outputs
+    token-identical, one replica_failure black box naming the pool and
+    every resubmitted uid — and both ledgers balance afterwards."""
+    from pipegoose_tpu.telemetry.flightrec import FlightRecorder
+
+    cfg, params, reqs = setup
+    single = _single(params, cfg, registry=MetricsRegistry())
+    ref_outs, _ = single.run(_requests(reqs))
+    recorder = FlightRecorder(str(tmp_path), capacity=64)
+    dis = _disagg(params, cfg, recorder=recorder)
+
+    def hook(engine, tick):
+        if tick == 2:
+            engine.prefill.engine.inject_fault("crash")
+
+    outs, metrics = dis.run(_requests(reqs), tick_hook=hook)
+    _assert_identical(ref_outs, outs, "pool death")
+    assert metrics["prefill_pool_failed"] is not None
+    assert "ReplicaFault" in metrics["prefill_pool_failed"]
+    assert metrics["prefill_pool"] == {
+        "failed": metrics["prefill_pool_failed"]}
+    assert metrics["transfer"]["fallbacks"] >= 1
+    # the decode pool really served the fallen-back prefills itself
+    assert metrics["decode_pool"]["prefill_tokens"] > 0
+    # black box: pool + resubmitted uids; recovered (nothing lost,
+    # decode pool serving) => the pending /healthz flag was consumed
+    dumps = [p for p in recorder.dumps if "replica_failure" in p]
+    assert len(dumps) == 1
+    import json as _json
+    with open(dumps[0]) as f:
+        det = _json.load(f)["trigger"]["details"]
+    assert det["pool"] == "prefill"
+    assert det["resubmitted_uids"] and det["lost_uids"] == []
+    assert recorder.last_trigger is None
+    # ledger consistency after the aborted run + salvage, BOTH pools
+    _balanced(dis.decode.engine.sched, dis.decode.engine.pool)
+    _balanced(dis.prefill.engine.sched, dis.prefill.engine.pool)
+    assert len(dis.queue) == 0 and dis.decode.pending == 0
+
+
+def test_prefill_pool_wedge_promotes_fallback(setup):
+    """The wedge variant: a prefill pool that stops progressing (fault
+    seam 'wedge') past prefill_fail_patience is declared dead and the
+    same pool-level fallback serves everything, token-identically."""
+    cfg, params, reqs = setup
+    single = _single(params, cfg, registry=MetricsRegistry())
+    ref_outs, _ = single.run(_requests(reqs))
+    dis = _disagg(params, cfg, prefill_fail_patience=5)
+
+    def hook(engine, tick):
+        if tick == 2:
+            engine.prefill.engine.inject_fault("wedge")
+
+    outs, metrics = dis.run(_requests(reqs), tick_hook=hook)
+    _assert_identical(ref_outs, outs, "pool wedge")
+    assert "wedged" in metrics["prefill_pool_failed"]
+    assert metrics["transfer"]["fallbacks"] >= 1
+
+
+def test_stuck_shipment_times_out_into_fallback(setup):
+    """TransferQueue.max_age_s: a shipment nobody services in time
+    raises TransferError into the EXISTING per-shipment fallback
+    instead of blocking the queue forever. With an (absurd) instant
+    timeout every shipment ages out and the run degrades to
+    local-prefill-on-the-decode-pool — still token-identical."""
+    cfg, params, reqs = setup
+    single = _single(params, cfg, registry=MetricsRegistry())
+    ref_outs, _ = single.run(_requests(reqs))
+    dis = _disagg(params, cfg, max_shipment_age_s=1e-9)
+    outs, metrics = dis.run(_requests(reqs))
+    _assert_identical(ref_outs, outs, "aged out")
+    assert metrics["transfer"]["fallbacks"] == len(reqs)
+    assert metrics["transfer"]["failures"] >= len(reqs)
+    assert metrics["prefill_pool_failed"] is None   # pools stay healthy
+    # the age gauge exists and was maintained
+    snap = dis.registry.snapshot()
+    assert "serving.transfer.queue_age_seconds" in snap["gauges"]
+
+
+def test_transfer_queue_age_and_clear_unit():
+    from pipegoose_tpu.serving.disagg import PageHandoff, TransferQueue
+
+    with pytest.raises(ValueError, match="max_age_s"):
+        TransferQueue(4, max_age_s=0.0)
+    q = TransferQueue(4, max_age_s=1.0)
+
+    def rec(t):
+        return PageHandoff(req=None, page_index=0, n_pages=0,
+                           tokens_end=0, k=None, v=None, wire_bytes=0,
+                           final=False, first_token=None, t_created=t)
+
+    assert q.oldest_age(now=5.0) == 0.0      # empty
+    a, b = rec(1.0), rec(3.0)
+    q.push(a)
+    q.push(b)
+    assert q.oldest_age(now=5.0) == pytest.approx(4.0)
+    assert q.expired(a, now=2.5) and not q.expired(b, now=2.5)
+    assert not TransferQueue(4).expired(a, now=1e9)   # disabled
+    dropped = q.clear()
+    assert dropped == [a, b] and len(q) == 0
+
+
+def test_transfer_flap_chaos_kind_arms_and_disarm_restores(setup):
+    """The seeded chaos kind: transfer_flap arms the transfer fault
+    seam with N transient failures mid-run — each exercises the
+    per-shipment fallback — and disarm restores the pre-arm hook."""
+    from pipegoose_tpu.serving.disagg import transfer as transfer_mod
+    from pipegoose_tpu.testing.chaos import (
+        ChaosMonkey,
+        ChaosSchedule,
+        Injection,
+    )
+
+    cfg, params, reqs = setup
+    single = _single(params, cfg, registry=MetricsRegistry())
+    ref_outs, _ = single.run(_requests(reqs))
+    dis = _disagg(params, cfg)
+    schedule = ChaosSchedule(
+        [Injection(2, "transfer_flap", (("fail_times", 1),))])
+    monkey = ChaosMonkey(schedule)
+    try:
+        outs, metrics = dis.run(_requests(reqs),
+                                tick_hook=monkey.tick_hook)
+    finally:
+        monkey.disarm()
+    _assert_identical(ref_outs, outs, "transfer flap")
+    assert len(monkey.applied) == 1
+    assert monkey.transfer_faults[0].fired == 1
+    assert metrics["transfer"]["failures"] == 1
+    assert metrics["transfer"]["fallbacks"] == 1
+    assert transfer_mod._fault_hook is None   # disarm restored it

@@ -1,0 +1,485 @@
+"""Serving fleet crash recovery (ISSUE 15): unplanned replica failure
+detection (SERVING -> SUSPECT -> FAILED heartbeat ladder + the engine
+fault seam), in-flight request SALVAGE (re-dispatch ahead of fresh
+ingress through the re-prefill-resumes-at-pending-token machinery,
+resubmit-from-prompt degradation with reuse_uid), router quarantine +
+probation rejoin, the replica_failure black box, and the seeded fleet
+chaos kinds — all pinned token-identical to a no-crash run with zero
+admitted requests lost."""
+import json
+import os
+
+import numpy as np
+import pytest
+
+from pipegoose_tpu.serving import ReplicaFault, Request
+from pipegoose_tpu.serving.control_plane import (
+    Autoscaler,
+    AutoscalerConfig,
+    ControlPlane,
+    Replica,
+    ReplicaState,
+)
+from pipegoose_tpu.telemetry.flightrec import FlightRecorder
+from pipegoose_tpu.testing.chaos import (
+    ChaosMonkey,
+    ChaosSchedule,
+    Injection,
+    schedule_fingerprint,
+)
+
+
+# -- replica health unit layer (no engines) ---------------------------------
+
+
+class _StubSched:
+    def all_done(self):
+        return True
+
+    def capacity_snapshot(self):
+        return {"free_slots": 1}
+
+
+class _StubEngine:
+    run_in_progress = False
+    prefix_cache = None
+    sched = _StubSched()
+
+    def inject_fault(self, kind):
+        pass
+
+
+def test_replica_health_transitions_and_probe_backoff():
+    rep = Replica("r0", _StubEngine())
+    assert rep.state is ReplicaState.SERVING and rep.accepting
+    rep.note_no_progress()
+    rep.mark_suspect(tick=10)
+    assert rep.state is ReplicaState.SUSPECT
+    assert rep.accepting                      # probed, not quarantined
+    # probe_allowed is a pure window check — an idle fleet that never
+    # places a probe must not burn through the backoff ladder
+    assert rep.probe_allowed(10) and rep.probe_allowed(10)
+    assert rep.probe_backoff == 1
+    # the backoff advances only when a probe is PLACED: 10, +1, +2, +4
+    rep.note_probe(10)
+    assert not rep.probe_allowed(10)
+    assert rep.probe_allowed(11)
+    rep.note_probe(11)
+    assert not rep.probe_allowed(12)
+    assert rep.probe_allowed(13)
+    rep.note_probe(13)
+    assert rep.probe_backoff == 8
+    # one progressing tick recovers SERVING and resets the backoff
+    assert rep.note_progress() is True
+    assert rep.state is ReplicaState.SERVING and rep.probe_backoff == 1
+    # FAILED is quarantine; rejoin is probation
+    rep.mark_failed("tick raised")
+    assert not rep.accepting and rep.failure_reason == "tick raised"
+    with pytest.raises(ValueError, match="not serving"):
+        rep.start_drain()
+    rep.rejoin(probation_ticks=5)
+    assert rep.state is ReplicaState.SERVING
+    assert rep.probation_ticks_left == 5
+    status = rep.status()
+    assert status["state"] == "serving"
+    assert status["probation_ticks_left"] == 5
+
+
+def test_rejoin_requires_failed_state():
+    rep = Replica("r0", _StubEngine())
+    with pytest.raises(ValueError, match="not failed"):
+        rep.rejoin(probation_ticks=1)
+
+
+def test_autoscaler_failed_replicas_are_a_capacity_loss_signal():
+    """FAILED counts as capacity loss: any uncompensated failure is an
+    immediate scale-up (no burn needed), and a fleet carrying one never
+    scales down."""
+
+    class _Mon:
+        def evaluate(self, now=None):
+            return {"targets": {"ttft": {"burn_fast": 0.1}}}
+
+    asc = Autoscaler(_Mon(), AutoscalerConfig(
+        min_replicas=1, max_replicas=3, cooldown_ticks=5))
+    assert asc.decide(1, n_serving=1, backlog=0, n_failed=1) == "up"
+    assert asc.log[-1]["reason"].startswith("1 failed replica")
+    # cooldown still applies to the failure signal
+    assert asc.decide(3, n_serving=2, backlog=0, n_failed=1) is None
+    # calm burns + no backlog would scale down — but not while the
+    # fleet carries an uncompensated failure
+    assert asc.decide(20, n_serving=3, backlog=0, n_failed=0) == "down"
+    assert asc.decide(40, n_serving=2, backlog=0, n_failed=1) == "up"
+    # at max_replicas even a failure adds nothing — shedding remains
+    # the pressure valve
+    assert asc.decide(60, n_serving=3, backlog=0, n_failed=1) is None
+
+
+def test_chaos_schedule_new_kinds_seeded_byte_identical():
+    """PR 9 fingerprint convention: the same seed yields the
+    byte-identical plan for the fleet kinds, and adding the new kinds
+    never perturbed the steps of kinds drawn before them."""
+    kw = dict(replica_crash=1, replica_wedge=1, transfer_flap=2,
+              n_replicas=3, flap_times=2)
+    a = ChaosSchedule.seeded(77, max_step=40, **kw)
+    b = ChaosSchedule.seeded(77, max_step=40, **kw)
+    assert schedule_fingerprint(a) == schedule_fingerprint(b)
+    assert len(a) == 4
+    kinds = {i.kind for i in a.injections}
+    assert kinds == {"replica_crash", "replica_wedge", "transfer_flap"}
+    for inj in a.injections:
+        if inj.kind in ("replica_crash", "replica_wedge"):
+            assert 0 <= inj.kwargs["replica"] < 3
+        else:
+            assert inj.kwargs["fail_times"] == 2
+    # appending the fleet kinds must not move the legacy kinds' steps
+    legacy = ChaosSchedule.seeded(5, max_step=30, device_loss=1,
+                                  host_stall=2)
+    with_new = ChaosSchedule.seeded(5, max_step=30, device_loss=1,
+                                    host_stall=2, replica_crash=1)
+    old_steps = {(i.kind, i.step) for i in legacy.injections}
+    new_steps = {(i.kind, i.step) for i in with_new.injections
+                 if i.kind != "replica_crash"}
+    assert old_steps == new_steps
+
+
+# -- e2e fixtures -----------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    import jax
+
+    from pipegoose_tpu.models import bloom
+
+    cfg = bloom.BloomConfig(vocab_size=64, hidden_size=32, n_layer=2,
+                            n_head=2)
+    params = bloom.init_params(cfg, jax.random.PRNGKey(0))
+    return params, cfg
+
+
+def _factory(params, cfg, tracer=None, uid_stride=0):
+    def make(name, registry):
+        from pipegoose_tpu.serving import ServingEngine
+
+        eng = ServingEngine(params, cfg, num_slots=1, num_pages=33,
+                            page_size=8, max_context=96,
+                            prefix_cache=True, registry=registry,
+                            tracer=tracer)
+        if uid_stride:
+            # fleet-unique uids so ONE shared tracer can key timelines
+            # across replicas (uids are replica-local by default)
+            eng.sched._next_uid = uid_stride * int(name.replace(
+                "replica", ""))
+        return eng
+    return make
+
+
+def _requests(n=10, seed=0, vocab=64):
+    from pipegoose_tpu.serving import make_skewed_replay
+
+    replay = make_skewed_replay(
+        n_requests=n, n_prefixes=3, prefix_len=32, suffix_lens=(2, 4),
+        max_new=3, vocab=vocab, seed=seed, n_tenants=2,
+    )
+    return lambda: [Request(prompt=p, max_new_tokens=m, tenant=t)
+                    for p, m, t in replay]
+
+
+def _assert_token_identical(clean, got):
+    assert len(got) == len(clean)
+    for a, b in zip(clean, got):
+        np.testing.assert_array_equal(a.generated, b.generated)
+        assert b.finish_reason in ("length", "eos")
+
+
+# -- e2e: crash / wedge / crash-during-drain salvage ------------------------
+
+
+def test_replica_crash_salvages_token_identical(tiny, tmp_path):
+    """The acceptance pin: a replica_crash injected mid-run on a
+    2-replica fleet yields outputs token-identical to the no-crash run
+    with ZERO admitted requests lost; the replica_failure black box
+    names the replica and every salvaged uid; the chaos injection sits
+    in the same flight-recorder ring."""
+    params, cfg = tiny
+    reqs = _requests()
+    recorder = FlightRecorder(str(tmp_path), capacity=64)
+    plane = ControlPlane(_factory(params, cfg), n_replicas=2,
+                         recorder=recorder)
+    clean, _ = plane.run(reqs())
+    schedule = ChaosSchedule(
+        [Injection(4, "replica_crash", (("replica", 1),))])
+    monkey = ChaosMonkey(schedule, recorder=recorder)
+    crashed, metrics = plane.run(reqs(), tick_hook=monkey.fleet_hook)
+    _assert_token_identical(clean, crashed)
+    assert plane._m_failures.value == 1.0
+    assert plane._m_lost.value == 0.0
+    assert plane._m_salvaged.value >= 1.0  # real in-flight salvage
+    failed = plane.failed_replicas()
+    assert len(failed) == 1
+    assert "ReplicaFault" in failed[0].failure_reason
+    # /debug/fleet names the health states
+    status = plane.fleet_status()
+    json.dumps(status)
+    assert status["failed"] == 1 and status["capacity_gap"] == 1
+    states = {r["name"]: r["state"] for r in status["replicas"]}
+    assert states[failed[0].name] == "failed"
+    # black box: replica + salvaged uids + router verdict, ring shows
+    # the injection next to the detection
+    dumps = [p for p in recorder.dumps if "replica_failure" in p]
+    assert len(dumps) == 1 and os.path.exists(dumps[0])
+    with open(dumps[0]) as f:
+        box = json.load(f)
+    det = box["trigger"]["details"]
+    assert det["replica"] == failed[0].name
+    assert det["salvaged_uids"] and det["lost_uids"] == []
+    assert det["router"]["verdict"] == "quarantined"
+    kinds = [r["kind"] for r in box["records"]]
+    assert "chaos.injection" in kinds
+    # the failure was RECOVERED (nothing lost, a survivor serving):
+    # the pending trigger was consumed, so /healthz stays 200
+    assert recorder.last_trigger is None
+    assert len(monkey.applied) == 1
+
+
+def test_replica_wedge_walks_suspect_to_failed(tiny, tmp_path):
+    """The heartbeat ladder: a wedged replica (alive, no progress) goes
+    SUSPECT after suspect_after_ticks, FAILED after failed_after_ticks,
+    and its requests salvage token-identically."""
+    params, cfg = tiny
+    reqs = _requests(seed=1)
+    recorder = FlightRecorder(str(tmp_path), capacity=64)
+    plane = ControlPlane(_factory(params, cfg), n_replicas=2,
+                         recorder=recorder, suspect_after_ticks=2,
+                         failed_after_ticks=6)
+    clean, _ = plane.run(reqs())
+    schedule = ChaosSchedule(
+        [Injection(3, "replica_wedge", (("replica", 0),))])
+    monkey = ChaosMonkey(schedule, recorder=recorder)
+    seen_suspect = []
+
+    def hook(p, tick):
+        monkey.fleet_hook(p, tick)
+        seen_suspect.extend(r.name for r in p.replicas
+                            if r.state is ReplicaState.SUSPECT)
+
+    wedged, _ = plane.run(reqs(), tick_hook=hook)
+    _assert_token_identical(clean, wedged)
+    failed = plane.failed_replicas()
+    assert len(failed) == 1
+    assert "wedged" in failed[0].failure_reason
+    assert failed[0].name in seen_suspect  # walked THROUGH suspect
+    assert plane._m_lost.value == 0.0
+    assert recorder.last_trigger is None   # recovered
+
+
+def test_crash_during_drain_loses_nothing(tiny, tmp_path):
+    """The third matrix cell: a drain (planned) and a crash (unplanned)
+    in the same run — the drain's migrated requests and the crashed
+    replica's salvaged ones all land on the survivor, token-identical,
+    zero lost."""
+    params, cfg = tiny
+    reqs = _requests(seed=2)
+    recorder = FlightRecorder(str(tmp_path), capacity=64)
+    plane = ControlPlane(_factory(params, cfg), n_replicas=3,
+                         recorder=recorder)
+    clean, _ = plane.run(reqs())
+    schedule = ChaosSchedule(
+        [Injection(4, "replica_crash", (("replica", 1),))])
+    monkey = ChaosMonkey(schedule, recorder=recorder)
+
+    def hook(p, tick):
+        if tick == 3 and len(p.serving_replicas()) > 2:
+            p.start_drain(p.serving_replicas()[0].name)
+        monkey.fleet_hook(p, tick)
+
+    got, _ = plane.run(reqs(), tick_hook=hook)
+    _assert_token_identical(clean, got)
+    assert plane._m_drains.value == 1.0
+    assert plane._m_failures.value == 1.0
+    assert plane._m_lost.value == 0.0
+    assert recorder.last_trigger is None
+
+
+def test_unreachable_state_degrades_to_resubmit_from_prompt(tiny,
+                                                            tmp_path):
+    """The salvage degradation: a request whose scheduler-side harvest
+    RAISES is resubmitted from its prompt with reuse_uid — generated
+    tokens dropped and re-derived (token-identical by greedy
+    determinism), the shared tracer timeline continuing under the same
+    uid with components still summing to e2e."""
+    from pipegoose_tpu.telemetry import MetricsRegistry
+    from pipegoose_tpu.telemetry.reqtrace import RequestTracer
+
+    params, cfg = tiny
+    reqs = _requests(n=8, seed=3)
+    reg = MetricsRegistry(enabled=True)
+    tracer = RequestTracer(registry=reg, keep_completed=64)
+    recorder = FlightRecorder(str(tmp_path), capacity=64)
+    plane = ControlPlane(
+        _factory(params, cfg, tracer=tracer, uid_stride=10_000),
+        n_replicas=2, recorder=recorder,
+    )
+    clean, _ = plane.run(reqs())
+    victim = plane.replicas[1]
+    orig_preempt = victim.engine.sched.preempt
+
+    def bad_preempt(req):
+        raise RuntimeError("scheduler state unreachable")
+
+    def hook(p, tick):
+        if tick == 4:
+            victim.engine.sched.preempt = bad_preempt
+            victim.engine.inject_fault("crash")
+
+    got, _ = plane.run(reqs(), tick_hook=hook)
+    victim.engine.sched.preempt = orig_preempt
+    _assert_token_identical(clean, got)
+    assert plane._m_resubmitted.value >= 1.0
+    assert plane._m_lost.value == 0.0
+    # the black box splits the dispositions
+    box_path = [p for p in recorder.dumps if "replica_failure" in p][-1]
+    with open(box_path) as f:
+        det = json.load(f)["trigger"]["details"]
+    assert det["resubmitted_uids"]
+    # attribution survives: every completed timeline's components sum
+    # to its e2e exactly (requeue books as queue/stall, re-prefill as
+    # prefill — never a gap)
+    assert tracer.completed
+    for tl in tracer.completed:
+        total = sum(tl.components.values())
+        assert abs(total - tl.e2e_s) < 1e-6, (tl.uid, total, tl.e2e_s)
+
+
+def test_unrecovered_failure_flips_healthz(tiny, tmp_path):
+    """Both replicas dead = no survivors: the replica_failure trigger
+    stays PENDING, and /healthz reports 503 naming it."""
+    from pipegoose_tpu.telemetry.opsserver import OpsServer
+
+    params, cfg = tiny
+    reqs = _requests(n=4, seed=4)
+    recorder = FlightRecorder(str(tmp_path), capacity=64)
+    plane = ControlPlane(_factory(params, cfg), n_replicas=2,
+                         recorder=recorder, suspect_after_ticks=2,
+                         failed_after_ticks=5, stall_patience=20)
+    plane.run(reqs())                      # warm
+    schedule = ChaosSchedule([
+        Injection(3, "replica_crash", (("replica", 0),)),
+        Injection(4, "replica_crash", (("replica", 0),)),
+    ])
+    monkey = ChaosMonkey(schedule, recorder=recorder)
+    with pytest.raises(RuntimeError, match="control-plane stall"):
+        plane.run(reqs(), tick_hook=monkey.fleet_hook)
+    assert len(plane.failed_replicas()) == 2
+    assert recorder.last_trigger is not None
+    assert recorder.last_trigger.name == "replica_failure"
+    code, body = OpsServer(recorder=recorder).health()
+    assert code == 503
+    assert any(p["name"] == "replica_failure" for p in body["problems"])
+
+
+def test_rejoin_serves_again_after_probation(tiny, tmp_path):
+    """Quarantine is not forever: clearing the fault and rejoining puts
+    the replica back on probation (no fresh dispatch), then it serves
+    again — and the capacity gap closes."""
+    params, cfg = tiny
+    reqs = _requests(n=8, seed=5)
+    recorder = FlightRecorder(str(tmp_path), capacity=64)
+    plane = ControlPlane(_factory(params, cfg), n_replicas=2,
+                         recorder=recorder, probation_ticks=3)
+    clean, _ = plane.run(reqs())
+    schedule = ChaosSchedule(
+        [Injection(3, "replica_crash", (("replica", 1),))])
+    monkey = ChaosMonkey(schedule, recorder=recorder)
+    crashed, _ = plane.run(reqs(), tick_hook=monkey.fleet_hook)
+    _assert_token_identical(clean, crashed)
+    failed = plane.failed_replicas()[0]
+    assert plane._capacity_gap == 1
+    rep = plane.rejoin(failed.name)
+    assert rep is failed and rep.state is ReplicaState.SERVING
+    assert rep.probation_ticks_left == 3
+    assert plane._capacity_gap == 0
+    again, metrics = plane.run(reqs())
+    _assert_token_identical(clean, again)
+    # the rejoined replica actually served traffic post-probation
+    assert failed.name in metrics["per_replica"]
+    assert not plane.failed_replicas()
+
+
+def test_recovered_failure_preserves_an_earlier_pending_trigger(
+        tiny, tmp_path):
+    """Post-review regression: a later RECOVERED failure must not
+    consume-and-clear an EARLIER still-pending trigger (a previous
+    unrecovered failure, a decode stall) — /healthz would go green
+    while the earlier problem is still real."""
+    params, cfg = tiny
+    reqs = _requests(n=6, seed=6)
+    recorder = FlightRecorder(str(tmp_path), capacity=64)
+    plane = ControlPlane(_factory(params, cfg), n_replicas=2,
+                         recorder=recorder)
+    clean, _ = plane.run(reqs())
+    earlier = recorder.fire_trigger(
+        "decode_stall", "pre-existing unresolved problem", 1)
+    schedule = ChaosSchedule(
+        [Injection(3, "replica_crash", (("replica", 1),))])
+    monkey = ChaosMonkey(schedule, recorder=recorder)
+    crashed, _ = plane.run(reqs(), tick_hook=monkey.fleet_hook)
+    _assert_token_identical(clean, crashed)
+    assert plane._m_failures.value == 1.0   # recovered failure happened
+    assert recorder.last_trigger is earlier  # ...but the old flag stays
+
+
+def test_rejoin_refuses_a_degraded_salvage(tiny, tmp_path):
+    """Post-review regression: a replica whose salvage took the
+    resubmit-from-prompt degradation (scheduler raised mid-harvest)
+    cannot rejoin — its admission ledger is untrustworthy; scale_up is
+    the replacement path."""
+    params, cfg = tiny
+    reqs = _requests(n=6, seed=7)
+    recorder = FlightRecorder(str(tmp_path), capacity=64)
+    plane = ControlPlane(_factory(params, cfg), n_replicas=2,
+                         recorder=recorder)
+    plane.run(reqs())
+    victim = plane.replicas[1]
+
+    def bad_harvest(req):
+        raise RuntimeError("scheduler state unreachable")
+
+    orig_p = victim.engine.sched.preempt
+    orig_w = victim.engine.sched.withdraw
+
+    def hook(p, tick):
+        if tick == 4:
+            victim.engine.sched.preempt = bad_harvest
+            victim.engine.sched.withdraw = bad_harvest
+            victim.engine.inject_fault("crash")
+
+    try:
+        plane.run(reqs(), tick_hook=hook)
+    finally:
+        victim.engine.sched.preempt = orig_p
+        victim.engine.sched.withdraw = orig_w
+    assert victim.salvage_degraded
+    with pytest.raises(ValueError, match="cannot rejoin"):
+        plane.rejoin(victim.name)
+
+
+def test_fault_seam_validation(tiny):
+    params, cfg = tiny
+    from pipegoose_tpu.serving import ServingEngine
+
+    eng = ServingEngine(params, cfg, num_slots=1, num_pages=9,
+                        page_size=8, max_context=32)
+    with pytest.raises(ValueError, match="unknown fault kind"):
+        eng.inject_fault("explode")
+    eng.inject_fault("crash")
+    eng.start_run(())
+    with pytest.raises(ReplicaFault):
+        eng.tick_once()
+    eng.abort_run()
+    assert eng._fault == "crash"      # abort does NOT clear the fault
+    eng.inject_fault(None)
+    eng.start_run(())
+    assert eng.tick_once() is False   # empty scheduler, healthy
+    eng.abort_run()

@@ -420,9 +420,77 @@ def test_prefill_only_ledger_reserves_prompt_not_decode():
 def test_submit_reuse_uid_preserves_cross_scheduler_identity():
     sched = Scheduler(1, PagePool(9, 4), max_context=32)
     r = _req(4, 4)
-    r.uid = 41                                # prefill-scheduler uid
+    r.uid = 41                                # foreign-scheduler uid
     sched.submit(r, now=0.0, reuse_uid=True)
     assert r.uid == 41
     fresh = _req(4, 4)
     sched.submit(fresh, now=0.0)
-    assert fresh.uid == 0                     # default: own counter
+    # the local counter does NOT chase a reused uid: cross-scheduler
+    # uniqueness is the caller's (disagg: one prefill counter; control
+    # plane: disjoint UID_STRIDE blocks per replica) — chasing would
+    # leak this counter into another replica's block
+    assert fresh.uid == 0
+
+
+
+# -- ledger consistency after an aborted run (ISSUE 15 satellite) -----------
+
+
+def _assert_ledger_balanced(sched, pool, free0):
+    snap = sched.capacity_snapshot()
+    assert snap["outstanding_pages"] == 0, snap
+    assert snap["transfer_requests"] == 0, snap
+    assert snap["transfer_tokens_owed"] == 0, snap
+    assert snap["queued_requests"] == 0 and snap["active_requests"] == 0
+    assert pool.free_count == free0, (pool.free_count, free0)
+
+
+def test_ledger_balances_after_abort_mid_transfer_staging_decode():
+    """A decode scheduler abandoned mid-transfer-staging (the pool-
+    death path: abort_transfer on the incomplete stage, preempt +
+    withdraw the rest) ends with a balanced ledger: no stranded
+    reservations, no transfer records, every page back."""
+    pool = PagePool(17, 4)
+    sched = Scheduler(2, pool, max_context=64)
+    free0 = pool.free_count
+    live = _req(8, 8)                         # a normally admitted peer
+    sched.submit(live, now=0.0)
+    sched.admit(now=0.0)
+    staged = _req(16, 8)
+    staged.uid = 100
+    staged.status = Status.TRANSFER
+    assert sched.begin_transfer(staged, now=1.0)
+    sched.transfer_pages(staged, 8)           # 2 pages materialized
+    snap = sched.capacity_snapshot()
+    assert snap["transfer_requests"] == 1 and snap["outstanding_pages"] > 0
+    # the aborted-run teardown: transfer staging aborted, live work
+    # preempted + withdrawn (exactly what crash salvage does)
+    sched.abort_transfer(staged)
+    sched.preempt(live)
+    sched.withdraw(live)
+    _assert_ledger_balanced(sched, pool, free0)
+
+
+def test_ledger_balances_after_abort_mid_prefill_prefill_only():
+    """The prefill-only twin: a prefill pool abandoned mid-chunk (some
+    prompt pages allocated, reservation outstanding) balances after
+    preempt + withdraw — the pool-death harvest path."""
+    pool = PagePool(9, 4)
+    sched = Scheduler(2, pool, max_context=32, prefill_only=True,
+                      chunk_tokens=4)
+    free0 = pool.free_count
+    a, b = _req(12, 4), _req(8, 4)
+    sched.submit(a, now=0.0)
+    sched.submit(b, now=0.0)
+    sched.admit(now=0.0)
+    sched.ensure_pages(a, 8)                  # mid-prefill: 2 of 3 pages
+    assert sched.capacity_snapshot()["outstanding_pages"] > 0
+    for r in (a, b):
+        sched.preempt(r)
+        sched.withdraw(r)
+    _assert_ledger_balanced(sched, pool, free0)
+    # the harvested requests are re-submittable elsewhere
+    other = Scheduler(2, PagePool(9, 4), max_context=32,
+                      prefill_only=True, chunk_tokens=4)
+    other.submit(a, now=9.0, reuse_uid=True)
+    assert a.uid is not None and a.status is Status.QUEUED
