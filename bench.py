@@ -547,9 +547,15 @@ def run_bench(force_cpu: bool) -> None:
             # land in the same serving artifact every bench run
             res = serving_ab_benchmark(sparams, scfg, specs,
                                        quant_arms=True, **kw)
+            # KV memory hierarchy (ISSUE 16): an overflow replay whose
+            # working set exceeds HBM pages, through LRU-recompute vs
+            # host-tier restore vs cross-replica pull — hit rate, TTFT
+            # p99, and the recompute-token reduction land in the same
+            # artifact
             res["prefix_replay"] = prefix_replay_benchmark(
                 sparams, scfg, seed=0, include_speculative=True,
-                include_quant=True, trace=bool(reqtrace_path), **replay_kw,
+                include_quant=True, include_tiered=True,
+                trace=bool(reqtrace_path), **replay_kw,
             )
             # multi-replica control plane (ISSUE 12): the same
             # multi-tenant Zipf trace through 2 replicas at each

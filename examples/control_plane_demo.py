@@ -87,9 +87,13 @@ def main():
     # -- routing arms: the same trace, two placement policies ---------------
     forwarded = {}
     planes = {}
+    # pull_hints off: the A/B isolates ROUTING — with fleet prefix
+    # sharing on, a round-robin miss pulls the warm peer's KV pages
+    # instead of recomputing and both arms forward the same count
+    # (that arm is examples/kv_tier_demo.py's story)
     for policy in ("round_robin", "cache_aware"):
         plane = ControlPlane(factory, n_replicas=args.replicas,
-                             policy=policy)
+                             policy=policy, pull_hints=False)
         plane.run(reqs())                    # compile + seed caches
         plane.clear_prefix_caches()          # cold caches, warm programs
         outs, metrics = plane.run(reqs())

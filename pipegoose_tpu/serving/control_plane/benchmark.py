@@ -129,8 +129,13 @@ def control_plane_replay_benchmark(
     results: Dict = {}
     planes: Dict[str, ControlPlane] = {}
     for policy in ("round_robin", "cache_aware"):
+        # pull_hints OFF: this benchmark isolates ROUTING — with fleet
+        # prefix sharing on, a round-robin miss pulls the peer's pages
+        # instead of recomputing and both arms forward the same token
+        # count (the sharing win is prefix_replay_benchmark's
+        # fleet_pull arm, measured separately)
         plane = ControlPlane(factory(), n_replicas=n_replicas,
-                             policy=policy,
+                             policy=policy, pull_hints=False,
                              affinity_slack_tokens=affinity_slack_tokens)
         planes[policy] = plane
         # two warmups, same convention as prefix_replay_benchmark: the

@@ -45,6 +45,7 @@ from pipegoose_tpu.serving.control_plane.replica import (
 from pipegoose_tpu.serving.control_plane.router import Router
 from pipegoose_tpu.serving.control_plane.tenants import TenantLedger
 from pipegoose_tpu.serving.engine import RequestOutput
+from pipegoose_tpu.serving.kv_tier.directory import PrefixDirectory
 from pipegoose_tpu.serving.scheduler import Request, Status
 from pipegoose_tpu.telemetry.fleet import FleetRegistry
 from pipegoose_tpu.telemetry.registry import MetricsRegistry
@@ -101,7 +102,8 @@ class ControlPlane:
                  recorder: Optional[Any] = None,
                  suspect_after_ticks: int = 5,
                  failed_after_ticks: int = 20,
-                 probation_ticks: int = 8):
+                 probation_ticks: int = 8,
+                 pull_hints: bool = True):
         """``recorder``: optional ``telemetry.FlightRecorder`` — every
         replica failure dumps ONE ``replica_failure`` black box naming
         the replica and the salvaged/resubmitted/lost uids; an
@@ -112,7 +114,11 @@ class ControlPlane:
         SERVING->SUSPECT and ->FAILED; must satisfy suspect < failed <
         stall_patience so a single wedged replica is quarantined long
         before the whole-fleet watchdog gives up).
-        ``probation_ticks``: dispatch cooldown after :meth:`rejoin`."""
+        ``probation_ticks``: dispatch cooldown after :meth:`rejoin`.
+        ``pull_hints``: hint cross-replica KV pulls through the fleet
+        prefix directory at placement (serving/kv_tier/); off, replicas
+        recompute what their own cache misses — the routing benchmark
+        disables it to isolate placement from fleet prefix sharing."""
         if n_replicas < 1:
             raise ValueError(f"n_replicas must be >= 1, got {n_replicas}")
         if stall_patience < 1:
@@ -136,6 +142,7 @@ class ControlPlane:
             )
         self.replica_factory = replica_factory
         self.recorder = recorder
+        self.pull_hints = pull_hints
         self.suspect_after_ticks = suspect_after_ticks
         self.failed_after_ticks = failed_after_ticks
         self.probation_ticks = probation_ticks
@@ -175,6 +182,11 @@ class ControlPlane:
         self._m_salvaged = reg.counter("serving.fleet.salvaged_total")
         self._m_resubmitted = reg.counter("serving.fleet.resubmitted_total")
         self._m_lost = reg.counter("serving.fleet.lost_total")
+        # fleet prefix directory (serving/kv_tier/): which replica
+        # holds which prefix, HBM or host tier — created lazily from
+        # the first cached replica's page_size; None when the fleet
+        # runs cache-less
+        self.directory: Optional[PrefixDirectory] = None
         for _ in range(n_replicas):
             self._add_replica()
 
@@ -198,6 +210,15 @@ class ControlPlane:
         # timelines and reuse_uid salvage stay collision-free fleet-wide
         engine.sched._next_uid = max(engine.sched._next_uid,
                                      rep.index * UID_STRIDE)
+        if engine.prefix_cache is not None:
+            if self.directory is None:
+                self.directory = PrefixDirectory(engine.page_size)
+            directory = self.directory
+
+            def _publish(tokens, location, _name=name, _dir=directory):
+                _dir.publish(_name, tokens, location)
+
+            engine.on_prefix_publish = _publish
         self.replicas.append(rep)
         self.fleet.add_member(name, reg)
         if self._running:
@@ -281,6 +302,8 @@ class ControlPlane:
             rep = match[0]
         migrated = rep.start_drain()
         self.router.drop_replica(rep.name)
+        if self.directory is not None:
+            self.directory.retract_replica(rep.name)
         self._migrated.extend(migrated)
         self._m_migrated.inc(len(migrated))
         self._m_drains.inc()
@@ -297,7 +320,11 @@ class ControlPlane:
             if (rep.state is not ReplicaState.STOPPED
                     and rep.engine.prefix_cache is not None):
                 rep.engine.prefix_cache.clear()
+                if rep.engine.host_tier is not None:
+                    rep.engine.host_tier.clear()
         self.router.clear_shadows()
+        if self.directory is not None:
+            self.directory.clear()
 
     # -- ingress -----------------------------------------------------------
 
@@ -336,10 +363,35 @@ class ControlPlane:
         )
         self._reuse.discard(id(req))
         rep.inflight[id(req)] = req
+        if (self.pull_hints and self.directory is not None
+                and rep.engine.kv_tier is not None):
+            # fleet prefix sharing: when a PEER holds a longer prefix
+            # than this replica could have, hint the pull — the
+            # engine's pre-admission intercept verifies the peer's
+            # actual inventory (the directory may be stale; a stale
+            # hint costs one read-only probe, never correctness)
+            m, holder, _loc = self.directory.longest_holder(
+                req.tokens, exclude=rep.name
+            )
+            if holder is not None and m > 0:
+                peer = self._peer_engine(holder)
+                if peer is not None and peer is not rep.engine:
+                    rep.engine.kv_tier.hint_pull(req, peer)
         if rep.state is ReplicaState.SUSPECT:
             rep.note_probe(tick)
             return [c for c in cands if c is not rep]
         return cands
+
+    def _peer_engine(self, name: str):
+        """Live engine for a directory-named replica (pull source).
+        FAILED/STOPPED replicas never serve pulls — their pages are
+        gone or untrustworthy."""
+        for rep in self.replicas:
+            if rep.name == name and rep.state in (ReplicaState.SERVING,
+                                                  ReplicaState.SUSPECT,
+                                                  ReplicaState.DRAINING):
+                return rep.engine
+        return None
 
     def _dispatch(self, now: float, tick: int) -> int:
         """Place migrated/salvaged requests first, then one DRR batch
@@ -502,6 +554,8 @@ class ControlPlane:
         flips only on an UNRECOVERED failure."""
         rep.mark_failed(reason)
         self.router.drop_replica(rep.name)
+        if self.directory is not None:
+            self.directory.retract_replica(rep.name)
         self._m_failures.inc()
         self._capacity_gap += 1
         try:
@@ -739,6 +793,8 @@ class ControlPlane:
             "router": self.router.stats(),
             "tenants": self.ledger.stats(),
         }
+        if self.directory is not None:
+            metrics["kv_directory"] = self.directory.stats()
         if self.autoscaler is not None:
             metrics["autoscaler"] = list(self.autoscaler.log)
         return outputs, metrics
@@ -755,6 +811,8 @@ class ControlPlane:
             "failed": len(self.failed_replicas()),
             "capacity_gap": self._capacity_gap,
             "router": self.router.stats(),
+            "kv_directory": (self.directory.stats()
+                             if self.directory is not None else None),
             "tenants": self.ledger.stats(),
             "migrated_pending": len(self._migrated),
             "autoscaler": (list(self.autoscaler.log)

@@ -56,13 +56,16 @@ class ShadowIndex:
     and O(1) — a per-chain LRU would cost more than the misroutes it
     prevents at this size)."""
 
-    __slots__ = ("page_size", "max_blocks", "_root", "_blocks")
+    __slots__ = ("page_size", "max_blocks", "_root", "_blocks",
+                 "resets_total", "on_reset")
 
     def __init__(self, page_size: int, max_blocks: int = 4096):
         self.page_size = int(page_size)
         self.max_blocks = int(max_blocks)
         self._root: Dict[tuple, dict] = {}
         self._blocks = 0
+        self.resets_total = 0        # cap-triggered resets only
+        self.on_reset = None         # callback(shadow) at each cap reset
 
     def insert(self, tokens) -> None:
         ps = self.page_size
@@ -74,6 +77,9 @@ class ShadowIndex:
             if node is None:
                 if self._blocks >= self.max_blocks:
                     self.clear()
+                    self.resets_total += 1
+                    if self.on_reset is not None:
+                        self.on_reset(self)
                     return
                 node = {}
                 children[blk] = node
@@ -134,6 +140,11 @@ class Router:
         self._m_unplaceable = reg.counter(
             "router.unplaceable_total",
             help="route() calls where no replica could admit",
+        )
+        self._m_shadow_resets = reg.counter(
+            "router.shadow_resets_total",
+            help="shadow-index cap resets (graceful degradation: the "
+                 "shadow rebuilds from subsequent placements)",
         )
 
     def route(self, req: Any, replicas: List[Replica],
@@ -229,6 +240,7 @@ class Router:
         shadow = self._shadows.get(chosen.name)
         if shadow is None:
             shadow = ShadowIndex(chosen.engine.page_size)
+            shadow.on_reset = lambda _s: self._m_shadow_resets.inc()
             self._shadows[chosen.name] = shadow
         shadow.insert(tokens)
         return matched, chosen
@@ -292,5 +304,6 @@ class Router:
             "cache_routed_total": self._m_cache_routed.value,
             "matched_tokens_total": self._m_matched.value,
             "unplaceable_total": self._m_unplaceable.value,
+            "shadow_resets_total": self._m_shadow_resets.value,
             "recent_decisions": list(self.decisions)[-16:],
         }

@@ -219,7 +219,8 @@ class PoolTransfer:
     and meshes may differ (that difference IS the feature)."""
 
     def __init__(self, src_engine, dst_engine, *,
-                 wire_dtype: Optional[str] = None):
+                 wire_dtype: Optional[str] = None,
+                 width: Optional[int] = None):
         scfg, dcfg = src_engine.config, dst_engine.config
         for attr in ("n_layer", "n_head", "head_dim"):
             if getattr(scfg, attr) != getattr(dcfg, attr):
@@ -244,16 +245,21 @@ class PoolTransfer:
                 "int8 pools define their own wire format (q + scale "
                 "planes); wire_dtype applies to fp pools only"
             )
-        if src_engine.prefill_chunk is None:
+        if width is None and src_engine.prefill_chunk is None:
             raise ValueError(
                 "the source engine needs prefill_chunk: the chunk is "
-                "the streaming boundary that fixes the transfer width"
+                "the streaming boundary that fixes the transfer width "
+                "(or pass width= explicitly — the kv_tier spill/restore "
+                "path does, its shipments are page-granular)"
             )
+        if width is not None and width < 1:
+            raise ValueError(f"width must be >= 1 pages, got {width}")
         self.src = src_engine
         self.dst = dst_engine
         self.wire_dtype = wire_dtype
         self.page_size = src_engine.page_size
-        self.width = max(1, src_engine.prefill_chunk // self.page_size)
+        self.width = (int(width) if width is not None
+                      else max(1, src_engine.prefill_chunk // self.page_size))
 
         def _exp(kp, vp, ids):
             return (export_page_slab(kp, ids, wire_dtype),

@@ -90,6 +90,10 @@ from pipegoose_tpu.serving.kv_pool import (
     paged_prefill_chunk,
     write_prompt_pages,
 )
+from pipegoose_tpu.serving.kv_tier.restore import (
+    RestoreManager,
+    RestorePlanner,
+)
 from pipegoose_tpu.serving.prefix_cache import PrefixCache
 from pipegoose_tpu.serving.scheduler import Request, Scheduler, Status
 from pipegoose_tpu.telemetry.registry import Histogram, get_registry
@@ -192,7 +196,10 @@ class ServingEngine:
                  kv_dtype: Optional[str] = None,
                  weight_group_size: int = 32,
                  prefill_only: bool = False,
-                 sentinel=None):
+                 sentinel=None,
+                 host_tier=None,
+                 host_tier_wire: Optional[str] = None,
+                 cost_model=None):
         """``recorder``: optional ``telemetry.FlightRecorder`` — every
         decode step lands in its ring, and the no-decode-progress
         watchdog dumps a black box through it before raising.
@@ -224,7 +231,18 @@ class ServingEngine:
         completed prefill HANDS OFF — first token + exported KV pages —
         through the handoff hook (:meth:`set_handoff_hook`) instead of
         entering decode. Requires ``prefill_chunk`` (the chunk is the
-        streaming boundary) and a hook before the first run."""
+        streaming boundary) and a hook before the first run.
+
+        ``host_tier``: optional ``serving.kv_tier.HostTier`` — evicted
+        refcount-1 prefix chains spill into host DRAM at wire precision
+        and later lookup misses restore the pages instead of
+        recomputing them (requires ``prefix_cache=True``).
+        ``host_tier_wire`` ("bf16"): narrow FP pools on the wire;
+        forbidden for int8 pools (their q+scale planes ARE the wire
+        format). ``cost_model``: optional calibrated
+        ``planner.cost.CostModel`` — its fitted launch/bandwidth/
+        overhead constants decide restore-vs-recompute per prefix
+        length; default None always restores."""
         if max_context % page_size:
             raise ValueError("max_context must be a multiple of page_size")
         if prefill_only and prefill_chunk is None:
@@ -348,6 +366,22 @@ class ServingEngine:
         self.pool = PagePool(num_pages, page_size)
         self._run_prefill_tokens = self._run_hit_tokens = 0  # set per run()
         self.prefix_cache = PrefixCache(self.pool) if prefix_cache else None
+        # KV memory hierarchy (serving/kv_tier/): optional host-DRAM
+        # spill target behind the prefix cache. ``host_tier_wire``
+        # narrows FP pools on the wire (int8 pools already spill
+        # wire-exact q+scale planes and forbid a wire dtype).
+        if host_tier is not None and self.prefix_cache is None:
+            raise ValueError("host_tier requires prefix_cache=True "
+                             "(the tier backs the cache's evictions)")
+        if host_tier_wire is not None:
+            if host_tier is None:
+                raise ValueError("host_tier_wire requires a host_tier")
+            if self.kv_dtype == "int8":
+                raise ValueError(
+                    "host_tier_wire is for fp pools; int8 pages already "
+                    "spill wire-exact (q+scale planes verbatim)")
+        self.host_tier = host_tier
+        self.host_tier_wire = host_tier_wire
         self.prefill_only = prefill_only
         # disagg handoff seam: hook(engine, req, first_token, t) runs at
         # prefill completion BEFORE the scheduler releases the pages, so
@@ -363,6 +397,23 @@ class ServingEngine:
         # shared pages) and by chunking; the legacy monolithic
         # forward_cached + write_prompt_pages path stays the default
         self._paged_prefill = prefix_cache or prefill_chunk is not None
+        # fleet-directory publication seam: the control plane installs
+        # hook(tokens, location) per replica; None costs one branch
+        self.on_prefix_publish = None
+        # every cached engine gets a RestoreManager (cheap — nothing
+        # compiles until the first spill/pull), so it can serve as a
+        # pull PEER even without a host tier of its own
+        self.kv_tier = (RestoreManager(self)
+                        if self.prefix_cache is not None else None)
+        if self.kv_tier is not None and cost_model is not None:
+            n_params = sum(int(x.size)
+                           for x in jax.tree_util.tree_leaves(params))
+            self.kv_tier.planner = RestorePlanner(
+                cost_model, n_params=n_params)
+        if self.host_tier is not None:
+            if self.host_tier._m_bytes is None:
+                self.host_tier.bind_registry(self.registry)
+            self.prefix_cache.spill_hook = self.kv_tier.spill
         self.k_pages, self.v_pages = init_pages(
             config, num_pages, page_size, kv_dtype=self.kv_dtype
         )
@@ -674,6 +725,14 @@ class ServingEngine:
                 "page_capacity_ratio": round(fp_total / max(kv_total, 1), 4),
             },
         }
+        if self.host_tier is not None:
+            # exact slab census: pages x wire bytes (q+scale for int8
+            # pools — never fp-sized), the ISSUE's pinned invariant
+            report["host_tier"] = {
+                "resident_pages": self.host_tier.resident_pages,
+                "resident_bytes": self.host_tier.resident_bytes,
+                "budget_bytes": self.host_tier.byte_budget,
+            }
         reg = registry if registry is not None else self.registry
         reg.gauge(
             "serving.hbm.weights_bytes",
@@ -713,6 +772,15 @@ class ServingEngine:
         PrefillWorker is the production hook)."""
         self._handoff_hook = hook
 
+    def set_peer_source(self, peer) -> None:
+        """Default cross-replica pull source: every queued request
+        probes ``peer``'s prefix inventory before admission (bench /
+        two-engine tests; the control plane hints per request through
+        the fleet directory instead). Requires a prefix cache."""
+        if self.kv_tier is None:
+            raise RuntimeError("set_peer_source requires prefix_cache=True")
+        self.kv_tier.set_peer_source(peer)
+
     def admit_transferred(self, req: Request, first_token: int) -> bool:
         """Disagg decode-pool admission: bind a fully materialized
         transfer (every page imported at wire precision) to a free
@@ -743,6 +811,11 @@ class ServingEngine:
                     stage["pages"][:n_full],
                 )
                 self._m_cached.set(self.prefix_cache.cached_pages)
+                if self.on_prefix_publish is not None and n_full:
+                    self.on_prefix_publish(
+                        np.asarray(req.prompt)[:n_full * self.page_size],
+                        "hbm",
+                    )
         if not self.sched.admit_with_pages(req, first_token, rs.now()):
             return False
         self._m_requests.inc()
@@ -878,6 +951,10 @@ class ServingEngine:
                 req.pages[:n_full],
             )
             self._m_cached.set(self.prefix_cache.cached_pages)
+            if self.on_prefix_publish is not None and n_full:
+                self.on_prefix_publish(
+                    np.asarray(req.prompt)[:n_full * self.page_size], "hbm",
+                )
         self._m_prefills.inc()
         if self._handoff_hook is not None:
             # disagg prefill pool: the first token exists NOW — hand it
@@ -1104,6 +1181,8 @@ class ServingEngine:
             )
         self._run_prefill_tokens = 0   # prompt tokens forwarded this run
         self._run_hit_tokens = 0       # prompt tokens served by the cache
+        if self.kv_tier is not None:
+            self.kv_tier.on_run_start()
         if self.tracer is not None:
             # one time domain: tracer-internal timestamps (e.g. preempt
             # hooks) must come from the same clock as t_submit/t_done
@@ -1157,6 +1236,12 @@ class ServingEngine:
         rs.tick += 1
         if rs.tick_hook is not None:
             rs.tick_hook(self, rs.tick)
+        if self.kv_tier is not None:
+            # KV-tier pre-admission intercept: give the queue head one
+            # shot at a cross-replica pull and/or a host-tier restore,
+            # so the admission below sees the pages as ordinary cache
+            # hits (restore) or resumes chunked prefill (pull)
+            self.kv_tier.tick_intercept(now)
         admitted = self.sched.admit(now())
         shed_now = self.sched.drain_shed()
         if shed_now:
@@ -1433,6 +1518,12 @@ class ServingEngine:
                 "cached_pages": self.prefix_cache.cached_pages,
                 "shared_pages_now": self.pool.shared_count,
             }
+        if self.kv_tier is not None and (
+                self.host_tier is not None
+                or self.kv_tier.pulls or self.kv_tier.fallbacks):
+            metrics["kv_tier"] = dict(self.kv_tier.run_stats())
+            if self.host_tier is not None:
+                metrics["kv_tier"]["host"] = self.host_tier.stats()
         if self.speculative is not None:
             metrics["speculative"] = {
                 "draft_tokens": rs.spec_drafted,
@@ -1607,7 +1698,10 @@ def make_skewed_replay(*, n_requests: int, n_prefixes: int, prefix_len: int,
                        suffix_lens: Sequence[int], max_new: int,
                        vocab: int, seed: int = 0, zipf_a: float = 1.2,
                        n_tenants: Optional[int] = None,
-                       tenant_zipf_a: float = 1.2):
+                       tenant_zipf_a: float = 1.2,
+                       working_set_factor: Optional[float] = None,
+                       num_pages: Optional[int] = None,
+                       page_size: Optional[int] = None):
     """Synthetic heavy-traffic replay with SKEWED prompt reuse: each
     request's prompt is one of ``n_prefixes`` shared prefixes (drawn
     Zipf-style — rank r with weight 1/r^a, the few-hot-system-prompts
@@ -1621,7 +1715,28 @@ def make_skewed_replay(*, n_requests: int, n_prefixes: int, prefix_len: int,
     (``tenant_zipf_a``), the one-hot-customer shape the control plane's
     fairness ledger exists for, and the rows become (prompt, max_new,
     tenant) TRIPLES. Default None keeps the legacy pair shape, so
-    every existing caller unpacks unchanged."""
+    every existing caller unpacks unchanged.
+
+    ``working_set_factor``: size the distinct-prefix corpus RELATIVE to
+    a pool's HBM capacity instead of passing ``n_prefixes`` absolutely
+    — factor 2.0 against (``num_pages``, ``page_size``) makes the
+    prefix working set twice what the pool can hold, the guaranteed-
+    overflow replay the KV-tier bench needs (every factor > 1 forces
+    LRU eviction; the tier turns those evictions into restores instead
+    of recomputes). Requires ``num_pages`` and ``page_size``;
+    overrides ``n_prefixes``."""
+    if working_set_factor is not None:
+        if num_pages is None or page_size is None:
+            raise ValueError(
+                "working_set_factor needs num_pages and page_size — it "
+                "sizes the prefix corpus against the pool's capacity")
+        if working_set_factor <= 0:
+            raise ValueError(
+                f"working_set_factor must be > 0, got {working_set_factor}")
+        # pool capacity is num_pages - 1 (the scheduler's slack page)
+        cap_tokens = (num_pages - 1) * page_size
+        n_prefixes = max(1, -(-int(working_set_factor * cap_tokens)
+                              // max(prefix_len, 1)))
     rng = np.random.RandomState(seed)
     prefixes = [rng.randint(1, vocab, (prefix_len,)) for _ in range(n_prefixes)]
     weights = np.array([1.0 / (r + 1) ** zipf_a for r in range(n_prefixes)])
@@ -1653,7 +1768,9 @@ def prefix_replay_benchmark(params, config, *, n_requests=12, n_prefixes=3,
                             page_size=8, max_context=64, prefill_chunk=None,
                             mesh=None, param_specs=None, tp_axis="tensor",
                             include_speculative=False, speculative=(1, 3),
-                            trace=False, include_quant=False):
+                            trace=False, include_quant=False,
+                            include_tiered=False, tiered_working_set=2.0,
+                            tiered_budget_bytes=1 << 30):
     """Measure the tentpole: the same skewed-prompt-reuse replay through
     (a) the PR 1 baseline engine (monolithic prefill, no sharing),
     (b) chunked prefill alone, (c) the prefix cache alone, (d) both, and
@@ -1680,7 +1797,20 @@ def prefix_replay_benchmark(params, config, *, n_requests=12, n_prefixes=3,
     item 4's quantization knobs — each carrying its HBM bytes and
     page-capacity ratio next to the usual tokens/s and TTFT columns,
     and a ``summary.quant`` block pinning them against the fp
-    cached+chunked arm of the same run."""
+    cached+chunked arm of the same run.
+
+    ``include_tiered=True`` adds the KV-memory-hierarchy block: a
+    SECOND replay whose prefix working set is ``tiered_working_set``
+    times the pool's HBM capacity (guaranteed eviction pressure) run
+    through (a) ``lru`` — the plain cached+chunked engine, every
+    eviction recomputes; (b) ``host_tier`` — the same engine with a
+    host-DRAM tier, evictions spill and later misses restore; and
+    (c) ``fleet_pull`` — a COLD replica pulling the prefixes a warm
+    peer already holds through the cross-replica transfer path. Each
+    arm reports tokens/s, TTFT p50/p99, hit rate, and the
+    restored-vs-recomputed token split; ``tiered.summary`` pins the
+    tier's hit-rate and TTFT-p99 wins over the LRU arm (the
+    acceptance meters)."""
     vocab = getattr(config, "valid_vocab_size", None) or config.vocab_size
     replay = make_skewed_replay(
         n_requests=n_requests, n_prefixes=n_prefixes, prefix_len=prefix_len,
@@ -1836,4 +1966,74 @@ def prefix_replay_benchmark(params, config, *, n_requests=12, n_prefixes=3,
                 ),
             },
         }
+    if include_tiered:
+        from pipegoose_tpu.serving.kv_tier import HostTier
+
+        overflow = make_skewed_replay(
+            n_requests=n_requests, n_prefixes=n_prefixes,
+            prefix_len=prefix_len, suffix_lens=suffix_lens,
+            max_new=max_new, vocab=vocab, seed=seed + 1, zipf_a=zipf_a,
+            working_set_factor=tiered_working_set, num_pages=num_pages,
+            page_size=page_size,
+        )
+
+        def overflow_requests():
+            return [Request(prompt=p, max_new_tokens=n) for p, n in overflow]
+
+        def tier_engine(**kw):
+            return ServingEngine(
+                params, config, num_slots=num_slots, num_pages=num_pages,
+                page_size=page_size, max_context=max_context, mesh=mesh,
+                param_specs=param_specs, tp_axis=tp_axis,
+                prefill_chunk=chunk, prefix_cache=True, **kw,
+            )
+
+        def tier_row(engine, warmups=2):
+            for _ in range(warmups):
+                engine.run(overflow_requests())
+            outs, m = engine.run(overflow_requests())
+            h = Histogram("replay.tiered.ttft_seconds")  # standalone
+            for o in outs:
+                if o.ttft_s is not None:
+                    h.observe(o.ttft_s)
+            row = {
+                "decode_tokens_per_s": m["decode_tokens_per_s"],
+                "ttft_p50_s": round(h.quantile(0.5), 6),
+                "ttft_p99_s": round(h.quantile(0.99), 6),
+                "wall_time_s": m["wall_time_s"],
+                "hit_rate": m["prefix_cache"]["hit_rate"],
+                # the restore-vs-recompute split: prefill_tokens is the
+                # FLOP meter (what WAS recomputed), restored/pulled are
+                # tier/wire tokens that were not
+                "recomputed_tokens": m["prefill_tokens"],
+                "restored_tokens": m.get("kv_tier", {}).get(
+                    "restored_tokens", 0),
+                "pulled_tokens": m.get("kv_tier", {}).get(
+                    "pulled_tokens", 0),
+            }
+            return row, engine
+
+        tiered = {}
+        tiered["lru"], _ = tier_row(tier_engine())
+        tiered["host_tier"], warm_engine = tier_row(
+            tier_engine(host_tier=HostTier(tiered_budget_bytes)))
+        # fleet arm: a COLD replica (fresh cache, no tier) pulls from
+        # the warm host_tier engine above — one warm run compiles the
+        # puller; pulls keep happening in the measured run because the
+        # overflow working set evicts between runs
+        puller = tier_engine()
+        puller.set_peer_source(warm_engine)
+        tiered["fleet_pull"], _ = tier_row(puller, warmups=1)
+        lru, ht = tiered["lru"], tiered["host_tier"]
+        tiered["summary"] = {
+            "working_set_factor": tiered_working_set,
+            "hit_rate_lru": lru["hit_rate"],
+            "hit_rate_tiered": ht["hit_rate"],
+            "ttft_p99_speedup_vs_lru": round(
+                lru["ttft_p99_s"] / max(ht["ttft_p99_s"], 1e-9), 3),
+            "recompute_token_reduction": round(
+                1.0 - ht["recomputed_tokens"]
+                / max(lru["recomputed_tokens"], 1), 4),
+        }
+        results["tiered"] = tiered
     return results

@@ -1,0 +1,34 @@
+"""Fleet-wide KV memory hierarchy (ROADMAP open item 5).
+
+Two layers below the radix prefix cache's HBM pages:
+
+- :mod:`host_tier` — a byte-budgeted host-DRAM LRU of page slabs at
+  WIRE precision. Eviction from the HBM prefix cache SPILLS the cold
+  page here instead of discarding its KV; a later lookup miss that
+  hits the tier RESTORES the page (one jitted scatter) instead of
+  re-prefilling the prefix.
+- :mod:`directory` — the fleet-wide prefix directory: which replica
+  holds which prefix, in HBM or host tier. A replica routed a request
+  whose prefix a peer already computed PULLS the pages cross-replica
+  through the ``PoolTransfer`` export/import path instead of
+  re-prefilling.
+
+:mod:`restore` holds the decision logic (calibrated restore-vs-
+recompute cost) and the engine-side orchestration of both paths.
+"""
+from pipegoose_tpu.serving.kv_tier.directory import PrefixDirectory
+from pipegoose_tpu.serving.kv_tier.host_tier import (
+    HostTier,
+    HostTierError,
+    set_host_tier_fault,
+)
+from pipegoose_tpu.serving.kv_tier.restore import RestoreManager, RestorePlanner
+
+__all__ = [
+    "HostTier",
+    "HostTierError",
+    "PrefixDirectory",
+    "RestoreManager",
+    "RestorePlanner",
+    "set_host_tier_fault",
+]

@@ -1,0 +1,158 @@
+"""Fleet-wide prefix directory: which replica holds which prefix.
+
+The router's ``ShadowIndex`` (control_plane/router.py) answers "where
+would this prefix be WARM?" from placement history alone — it never
+knows whether the pages still exist. The directory is the promoted
+form: replicas PUBLISH page-aligned prefixes as they materialize them
+(prefill completion and tier restores publish ``"hbm"``, host-tier
+spills re-publish as ``"host"``), so the control plane can route a
+request to a replica that can PULL the prefix pages cross-replica
+through the ``PoolTransfer`` export/import path instead of
+re-prefilling.
+
+Consistency model — DELIBERATELY weak, and documented as such
+(docs/serving.md): publications are advisory hints, never leases.
+
+- **Staleness**: an eviction that does not spill leaves a dangling
+  ``"hbm"`` claim; a tier LRU drop leaves a dangling ``"host"`` one.
+  Retraction happens only at replica granularity (drain / failure —
+  the same moments the router drops its shadow). The PULL is therefore
+  fallible by design: the peer re-walks its own cache + tier at
+  export time and ships only what it still holds; a shortfall
+  restores less (or nothing) and the puller recomputes the rest —
+  correctness never depends on the directory being right.
+- **Bounded**: like the ShadowIndex, the trie resets wholesale at
+  ``max_blocks`` (graceful degradation to "no hints", counted in
+  ``resets_total`` — never an error).
+
+Block-granular radix trie over page-aligned token blocks; each node
+carries ``{replica: location}`` holders. Host-side orchestration state
+only — no device arrays live here.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+LOCATIONS = ("hbm", "host")
+
+
+class _Node:
+    __slots__ = ("children", "holders")
+
+    def __init__(self):
+        self.children: Dict[Tuple[int, ...], "_Node"] = {}
+        self.holders: Dict[str, str] = {}
+
+
+class PrefixDirectory:
+    """Prefix -> holding replicas, at page granularity."""
+
+    __slots__ = ("page_size", "max_blocks", "_root", "_blocks",
+                 "resets_total", "publishes_total")
+
+    def __init__(self, page_size: int, max_blocks: int = 100_000):
+        if page_size < 1:
+            raise ValueError(f"page_size must be positive, got {page_size}")
+        if max_blocks < 1:
+            raise ValueError(f"max_blocks must be positive, got {max_blocks}")
+        self.page_size = page_size
+        self.max_blocks = max_blocks
+        self._root = _Node()
+        self._blocks = 0
+        self.resets_total = 0
+        self.publishes_total = 0
+
+    def clear(self) -> None:
+        self._root = _Node()
+        self._blocks = 0
+
+    def _reset_on_cap(self) -> bool:
+        if self._blocks >= self.max_blocks:
+            self.clear()
+            self.resets_total += 1
+            return True
+        return False
+
+    def publish(self, replica: str, tokens, location: str) -> int:
+        """Record that ``replica`` holds the page-aligned prefix of
+        ``tokens`` at ``location`` ("hbm" or "host"). A deeper claim
+        refreshes every ancestor block too (holding block i implies
+        holding 0..i — that is what a chain is). Returns the number of
+        blocks recorded (0 when under one page, or right after a cap
+        reset)."""
+        if location not in LOCATIONS:
+            raise ValueError(
+                f"location must be one of {LOCATIONS}, got {location!r}"
+            )
+        toks = np.asarray(tokens).reshape(-1)
+        n_blocks = len(toks) // self.page_size
+        if n_blocks == 0:
+            return 0
+        if self._reset_on_cap():
+            return 0
+        self.publishes_total += 1
+        node = self._root
+        for i in range(n_blocks):
+            block = tuple(
+                int(t) for t in
+                toks[i * self.page_size:(i + 1) * self.page_size]
+            )
+            child = node.children.get(block)
+            if child is None:
+                child = _Node()
+                node.children[block] = child
+                self._blocks += 1
+            child.holders[replica] = location
+            node = child
+        return n_blocks
+
+    def retract_replica(self, name: str) -> None:
+        """Drop every claim ``name`` holds (drain / failure — mirrors
+        ``Router.drop_replica``). Empty nodes stay until the cap reset
+        reclaims them (bounded by ``max_blocks`` either way)."""
+        stack = [self._root]
+        while stack:
+            node = stack.pop()
+            node.holders.pop(name, None)
+            stack.extend(node.children.values())
+
+    def longest_holder(self, tokens, exclude: Optional[str] = None
+                       ) -> Tuple[int, Optional[str], Optional[str]]:
+        """Deepest page-aligned prefix of ``tokens`` some replica other
+        than ``exclude`` claims to hold. Returns ``(match_tokens,
+        replica, location)`` — ``(0, None, None)`` on no claim.
+        Deterministic tie-break at the deepest node: "hbm" claims beat
+        "host" (an HBM export skips the tier fetch), then replica name
+        order."""
+        toks = np.asarray(tokens).reshape(-1)
+        node = self._root
+        best: Tuple[int, Optional[str], Optional[str]] = (0, None, None)
+        depth = 0
+        for i in range(len(toks) // self.page_size):
+            block = tuple(
+                int(t) for t in
+                toks[i * self.page_size:(i + 1) * self.page_size]
+            )
+            node = node.children.get(block)
+            if node is None:
+                break
+            depth += 1
+            cands = sorted(
+                ((loc != "hbm", name) for name, loc in node.holders.items()
+                 if name != exclude),
+            )
+            if cands:
+                host_pref, name = cands[0]
+                best = (depth * self.page_size, name,
+                        "host" if host_pref else "hbm")
+        return best
+
+    def stats(self) -> dict:
+        return {
+            "blocks": self._blocks,
+            "max_blocks": self.max_blocks,
+            "resets_total": self.resets_total,
+            "publishes_total": self.publishes_total,
+        }

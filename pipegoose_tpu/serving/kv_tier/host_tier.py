@@ -1,0 +1,213 @@
+"""Host-DRAM KV tier: byte-budgeted LRU of spilled prefix pages.
+
+The tier sits UNDER the radix prefix cache (serving/prefix_cache.py):
+when the cache's LRU eviction reclaims a cold refcount-1 leaf, the
+engine's spill hook exports that page's KV through the same jitted
+gather the disagg transfer uses (kv_pool.export_page_slab) and parks
+the host slab here; a later lookup miss whose prefix the tier still
+holds restores the page with one jitted scatter instead of re-running
+the prefill that computed it.
+
+Storage is at WIRE precision — the slab format of serving/disagg/
+transfer.py IS the storage format:
+
+- an int8 pool's ``{"q", "scale"}`` planes are stored verbatim
+  (~``hd/(hd+4)``x denser than fp — the quantized pool's density
+  carries straight into host DRAM, and pages are never dequantized in
+  the hierarchy, so spill -> restore is byte-identical);
+- an fp pool stores its pool dtype by default (exact round-trip), or
+  bf16 when the engine opts into ``host_tier_wire="bf16"`` (the
+  distributed/compressed.py convention — exact for bf16 pools, lossy
+  for fp32 ones, so the token-identity pins run on the default).
+
+Keys are the page's full token chain — ``tuple(tokens[: (i+1) * ps])``
+for block ``i`` — exactly the radix-trie path that produced the page,
+so a tier entry is valid for ANY request sharing that prefix (KV pages
+are deterministic in the token values alone; see kv_pool.quantize_kv).
+One entry per page keeps spill/restore page-granular: a chain restores
+front-to-back and the first gap stops the walk.
+
+``set_host_tier_fault`` is the failure seam (the ``set_transfer_fault``
+convention): a hook raising :class:`HostTierError` fails that spill or
+restore, and the engine's contract is to DEGRADE — a failed spill just
+loses the tier copy, a failed restore falls back to recompute — never
+to stall or lose the request (testing/chaos.py's ``host_tier_io_error``
+exercises exactly this).
+
+Host-side by design (jit-safety allowlisted): slabs are numpy, the
+LRU is an OrderedDict; the only device programs are the engine's
+jitted export/import pair.
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Any, Callable, Optional, Tuple
+
+from pipegoose_tpu.serving.disagg.transfer import slab_nbytes
+
+
+class HostTierError(RuntimeError):
+    """A host-tier spill or restore failed (allocation failure, copy
+    fault, test injection). The engine's contract: degrade — drop the
+    spill, or recompute instead of restoring — never stall."""
+
+
+_fault_hook: Optional[Callable[..., None]] = None
+
+
+def set_host_tier_fault(hook: Optional[Callable[..., None]]):
+    """Install a fault-injection hook ``hook(op, key, n_pages)`` called
+    before every spill (``op="spill"``) and restore (``op="restore"``);
+    raise :class:`HostTierError` from it to fail that operation.
+    Returns the previous hook (restore it — the chaos-harness
+    convention shared with ``set_transfer_fault``)."""
+    global _fault_hook
+    prev = _fault_hook
+    _fault_hook = hook
+    return prev
+
+
+class HostTier:
+    """Byte-budgeted LRU over host-resident page slabs.
+
+    ``byte_budget`` bounds ``resident_bytes`` (exact ``slab_nbytes``
+    census — values + scale planes at their wire dtypes, the int8
+    density claim as arithmetic, not a comment); inserting past the
+    budget evicts least-recently-used entries first. An entry larger
+    than the whole budget is refused rather than thrashing the tier
+    empty. ``get`` refreshes recency; ``contains`` does not (admission
+    probes and directory audits must not perturb the LRU order).
+
+    Counters follow the registry convention when one is bound
+    (``serving.kv_tier.{hit,miss,restore,spill}_total`` +
+    ``serving.kv_tier.bytes`` gauge); plain-int ``stats()`` works
+    registry-free."""
+
+    def __init__(self, byte_budget: int, *, registry=None):
+        if byte_budget < 1:
+            raise ValueError(
+                f"byte_budget must be positive, got {byte_budget}"
+            )
+        self.byte_budget = int(byte_budget)
+        # key (token-chain tuple) -> (k_slab, v_slab, nbytes)
+        self._entries: "OrderedDict[Tuple[int, ...], Tuple[Any, Any, int]]" \
+            = OrderedDict()
+        self.resident_bytes = 0
+        self.hits = 0          # probe found >= 1 restorable block
+        self.misses = 0        # probe found none
+        self.spills = 0        # pages captured
+        self.restores = 0      # pages restored back to HBM
+        self.spill_drops = 0   # spills refused (over-budget entry / fault)
+        self._m_hit = self._m_miss = None
+        self._m_restore = self._m_spill = self._m_bytes = None
+        if registry is not None:
+            self.bind_registry(registry)
+
+    def bind_registry(self, registry) -> None:
+        """Resolve the metric handles once (the engine-init convention)."""
+        self._m_hit = registry.counter(
+            "serving.kv_tier.hit_total",
+            "restore probes that found >= 1 tiered block")
+        self._m_miss = registry.counter(
+            "serving.kv_tier.miss_total",
+            "restore probes that found nothing tiered")
+        self._m_restore = registry.counter(
+            "serving.kv_tier.restore_total",
+            "pages restored from the host tier to HBM")
+        self._m_spill = registry.counter(
+            "serving.kv_tier.spill_total",
+            "pages spilled from HBM eviction into the host tier")
+        self._m_bytes = registry.gauge(
+            "serving.kv_tier.bytes",
+            "host-resident tier bytes at wire precision")
+        self._m_bytes.set(self.resident_bytes)
+
+    # -- census ------------------------------------------------------------
+
+    @property
+    def resident_pages(self) -> int:
+        return len(self._entries)
+
+    def contains(self, key: Tuple[int, ...]) -> bool:
+        """Probe without touching LRU order or counters."""
+        return key in self._entries
+
+    def entry_bytes(self, key: Tuple[int, ...]) -> int:
+        ent = self._entries.get(key)
+        return ent[2] if ent is not None else 0
+
+    # -- spill / restore ---------------------------------------------------
+
+    def put(self, key: Tuple[int, ...], k_slab, v_slab) -> bool:
+        """Capture one spilled page (host wire slabs). Returns True when
+        stored; an entry alone exceeding the budget is refused (stored
+        False, counted in ``spill_drops``). Replacing an existing key
+        re-censuses exactly."""
+        if _fault_hook is not None:
+            _fault_hook("spill", key, 1)
+        nbytes = slab_nbytes(k_slab) + slab_nbytes(v_slab)
+        if nbytes > self.byte_budget:
+            self.spill_drops += 1
+            return False
+        old = self._entries.pop(key, None)
+        if old is not None:
+            self.resident_bytes -= old[2]
+        while self._entries and self.resident_bytes + nbytes > self.byte_budget:
+            _, (_, _, dropped) = self._entries.popitem(last=False)
+            self.resident_bytes -= dropped
+        self._entries[key] = (k_slab, v_slab, nbytes)
+        self.resident_bytes += nbytes
+        self.spills += 1
+        if self._m_spill is not None:
+            self._m_spill.inc()
+            self._m_bytes.set(self.resident_bytes)
+        return True
+
+    def get(self, key: Tuple[int, ...]) -> Tuple[Any, Any, int]:
+        """Fetch one page's slabs for restore (refreshes recency; the
+        entry STAYS resident — a restored page may be evicted and
+        re-spilled later, and until then the tier copy still serves
+        peer pulls). Raises KeyError on a vanished entry,
+        :class:`HostTierError` from the fault seam."""
+        if _fault_hook is not None:
+            _fault_hook("restore", key, 1)
+        k_slab, v_slab, nbytes = self._entries[key]
+        self._entries.move_to_end(key)
+        return k_slab, v_slab, nbytes
+
+    # -- probe accounting (engine-driven: one probe per restore attempt) ---
+
+    def note_probe(self, found_blocks: int) -> None:
+        if found_blocks > 0:
+            self.hits += 1
+            if self._m_hit is not None:
+                self._m_hit.inc()
+        else:
+            self.misses += 1
+            if self._m_miss is not None:
+                self._m_miss.inc()
+
+    def note_restored(self, n_pages: int) -> None:
+        self.restores += n_pages
+        if self._m_restore is not None:
+            self._m_restore.inc(n_pages)
+
+    # -- admin -------------------------------------------------------------
+
+    def clear(self) -> None:
+        self._entries.clear()
+        self.resident_bytes = 0
+        if self._m_bytes is not None:
+            self._m_bytes.set(0)
+
+    def stats(self) -> dict:
+        return {
+            "budget_bytes": self.byte_budget,
+            "resident_bytes": self.resident_bytes,
+            "resident_pages": self.resident_pages,
+            "hits": self.hits,
+            "misses": self.misses,
+            "spills": self.spills,
+            "restores": self.restores,
+            "spill_drops": self.spill_drops,
+        }

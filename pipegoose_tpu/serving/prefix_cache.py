@@ -97,6 +97,15 @@ class PrefixCache:
         # O(1) (a list's .remove would make pressure eviction O(N^2))
         self._nodes: Dict[int, _Node] = {}
         self._clock = 0                 # deterministic LRU ordering
+        # eviction intercept (serving/kv_tier/): called as
+        # ``spill_hook(token_chain, page)`` BEFORE a victim's page goes
+        # back to the pool — the engine's hook exports the page's KV to
+        # the host tier so the prefix survives eviction. Best-effort by
+        # contract: eviction MUST proceed either way (the scheduler's
+        # never-fail reservation arithmetic rests on evict recovering
+        # pages), so a failing hook loses the tier copy, never the pool
+        # page.
+        self.spill_hook = None
 
     # -- queries -----------------------------------------------------------
 
@@ -144,6 +153,27 @@ class PrefixCache:
         if n <= 1:
             return 0
         return self.lookup(tokens, max_tokens=n - 1).total_tokens
+
+    def restorable_len(self, tokens: Sequence[int], tier,
+                       max_tokens: Optional[int] = None) -> int:
+        """Tier-aware probe: token length of the longest prefix servable
+        WITHOUT recompute — the HBM hit's full pages plus the contiguous
+        run of host-tier blocks extending it (the first gap stops the
+        walk: a restore must land front-to-back). COW partials do not
+        extend into the tier (a tier entry is keyed by the exact block
+        chain). Side-effect free like :meth:`lookup` — never touches
+        the tier's LRU order either (``tier.contains``)."""
+        toks = [int(t) for t in np.asarray(tokens)]
+        cap = len(toks) if max_tokens is None else min(max_tokens, len(toks))
+        ps = self.page_size
+        hit = self.lookup(toks, max_tokens=cap)
+        if tier is None:
+            return hit.tokens
+        i = hit.tokens // ps
+        while (i + 1) * ps <= cap and tier.contains(
+                tuple(toks[:(i + 1) * ps])):
+            i += 1
+        return i * ps
 
     def lookup(self, tokens: Sequence[int], max_tokens: Optional[int] = None
                ) -> PrefixHit:
@@ -250,10 +280,32 @@ class PrefixCache:
                     victim = node
             if victim is None:
                 break
+            if self.spill_hook is not None:
+                try:
+                    self.spill_hook(self._chain(victim), victim.page)
+                except Exception:
+                    # spill is best-effort: the tier copy is lost, the
+                    # eviction (and the reservation ledger resting on
+                    # it) proceeds regardless
+                    pass
             self._remove(victim)
             self.pool.release([victim.page])
             freed += 1
         return freed
+
+    def _chain(self, node: _Node) -> Tuple[int, ...]:
+        """The full token chain that produced ``node``'s page — root
+        block through ``node.block`` inclusive (the host tier's key and
+        the spill black box's name for the prefix)."""
+        blocks = []
+        cur: Optional[_Node] = node
+        while cur is not None:
+            blocks.append(cur.block)
+            cur = cur.parent
+        out: List[int] = []
+        for blk in reversed(blocks):
+            out.extend(blk)
+        return tuple(out)
 
     def clear(self) -> int:
         """Drop every unpinned page (tests / shutdown). Pinned pages

@@ -592,8 +592,25 @@ class Scheduler:
             self.pool.release(stage["pages"])
         self._outstanding_total -= stage["outstanding"]
 
-    def admit_with_pages(self, req: Request, first_token: int,
-                         now: float) -> bool:
+    def alloc_for_restore(self, n: int) -> List[int]:
+        """Best-effort page allocation for the kv_tier restore path
+        (serving/kv_tier/): evict cold cache pages like any alloc, but
+        return UP TO ``n`` pages instead of retracting live requests —
+        a restore is opportunistic, not owed. No ledger debit is
+        needed: the caller inserts the restored chain into the prefix
+        cache and releases its own reference immediately, so the pages
+        re-enter the ``free + evictable`` total the reservation
+        arithmetic spends — capacity is moved, never consumed."""
+        if n <= 0:
+            return []
+        if self.cache is not None and self.pool.free_count < n:
+            self.cache.evict(n - self.pool.free_count)
+        got = min(n, self.pool.free_count)
+        return self.pool.alloc(got) if got else []
+
+    def admit_with_pages(self, req: Request, first_token: Optional[int],
+                         now: float, *,
+                         prefilled_len: Optional[int] = None) -> bool:
         """The disagg admission: bind a fully materialized transfer to
         a free slot and SKIP prefill entirely — the pages already hold
         the prompt's KV, so the request debits nothing beyond the tail
@@ -605,7 +622,15 @@ class Scheduler:
         ownership handover point where the staged pages become the
         request's own. ``t_admit`` survives from the prefill-pool
         admission (first admission wins), so queue latency stays the
-        user-visible wait."""
+        user-visible wait.
+
+        ``prefilled_len`` < ``target_len`` is the PARTIAL variant (the
+        kv_tier cross-replica pull): the staged pages cover only the
+        pulled page-aligned prefix, no first token exists yet, and the
+        request stays ``Status.PREFILL`` so the engine's chunked
+        prefill RESUMES at ``prefilled_len`` — admission-by-transfer
+        composing with the ordinary prefill machinery instead of
+        bypassing it."""
         stage = self.transfers.get(req.uid)
         if stage is None:
             raise ValueError(f"uid={req.uid} is not staged here")
@@ -626,8 +651,23 @@ class Scheduler:
         req.cow = None
         if req.t_admit is None:
             req.t_admit = now
-        req.prefilled_len = req.target_len
         req.hit_tokens = 0
+        if prefilled_len is not None and prefilled_len < req.target_len:
+            if first_token is not None:
+                raise ValueError(
+                    "a partial admit_with_pages carries no first token "
+                    "(prefill has not finished anywhere yet)"
+                )
+            if prefilled_len % self.pool.page_size:
+                raise ValueError(
+                    f"prefilled_len={prefilled_len} must be page-aligned "
+                    f"(pulled pages hold whole blocks)"
+                )
+            req.prefilled_len = prefilled_len
+            if self.tracer is not None:
+                self.tracer.on_transfer_done(req, now, resume="prefill")
+            return True
+        req.prefilled_len = req.target_len
         if self.tracer is not None:
             self.tracer.on_transfer_done(req, now)
         self.record_token(req, int(first_token), now)

@@ -57,13 +57,18 @@ from pipegoose_tpu.telemetry.registry import MetricsRegistry, get_registry
 #: ``transfer_s`` is the disaggregated-serving phase (serving/disagg/):
 #: prefill handed off on one pool, decode not yet admitted on the other
 #: — the critical-path share of the cross-mesh KV page streaming.
-#: Always present (0.0 outside disagg) so the sum-to-e2e contract is
-#: one invariant everywhere.
-COMPONENTS = ("queue_s", "prefill_s", "transfer_s", "decode_s", "stall_s")
+#: ``restore_s`` is the kv_tier phase (serving/kv_tier/): host-tier
+#: slabs scattering back into pool pages before admission (a
+#: cross-replica pull books as ``transfer_s`` — it rides the same
+#: staging path as disagg). Both are always present (0.0 when unused)
+#: so the sum-to-e2e contract is one invariant everywhere.
+COMPONENTS = ("queue_s", "prefill_s", "restore_s", "transfer_s",
+              "decode_s", "stall_s")
 
 _PHASE_TO_COMPONENT = {
     "queue": "queue_s",
     "prefill": "prefill_s",
+    "restore": "restore_s",
     "transfer": "transfer_s",
     "decode": "decode_s",
     "stall": "stall_s",
@@ -89,6 +94,7 @@ class RequestTimeline:
         "spec_drafted", "spec_accepted", "preemptions",
         "transfer_chunks", "transfer_pages", "transfer_bytes",
         "transfer_compute_s",
+        "restore_pages", "restore_bytes", "restore_compute_s",
         "cache_saved_est_s", "_phase", "_t_phase",
     )
 
@@ -122,6 +128,9 @@ class RequestTimeline:
         self.transfer_pages = 0
         self.transfer_bytes = 0        # wire bytes (q+scale / bf16 / fp)
         self.transfer_compute_s = 0.0  # measured export+import share
+        self.restore_pages = 0         # host-tier pages scattered back
+        self.restore_bytes = 0
+        self.restore_compute_s = 0.0
         self.cache_saved_est_s = 0.0
         self._phase: Optional[str] = None
         self._t_phase: Optional[float] = None
@@ -182,6 +191,9 @@ class RequestTimeline:
             "transfer_pages": self.transfer_pages,
             "transfer_bytes": self.transfer_bytes,
             "transfer_compute_s": self.transfer_compute_s,
+            "restore_pages": self.restore_pages,
+            "restore_bytes": self.restore_bytes,
+            "restore_compute_s": self.restore_compute_s,
             "decode_ticks": self.decode_ticks,
             "prefill_compute_s": self.prefill_compute_s,
             "decode_compute_s": self.decode_compute_s,
@@ -245,7 +257,18 @@ class NullRequestTracer:
                           tokens: int, pages: int, nbytes: int) -> None:
         pass
 
-    def on_transfer_done(self, req: Any, t: float) -> None:
+    def on_transfer_done(self, req: Any, t: float,
+                         resume: str = "decode") -> None:
+        pass
+
+    def on_restore_start(self, req: Any, t: float) -> None:
+        pass
+
+    def on_restore_chunk(self, req: Any, t: float, dur_s: float,
+                         tokens: int, pages: int, nbytes: int) -> None:
+        pass
+
+    def on_restore_done(self, req: Any, t: float) -> None:
         pass
 
     def on_done(self, req: Any, t: float) -> None:
@@ -280,7 +303,8 @@ class RequestTracer(NullRequestTracer):
     __slots__ = (
         "registry", "clock", "max_events", "keep_completed",
         "in_flight", "completed", "_wall_offset", "_lock",
-        "_h_queue", "_h_prefill", "_h_transfer", "_h_decode", "_h_stall",
+        "_h_queue", "_h_prefill", "_h_restore", "_h_transfer",
+        "_h_decode", "_h_stall",
         "_h_saved", "_c_requests", "_c_preempts", "_c_saved",
     )
 
@@ -309,6 +333,7 @@ class RequestTracer(NullRequestTracer):
         reg = self.registry
         self._h_queue = reg.histogram("serving.attrib.queue_seconds")
         self._h_prefill = reg.histogram("serving.attrib.prefill_seconds")
+        self._h_restore = reg.histogram("serving.attrib.restore_seconds")
         self._h_transfer = reg.histogram("serving.attrib.transfer_seconds")
         self._h_decode = reg.histogram("serving.attrib.decode_seconds")
         self._h_stall = reg.histogram("serving.attrib.stall_seconds")
@@ -414,6 +439,7 @@ class RequestTracer(NullRequestTracer):
         c = tl.components
         self._h_queue.observe(c["queue_s"])
         self._h_prefill.observe(c["prefill_s"])
+        self._h_restore.observe(c["restore_s"])
         self._h_transfer.observe(c["transfer_s"])
         self._h_decode.observe(c["decode_s"])
         self._h_stall.observe(c["stall_s"])
@@ -518,14 +544,51 @@ class RequestTracer(NullRequestTracer):
                          tokens=int(tokens), pages=int(pages),
                          nbytes=int(nbytes))
 
-    def on_transfer_done(self, req: Any, t: float) -> None:
+    def on_transfer_done(self, req: Any, t: float,
+                         resume: str = "decode") -> None:
         """Decode pool admitted the transferred pages: the transfer
-        phase closes and decode opens (fired by ``admit_with_pages``
-        just before the handoff token is recorded)."""
+        phase closes and ``resume`` opens — ``"decode"`` for the disagg
+        handoff (fired by ``admit_with_pages`` just before the handoff
+        token is recorded), ``"prefill"`` for a partial kv_tier pull
+        (the request resumes chunked prefill at the pulled length)."""
         with self._lock:
             tl = self._get(req, t)
-            tl.transition("decode", t)
-            tl.add_event("transfer_done", t)
+            tl.transition(resume, t)
+            tl.add_event("transfer_done", t, resume=resume)
+
+    # -- kv_tier restore hooks (serving/kv_tier/) --------------------------
+
+    def on_restore_start(self, req: Any, t: float) -> None:
+        """Host-tier restore opened for a still-QUEUED request (the
+        engine's pre-admission intercept): its wall clock belongs to
+        the restore until the pages are back in HBM."""
+        with self._lock:
+            tl = self._get(req, t)
+            tl.transition("restore", t)
+            tl.add_event("restore_start", t)
+
+    def on_restore_chunk(self, req: Any, t: float, dur_s: float,
+                         tokens: int, pages: int, nbytes: int) -> None:
+        """One page scattered back from the host tier (local restore),
+        or one peer TIER entry imported during a pull (the phase is
+        whatever the surrounding path opened — only counters move)."""
+        with self._lock:
+            tl = self._get(req, t)
+            tl.restore_pages += int(pages)
+            tl.restore_bytes += int(nbytes)
+            tl.restore_compute_s += dur_s
+            tl.add_event("restore_chunk", t, dur_s=dur_s,
+                         tokens=int(tokens), pages=int(pages),
+                         nbytes=int(nbytes))
+
+    def on_restore_done(self, req: Any, t: float) -> None:
+        """Restore finished (fully or degraded): the request goes back
+        to waiting for ordinary admission — the restored pages are
+        cache hits now, so what follows books as queue time again."""
+        with self._lock:
+            tl = self._get(req, t)
+            tl.transition("queue", t)
+            tl.add_event("restore_done", t)
 
     # -- views -------------------------------------------------------------
 
@@ -609,6 +672,7 @@ def request_trace_events(tracer: RequestTracer, *, pid: Optional[int] = None
     off = tracer.wall_offset
     queue_tid = 1_000  # after any realistic slot count
     transfer_tid = 2_000  # disagg cross-pool page streaming track
+    restore_tid = 3_000   # kv_tier host-tier restore track
     events: List[dict] = [
         {
             "name": "process_name", "ph": "M", "pid": pid,
@@ -621,6 +685,7 @@ def request_trace_events(tracer: RequestTracer, *, pid: Optional[int] = None
     ]
     seen_slots: set = set()
     seen_transfer = False
+    seen_restore = False
 
     def us(t: float) -> float:
         return (t + off) * 1e6
@@ -709,6 +774,26 @@ def request_trace_events(tracer: RequestTracer, *, pid: Optional[int] = None
                        t - dur, t, transfer_tid, uid=uid,
                        pages=ev.get("pages"), nbytes=ev.get("nbytes"))
                 seen_transfer = True
+            elif kind == "restore_start":
+                if phase == "queue":
+                    slice_(f"req{uid} queue", "request.queue",
+                           t_open, t, queue_tid, uid=uid)
+                phase, t_open = "restore", t
+                seen_restore = True
+            elif kind == "restore_done":
+                if phase == "restore":
+                    slice_(f"req{uid} restore", "request.restore",
+                           t_open, t, restore_tid, uid=uid,
+                           pages=tl.get("restore_pages", 0),
+                           nbytes=tl.get("restore_bytes", 0))
+                phase, t_open = "queue", t
+                seen_restore = True
+            elif kind == "restore_chunk":
+                dur = float(ev.get("dur_s", 0.0))
+                slice_(f"req{uid} restore chunk", "request.restore_chunk",
+                       t - dur, t, restore_tid, uid=uid,
+                       pages=ev.get("pages"), nbytes=ev.get("nbytes"))
+                seen_restore = True
             elif kind == "prefill_chunk":
                 dur = float(ev.get("dur_s", 0.0))
                 slice_(f"req{uid} chunk", "request.prefill_chunk",
@@ -722,9 +807,16 @@ def request_trace_events(tracer: RequestTracer, *, pid: Optional[int] = None
                            accepted=ev.get("accepted"))
         if phase is not None:  # in-flight: close the open phase slice
             track = (queue_tid if phase in ("queue", "stall")
-                     else transfer_tid if phase == "transfer" else tid)
+                     else transfer_tid if phase == "transfer"
+                     else restore_tid if phase == "restore" else tid)
             slice_(f"req{uid} {phase}", f"request.{phase}",
                    t_open, t_end, track, uid=uid, open=True)
+    if seen_restore:
+        events.insert(1, {
+            "name": "thread_name", "ph": "M", "pid": pid,
+            "tid": restore_tid,
+            "args": {"name": "restore (host KV tier)"},
+        })
     if seen_transfer:
         events.insert(1, {
             "name": "thread_name", "ph": "M", "pid": pid,

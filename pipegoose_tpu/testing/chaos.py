@@ -70,6 +70,14 @@ Injection kinds (``KINDS``):
                      each failed shipment must abort its staging and
                      fall back to a local re-prefill on the decode
                      pool, token-identically.
+``host_tier_io_error``  arm the host KV-tier fault seam
+                     (serving/kv_tier/host_tier.py
+                     ``set_host_tier_fault``) with ``fail_times``
+                     transient ``HostTierError``s on RESTORE — each
+                     failed restore must degrade to recompute
+                     (token-identically, one consumed
+                     ``kv_tier_fallback`` black box), never stall or
+                     lose the request.
 
 Host-side by design (and jit-safety-allowlisted): injections run in
 callback/tick context, never inside compiled code.
@@ -96,11 +104,13 @@ KINDS: Tuple[str, ...] = (
     "replica_crash",
     "replica_wedge",
     "transfer_flap",
+    "host_tier_io_error",
 )
 
 #: kinds applied by the serving tick hook (matched on engine tick
 #: number); the rest are trainer-callback injections (matched on step)
-SERVING_KINDS: Tuple[str, ...] = ("host_stall", "transfer_flap")
+SERVING_KINDS: Tuple[str, ...] = ("host_stall", "transfer_flap",
+                                  "host_tier_io_error")
 
 #: kinds applied by the FLEET hook (``ControlPlane.run(tick_hook=
 #: monkey.fleet_hook)``), matched on the control-plane tick number
@@ -109,6 +119,7 @@ FLEET_KINDS: Tuple[str, ...] = (
     "replica_wedge",
     "transfer_flap",
     "host_stall",
+    "host_tier_io_error",
 )
 
 
@@ -177,6 +188,7 @@ class ChaosSchedule:
         replica_crash: int = 0,
         replica_wedge: int = 0,
         transfer_flap: int = 0,
+        host_tier_io_error: int = 0,
         n_lose: int = 1,
         module_groups: Sequence[str] = ("embed",),
         stall_s: float = 0.05,
@@ -201,6 +213,7 @@ class ChaosSchedule:
             "replica_crash": replica_crash,
             "replica_wedge": replica_wedge,
             "transfer_flap": transfer_flap,
+            "host_tier_io_error": host_tier_io_error,
         }
         span = max_step - min_step + 1
         total = sum(counts.values())
@@ -235,7 +248,10 @@ class ChaosSchedule:
                     # modulo the LIVE candidates at fire time, so the
                     # same schedule applies to any fleet size
                     args = _args(replica=int(rng.randint(n_replicas)))
-                else:  # transfer_flap
+                elif kind == "transfer_flap":
+                    args = _args(fail_times=int(flap_times))
+                else:  # host_tier_io_error (shares flap_times: both
+                    # are transient wire faults with a retry budget)
                     args = _args(fail_times=int(flap_times))
                 injections.append(Injection(step, kind, args))
         return cls(injections, seed=seed, max_step=max_step)
@@ -305,6 +321,33 @@ class TransientTransferFault:
             )
 
 
+class TransientHostTierFault:
+    """Host-tier restore fault: raises ``HostTierError`` for the first
+    ``times`` RESTORE ops, then passes — what ``host_tier_io_error``
+    arms on serving/kv_tier/host_tier.py's :func:`set_host_tier_fault`
+    seam. Spills pass through untouched (a dropped spill would just
+    shrink the tier — the interesting contract is the restore-side
+    degrade-to-recompute). Hook signature is the seam's
+    ``(op, key, n_pages)``."""
+
+    def __init__(self, times: int):
+        self.remaining = int(times)
+        self.fired = 0
+
+    def __call__(self, op: str, key: Any, n_pages: int) -> None:
+        if op != "restore":
+            return
+        if self.remaining > 0:
+            from pipegoose_tpu.serving.kv_tier.host_tier import HostTierError
+
+            self.remaining -= 1
+            self.fired += 1
+            raise HostTierError(
+                f"chaos: injected host-tier I/O error on restore of "
+                f"{len(key)}-token prefix ({self.fired} so far)"
+            )
+
+
 def tear_checkpoint(directory: str) -> Optional[str]:
     """Replace the newest COMPLETE checkpoint's contents with a partial
     stub — the on-disk state a kill mid-save used to leave before the
@@ -368,6 +411,7 @@ class ChaosMonkey:
         self.applied: List[Injection] = []
         self.io_faults: List[TransientIOFault] = []
         self.transfer_faults: List[TransientTransferFault] = []
+        self.tier_faults: List[TransientHostTierFault] = []
         # hooks installed before our first arm — disarm restores them,
         # so the monkey never clobbers an externally installed fault
         # seam (one flag per seam: ckpt I/O and disagg transfer)
@@ -375,6 +419,8 @@ class ChaosMonkey:
         self._armed = False
         self._prev_xfer_hook: Optional[Any] = None
         self._xfer_armed = False
+        self._prev_tier_hook: Optional[Any] = None
+        self._tier_armed = False
         # fire-once bookkeeping: recovery REWINDS the step counter, so
         # the steps after a rollback replay through the schedule again —
         # re-injecting would make every recovery replay its own cause
@@ -489,6 +535,19 @@ class ChaosMonkey:
             self._xfer_armed = True
         self._log(inj)
 
+    def _apply_host_tier_io_error(self, inj: Injection) -> None:
+        from pipegoose_tpu.serving.kv_tier.host_tier import (
+            set_host_tier_fault,
+        )
+
+        fault = TransientHostTierFault(int(inj.kwargs.get("fail_times", 1)))
+        self.tier_faults.append(fault)
+        prev = set_host_tier_fault(fault)
+        if not self._tier_armed:  # remember only the EXTERNAL hook
+            self._prev_tier_hook = prev
+            self._tier_armed = True
+        self._log(inj)
+
     def _apply_replica_fault(self, plane: Any, inj: Injection,
                              kind: str) -> None:
         from pipegoose_tpu.serving.control_plane.replica import ReplicaState
@@ -575,6 +634,14 @@ class ChaosMonkey:
             set_transfer_fault(self._prev_xfer_hook)
             self._prev_xfer_hook = None
             self._xfer_armed = False
+        if self._tier_armed:
+            from pipegoose_tpu.serving.kv_tier.host_tier import (
+                set_host_tier_fault,
+            )
+
+            set_host_tier_fault(self._prev_tier_hook)
+            self._prev_tier_hook = None
+            self._tier_armed = False
 
     # -- serving tick hooks ------------------------------------------------
 
@@ -587,6 +654,8 @@ class ChaosMonkey:
         for inj in self._take(tick, SERVING_KINDS):
             if inj.kind == "host_stall":
                 self._apply_host_stall(inj)
+            elif inj.kind == "host_tier_io_error":
+                self._apply_host_tier_io_error(inj)
             else:  # transfer_flap
                 self._apply_transfer_flap(inj)
 
@@ -607,6 +676,8 @@ class ChaosMonkey:
                 )
             elif inj.kind == "transfer_flap":
                 self._apply_transfer_flap(inj)
+            elif inj.kind == "host_tier_io_error":
+                self._apply_host_tier_io_error(inj)
             else:  # host_stall
                 self._apply_host_stall(inj)
 

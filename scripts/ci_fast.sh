@@ -16,6 +16,13 @@ cd "$(dirname "$0")/.."
 
 export JAX_PLATFORMS=cpu
 
+# Persistent XLA compilation cache for the SERVING smokes (same dir as
+# tests/conftest.py — see there for why it is serving-only: this
+# jaxlib segfaults deserializing hybrid train-step executables, while
+# jit-pure serving programs round-trip cleanly). Prefix a smoke's
+# python invocation with $JAX_SERVING_CACHE_ENV to opt it in.
+JAX_SERVING_CACHE_ENV="JAX_COMPILATION_CACHE_DIR=${JAX_COMPILATION_CACHE_DIR:-/tmp/pipegoose_jax_cache} JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS=0 JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES=0"
+
 # Static jit-safety lint FIRST (scripts/lint_jit_safety.py): pure AST,
 # no jax import — host-sync calls (.item(), np.asarray, time.*,
 # jax.device_get) or bare excepts landing in a jit-path module fail in
@@ -155,7 +162,7 @@ PY
 # capacity — the quantization accuracy contract stays exercised on
 # every CI run before the tier proper.
 echo "== quant greedy-parity smoke (int8 weights + int8 KV) =="
-python - <<'PY'
+env $JAX_SERVING_CACHE_ENV python - <<'PY'
 from pipegoose_tpu.testing import force_cpu_devices
 
 force_cpu_devices(1)
@@ -197,7 +204,7 @@ PY
 # and a forced scale-down drain must migrate in-flight work and finish
 # every request with token streams identical to the no-drain run.
 echo "== control-plane router smoke (2 replicas, cache-aware vs RR) =="
-python - <<'PY'
+env $JAX_SERVING_CACHE_ENV python - <<'PY'
 from pipegoose_tpu.testing import force_cpu_devices
 
 force_cpu_devices(1)
@@ -239,7 +246,7 @@ PY
 # exactly. The cross-mesh handoff contract stays exercised on every CI
 # run before the tier proper.
 echo "== disagg smoke (2-pool token identity + exact attribution) =="
-python - <<'PY'
+env $JAX_SERVING_CACHE_ENV python - <<'PY'
 from pipegoose_tpu.testing import force_cpu_devices
 
 force_cpu_devices(1)
@@ -299,7 +306,7 @@ PY
 # quarantined, and every admitted request SALVAGED onto the survivor —
 # outputs token-identical to the no-crash fleet, zero requests lost.
 echo "== crash-recovery smoke (2 replicas, seeded replica_crash) =="
-python - <<'PY'
+env $JAX_SERVING_CACHE_ENV python - <<'PY'
 import tempfile
 
 from pipegoose_tpu.testing import (
@@ -421,6 +428,78 @@ print(f"profile smoke OK: {len(prof.collectives)} collectives matched "
       f"op-for-op, compute/comm/idle = "
       f"{prof.compute_fraction:.0%}/{prof.comm_fraction:.0%}/"
       f"{prof.idle_fraction:.0%} of {prof.wall_step_s*1e3:.1f}ms")
+PY
+
+# KV-tier smoke (serving/kv_tier/, ISSUE 16): an int8 pool whose
+# working set overflows HBM spills evicted prefix pages into the
+# host-DRAM tier and restores them on replay — outputs token-identical
+# to an all-HBM reference, the restore-aware latency attribution sums
+# to e2e exactly, and the tier's resident bytes equal the int8 wire
+# census (q+scale planes, never fp).
+echo "== kv-tier smoke (host-DRAM spill/restore) =="
+env $JAX_SERVING_CACHE_ENV python - <<'PY'
+from pipegoose_tpu.testing import force_cpu_devices
+
+force_cpu_devices(1)
+
+import jax
+import numpy as np
+
+from pipegoose_tpu.models import bloom
+from pipegoose_tpu.serving import Request, ServingEngine
+from pipegoose_tpu.serving.kv_tier import HostTier
+from pipegoose_tpu.serving.kv_tier.restore import wire_page_bytes
+from pipegoose_tpu.telemetry.reqtrace import RequestTracer
+
+cfg = bloom.BloomConfig(vocab_size=64, hidden_size=64, n_layer=2, n_head=4)
+params = bloom.init_params(cfg, jax.random.PRNGKey(0))
+rng = np.random.RandomState(7)
+prefixes = [rng.randint(1, 64, (12,)) for _ in range(2)]
+suffixes = [rng.randint(1, 64, (2,)) for _ in range(2)]
+
+
+def phase(prefix):
+    return [Request(prompt=np.concatenate([prefix, s]).astype(np.int32),
+                    max_new_tokens=4) for s in suffixes]
+
+
+kw = dict(num_slots=2, page_size=4, max_context=32, prefill_chunk=4,
+          prefix_cache=True, kv_dtype="int8")
+tier = HostTier(1 << 20)
+eng = ServingEngine(params, cfg, num_pages=9, host_tier=tier, **kw)
+tracer = RequestTracer()
+eng.attach_tracer(tracer)
+ref = ServingEngine(params, cfg, num_pages=33, **kw)
+
+outs, routs, restored = [], [], 0
+for pfx in (prefixes[0], prefixes[1], prefixes[0]):
+    done, m = eng.run(phase(pfx))
+    outs += [o.generated for o in done]
+    restored += m.get("kv_tier", {}).get("restored_tokens", 0)
+    rdone, _ = ref.run(phase(pfx))
+    routs += [o.generated for o in rdone]
+assert tier.spills > 0, "overflow never spilled into the tier"
+assert restored > 0 and tier.restores > 0, "replay never restored"
+for a, b in zip(outs, routs):
+    np.testing.assert_array_equal(
+        a, b, err_msg="spill->restore round trip diverged from all-HBM")
+tls = list(tracer.completed)
+assert tls, "tracer recorded nothing"
+for tl in tls:
+    total = sum(tl.components.values())
+    assert abs(total - tl.e2e_s) <= 1e-6 * max(tl.e2e_s, 1.0), (
+        tl.uid, total, tl.e2e_s, tl.components)
+assert any(tl.components["restore_s"] > 0 for tl in tls), (
+    "no request attributed restore time")
+wire = wire_page_bytes(eng)
+assert tier.resident_bytes == tier.resident_pages * wire, (
+    tier.resident_bytes, tier.resident_pages, wire)
+rep = eng.memory_report()["host_tier"]
+assert rep["resident_bytes"] == tier.resident_bytes
+print(f"kv-tier smoke OK: {tier.spills} page(s) spilled, "
+      f"{tier.restores} restored ({restored} tokens), outputs "
+      f"token-identical to all-HBM, attribution sums to e2e, "
+      f"{tier.resident_pages} x {wire} B int8 wire slabs resident")
 PY
 
 echo "== fast tier =="

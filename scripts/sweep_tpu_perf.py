@@ -534,7 +534,8 @@ def disagg_sweep():
     print(json.dumps(results))
 
 
-def serving_sweep(prefix_replay: bool = False, quant: bool = False):
+def serving_sweep(prefix_replay: bool = False, quant: bool = False,
+                  tiered: bool = False):
     """Continuous-batching vs naive padded serving (serving/engine.py)
     across slot counts on the real chip: the decode-step savings grow
     with the slot count as long as the mixed-length workload keeps
@@ -551,7 +552,14 @@ def serving_sweep(prefix_replay: bool = False, quant: bool = False):
     ``--quant`` (ROADMAP item 4) adds the int8w / int8kv / int8w+int8kv
     arms to whichever workload runs: tokens/s, TTFT, resident HBM, and
     the measured page-capacity ratio per slot count, pinned against the
-    fp rows of the same run."""
+    fp rows of the same run.
+
+    ``--tiered`` (ISSUE 16) adds the KV-memory-hierarchy arms to the
+    prefix replay: an overflow variant of the same workload (working
+    set > HBM pages) through LRU-evict-and-recompute vs host-tier
+    restore vs cross-replica pull — hit rate, TTFT p99, and the
+    recompute-token reduction per slot count. Implies
+    ``--prefix-replay``."""
     from pipegoose_tpu.models import bloom
     from pipegoose_tpu.serving import (
         prefix_replay_benchmark,
@@ -569,6 +577,7 @@ def serving_sweep(prefix_replay: bool = False, quant: bool = False):
 
     reg = telemetry.get_registry()
     was_enabled = reg.enabled
+    prefix_replay = prefix_replay or tiered
     results = {}
     for slots in (2, 4, 8):
         label = f"slots{slots}"
@@ -581,7 +590,7 @@ def serving_sweep(prefix_replay: bool = False, quant: bool = False):
                     num_slots=slots, num_pages=1 + 16 * slots,
                     page_size=32, max_context=256, prefill_chunk=64,
                     include_speculative=True, speculative=(4, 3),
-                    include_quant=quant,
+                    include_quant=quant, include_tiered=tiered,
                 )
             else:
                 results[label] = serving_ab_benchmark(
@@ -618,6 +627,7 @@ if __name__ == "__main__":
             serving_sweep,
             prefix_replay="--prefix-replay" in sys.argv[2:],
             quant="--quant" in sys.argv[2:],
+            tiered="--tiered" in sys.argv[2:],
         )
     # telemetry JSONL artifact (the serving sweep's engines emit their
     # per-step time series into it; every mode gets a final snapshot) —

@@ -1,0 +1,616 @@
+"""Fleet-wide KV memory hierarchy (serving/kv_tier/, ISSUE 16).
+
+The contract under test: prefix-cache evictions spill cold KV pages
+into a byte-budgeted host-DRAM LRU at WIRE precision (int8 pools park
+q + scale planes verbatim, never fp — resident bytes pinned at exactly
+the wire census), a later same-prefix request restores them through
+the jitted import BEFORE admission (spill -> restore token-identical
+to an all-HBM run, fp and int8), a fleet ``PrefixDirectory`` lets a
+cold replica PULL a prefix a warm peer holds through the disagg
+``PoolTransfer`` machinery (tp=2 -> tp=1 resharded at the host hop),
+``restore_s`` joins the exact attribution identity, and the seeded
+``host_tier_io_error`` chaos kind degrades to recompute — same tokens,
+one consumed ``kv_tier_fallback`` black box, never a stall or a lost
+request."""
+import jax
+import numpy as np
+import pytest
+
+from pipegoose_tpu.distributed import ParallelContext
+from pipegoose_tpu.models import bloom
+from pipegoose_tpu.serving import Request, ServingEngine
+from pipegoose_tpu.serving.kv_tier import (
+    HostTier,
+    HostTierError,
+    PrefixDirectory,
+    RestorePlanner,
+    set_host_tier_fault,
+)
+from pipegoose_tpu.serving.kv_tier.restore import wire_page_bytes
+from pipegoose_tpu.telemetry import MetricsRegistry
+from pipegoose_tpu.telemetry.reqtrace import RequestTracer
+
+PS = 4            # page size
+CHUNK = 4         # prefill chunk
+SMALL = 9         # pool pages: overflows on the 2-prefix replay
+AMPLE = 65        # pool pages: the all-HBM reference never evicts
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = bloom.BloomConfig(vocab_size=64, hidden_size=64, n_layer=2,
+                            n_head=4)
+    params = bloom.init_params(cfg, jax.random.PRNGKey(0))
+    rng = np.random.RandomState(7)
+    prefixes = [rng.randint(1, 64, (12,)) for _ in range(2)]  # 3 pages
+    suffixes = [rng.randint(1, 64, (2,)) for _ in range(2)]
+    return cfg, params, prefixes, suffixes
+
+
+def _phase(prefix, suffixes, max_new=4):
+    return [Request(prompt=np.concatenate([prefix, s]),
+                    max_new_tokens=max_new) for s in suffixes]
+
+
+def _engine(params, cfg, *, num_pages=SMALL, **kw):
+    kw.setdefault("registry", MetricsRegistry())
+    return ServingEngine(params, cfg, num_slots=2, num_pages=num_pages,
+                         page_size=PS, max_context=32,
+                         prefill_chunk=CHUNK, prefix_cache=True, **kw)
+
+
+def _replay(engine, prefixes, suffixes):
+    """The overflow replay: prefix A, then B (whose pages evict A's),
+    then A again. Returns (generated streams, prefill tokens, restored
+    tokens, pulled tokens) summed over the three runs."""
+    outs, prefill, restored, pulled = [], 0, 0, 0
+    for pfx in (prefixes[0], prefixes[1], prefixes[0]):
+        done, m = engine.run(_phase(pfx, suffixes))
+        outs += [o.generated for o in done]
+        prefill += m["prefill_tokens"]
+        kt = m.get("kv_tier", {})
+        restored += kt.get("restored_tokens", 0)
+        pulled += kt.get("pulled_tokens", 0)
+    return outs, prefill, restored, pulled
+
+
+def _assert_streams_equal(ref, got, label):
+    assert len(ref) == len(got)
+    for i, (a, b) in enumerate(zip(ref, got)):
+        np.testing.assert_array_equal(
+            b, a, err_msg=f"{label}: request {i} diverged")
+
+
+# --- host tier unit --------------------------------------------------------
+
+
+def _slab(nbytes):
+    return np.zeros(nbytes, dtype=np.uint8)
+
+
+def test_host_tier_lru_budget_and_exact_census():
+    tier = HostTier(100)
+    assert tier.put((1,), _slab(30), _slab(10))
+    assert tier.put((1, 2), _slab(30), _slab(10))
+    assert tier.resident_bytes == 80 and tier.resident_pages == 2
+    # contains() must not touch recency; get() must
+    assert tier.contains((1,))
+    tier.get((1,))                       # (1,) now most-recent
+    assert tier.put((1, 2, 3), _slab(30), _slab(10))   # evicts LRU (1,2)
+    assert not tier.contains((1, 2))
+    assert tier.contains((1,)) and tier.contains((1, 2, 3))
+    assert tier.resident_bytes == 80     # exact census after eviction
+    # replacing a key re-censuses exactly
+    assert tier.put((1,), _slab(10), _slab(10))
+    assert tier.resident_bytes == 60
+    tier.clear()
+    assert tier.resident_bytes == 0 and tier.resident_pages == 0
+
+
+def test_host_tier_refuses_entry_larger_than_budget():
+    tier = HostTier(32)
+    assert tier.put((1,), _slab(16), _slab(16))
+    assert not tier.put((2,), _slab(32), _slab(16))   # 48 > budget
+    assert tier.spill_drops == 1
+    assert tier.contains((1,))           # refused entry never thrashed it
+    with pytest.raises(ValueError, match="byte_budget"):
+        HostTier(0)
+
+
+def test_host_tier_fault_seam_arms_and_restores():
+    tier = HostTier(1 << 10)
+
+    def boom(op, key, n_pages):
+        if op == "spill":
+            raise HostTierError("injected")
+
+    prev = set_host_tier_fault(boom)
+    try:
+        with pytest.raises(HostTierError):
+            tier.put((1,), _slab(8), _slab(8))
+        tier2 = HostTier(1 << 10)        # restore path faults too
+        assert set_host_tier_fault(None) is boom
+        tier2.put((1,), _slab(8), _slab(8))
+        set_host_tier_fault(
+            lambda op, key, n: (_ for _ in ()).throw(
+                HostTierError("restore fault")) if op == "restore" else None)
+        with pytest.raises(HostTierError):
+            tier2.get((1,))
+    finally:
+        set_host_tier_fault(prev)
+
+
+def test_host_tier_registry_counters():
+    reg = MetricsRegistry(enabled=True)
+    tier = HostTier(1 << 10, registry=reg)
+    tier.put((1,), _slab(8), _slab(8))
+    tier.note_probe(1)
+    tier.note_probe(0)
+    tier.note_restored(2)
+    snap = reg.snapshot()["counters"]
+    assert snap["serving.kv_tier.spill_total"] == 1
+    assert snap["serving.kv_tier.hit_total"] == 1
+    assert snap["serving.kv_tier.miss_total"] == 1
+    assert snap["serving.kv_tier.restore_total"] == 2
+    assert reg.snapshot()["gauges"]["serving.kv_tier.bytes"] == 16
+    assert tier.stats()["restores"] == 2
+
+
+# --- prefix directory unit -------------------------------------------------
+
+
+def test_directory_publish_longest_holder_and_tiebreak():
+    d = PrefixDirectory(page_size=2)
+    chain = [1, 2, 3, 4, 5, 6]
+    d.publish("rep-b", chain[:4], "host")
+    d.publish("rep-a", chain[:4], "host")
+    # same depth: hbm beats host, then name order
+    assert d.longest_holder(chain) == (4, "rep-a", "host")
+    d.publish("rep-b", chain[:4], "hbm")
+    assert d.longest_holder(chain) == (4, "rep-b", "hbm")
+    # a deeper claim wins over the hbm preference
+    d.publish("rep-c", chain, "host")
+    assert d.longest_holder(chain) == (6, "rep-c", "host")
+    # exclude: the puller must never be told about itself
+    assert d.longest_holder(chain, exclude="rep-c") == (4, "rep-b", "hbm")
+    # deeper publish refreshed the ancestors too
+    assert d.longest_holder(chain[:2], exclude="rep-b")[1] == "rep-a"
+    d.retract_replica("rep-b")
+    assert d.longest_holder(chain, exclude="rep-c") == (4, "rep-a", "host")
+    assert d.longest_holder([9, 9, 9, 9]) == (0, None, None)
+    with pytest.raises(ValueError, match="location"):
+        d.publish("rep-a", chain, "tape")
+
+
+def test_directory_cap_reset_counts_and_degrades_to_no_hints():
+    d = PrefixDirectory(page_size=2, max_blocks=3)
+    assert d.publish("a", [1, 2, 3, 4], "hbm") == 2
+    assert d.publish("a", [5, 6], "hbm") == 1
+    assert d.publish("a", [7, 8], "hbm") == 0    # cap: reset, no record
+    assert d.resets_total == 1
+    assert d.longest_holder([1, 2, 3, 4]) == (0, None, None)
+    # rebuilds from subsequent publishes
+    assert d.publish("a", [1, 2], "hbm") == 1
+    assert d.longest_holder([1, 2]) == (2, "a", "hbm")
+    assert d.stats()["resets_total"] == 1
+    assert d.stats()["publishes_total"] == 3
+
+
+# --- router shadow-index cap reset (satellite regression) ------------------
+
+
+def test_shadow_index_cap_reset_counter_and_callback():
+    from pipegoose_tpu.serving.control_plane.router import Router, ShadowIndex
+
+    shadow = ShadowIndex(page_size=2, max_blocks=2)
+    fired = []
+    shadow.on_reset = fired.append
+    shadow.insert([1, 2, 3, 4])          # 2 blocks: at cap
+    assert shadow.longest_match([1, 2, 3, 4]) == 4
+    shadow.insert([5, 6])                # over cap: reset, count, notify
+    assert shadow.resets_total == 1 and fired == [shadow]
+    # the regression: a reset shadow must hold NO stale matches
+    assert shadow.longest_match([1, 2, 3, 4]) == 0
+    assert shadow.longest_match([5, 6]) == 0     # the trip insert is dropped
+    shadow.insert([5, 6])                # self-heals from the next placement
+    assert shadow.longest_match([5, 6]) == 2
+    # manual clear is not a cap reset
+    shadow.clear()
+    assert shadow.resets_total == 1
+    # the router exports the counter
+    assert Router(registry=MetricsRegistry()).stats()[
+        "shadow_resets_total"] == 0
+
+
+# --- workload sizing (satellite) -------------------------------------------
+
+
+def test_make_skewed_replay_working_set_factor():
+    from pipegoose_tpu.serving.engine import make_skewed_replay
+
+    kw = dict(n_requests=64, prefix_len=8, suffix_lens=(2,), max_new=2,
+              vocab=64, seed=3, n_prefixes=1)
+    specs = make_skewed_replay(working_set_factor=2.0, num_pages=SMALL,
+                              page_size=PS, **kw)
+    again = make_skewed_replay(working_set_factor=2.0, num_pages=SMALL,
+                               page_size=PS, **kw)
+    assert len(specs) == len(again)
+    for (p1, m1), (p2, m2) in zip(specs, again):
+        np.testing.assert_array_equal(p1, p2)
+        assert m1 == m2
+    # the drawn prefix corpus really exceeds the pool's capacity
+    uniq = {tuple(int(t) for t in p[:8]) for p, _ in specs}
+    assert len(uniq) * 8 > (SMALL - 1) * PS
+    with pytest.raises(ValueError, match="num_pages"):
+        make_skewed_replay(working_set_factor=2.0, **kw)
+    with pytest.raises(ValueError, match="working_set_factor"):
+        make_skewed_replay(working_set_factor=0.0, num_pages=SMALL,
+                           page_size=PS, **kw)
+
+
+# --- restore-vs-recompute planner ------------------------------------------
+
+
+class _FakeCostModel:
+    collective_launch_s = 1e-3
+    ici_bytes_per_s = 1e9
+    dci_bytes_per_s = 1e8
+    step_overhead_s = 1e-4
+    peak_flops = 1e12
+
+
+def test_restore_planner_hand_computed_decision():
+    p = RestorePlanner(_FakeCostModel(), n_params=1_000_000)
+    # restore: 2 launches + 1MB over ICI + overhead = 2e-3 + 1e-3 + 1e-4
+    assert p.restore_cost_s(1_000_000, n_ops=2) == pytest.approx(3.1e-3)
+    # DCI is the cross-replica fabric (10x slower here)
+    assert p.restore_cost_s(1_000_000, n_ops=2, cross_replica=True) \
+        == pytest.approx(2e-3 + 1e-2 + 1e-4)
+    # recompute 64 tokens: 1e-4 + 2*1e6*64/1e12
+    assert p.recompute_cost_s(64) == pytest.approx(1e-4 + 1.28e-4)
+    # cheap wire, expensive recompute -> restore wins at scale
+    assert p.should_restore(1024, 1024, n_ops=1)
+    # huge wire bytes vs a few tokens -> recompute wins
+    assert not p.should_restore(4, 10 ** 12, n_ops=1)
+    # no model (the CPU rig): always restore, unless floored
+    assert RestorePlanner().should_restore(4, 10 ** 12)
+    assert not RestorePlanner(min_tokens=8).should_restore(4, 1)
+    assert not RestorePlanner().should_restore(0, 1)
+
+
+# --- engine construction contracts -----------------------------------------
+
+
+def test_engine_validation_contracts(setup):
+    cfg, params, _, _ = setup
+    with pytest.raises(ValueError, match="prefix_cache"):
+        ServingEngine(params, cfg, num_slots=1, num_pages=SMALL,
+                      page_size=PS, max_context=32, prefix_cache=False,
+                      host_tier=HostTier(1 << 20),
+                      registry=MetricsRegistry())
+    with pytest.raises(ValueError, match="host_tier_wire"):
+        _engine(params, cfg, host_tier_wire="bf16")
+    with pytest.raises(ValueError, match="int8"):
+        _engine(params, cfg, kv_dtype="int8",
+                host_tier=HostTier(1 << 20), host_tier_wire="bf16")
+
+
+def test_import_reexports():
+    import pipegoose_tpu.serving.kv_tier as kt
+
+    for name in ("HostTier", "HostTierError", "set_host_tier_fault",
+                 "PrefixDirectory", "RestoreManager", "RestorePlanner"):
+        assert hasattr(kt, name), name
+
+
+# --- spill -> restore token identity (the tentpole) ------------------------
+
+
+@pytest.mark.parametrize("kv_dtype", [None, "int8"], ids=["fp", "int8kv"])
+def test_spill_restore_token_identical(setup, kv_dtype):
+    """The overflow replay through a tiered pool matches the all-HBM
+    reference token for token — the restored pages ARE the evicted
+    bytes (wire-exact, never dequantized in the hierarchy)."""
+    cfg, params, prefixes, suffixes = setup
+    ref = _engine(params, cfg, num_pages=AMPLE, kv_dtype=kv_dtype)
+    ref_outs, _, _, _ = _replay(ref, prefixes, suffixes)
+    tier = HostTier(1 << 20)
+    eng = _engine(params, cfg, host_tier=tier, kv_dtype=kv_dtype)
+    outs, _, restored, _ = _replay(eng, prefixes, suffixes)
+    _assert_streams_equal(ref_outs, outs, f"{kv_dtype or 'fp'} round trip")
+    assert tier.spills > 0, "the overflow never exercised the spill path"
+    assert restored > 0 and tier.restores > 0
+
+
+def test_overflow_replay_beats_lru_recompute(setup):
+    """Same overflow workload, same pool size: the tier strictly
+    reduces recomputed prefill tokens and strictly raises the cache
+    hit rate over plain LRU-evict-and-recompute."""
+    cfg, params, prefixes, suffixes = setup
+    lru = _engine(params, cfg, kv_dtype="int8")
+    _, lru_prefill, _, _ = _replay(lru, prefixes, suffixes)
+    eng = _engine(params, cfg, kv_dtype="int8", host_tier=HostTier(1 << 20))
+    _, tier_prefill, restored, _ = _replay(eng, prefixes, suffixes)
+    assert tier_prefill < lru_prefill, (tier_prefill, lru_prefill)
+    assert tier_prefill + restored <= lru_prefill
+
+
+def test_host_tier_bytes_pinned_at_wire_size(setup):
+    """The resident-byte census IS the wire arithmetic: int8 pages
+    cost exactly 2*L*ps*nh*(hd+4) bytes (q + scale planes, never fp),
+    fp pages exactly the pool dtype — and memory_report mirrors it."""
+    cfg, params, prefixes, suffixes = setup
+    for kv_dtype in ("int8", None):
+        tier = HostTier(1 << 20)
+        eng = _engine(params, cfg, host_tier=tier, kv_dtype=kv_dtype)
+        _replay(eng, prefixes, suffixes)
+        assert tier.resident_pages > 0
+        wire = wire_page_bytes(eng)
+        assert tier.resident_bytes == tier.resident_pages * wire
+        rep = eng.memory_report()["host_tier"]
+        assert rep["resident_bytes"] == tier.resident_bytes
+        assert rep["resident_pages"] == tier.resident_pages
+        assert rep["budget_bytes"] == tier.byte_budget
+    # the int8 page is strictly below the fp32 page on the wire
+    int8_eng = _engine(params, cfg, kv_dtype="int8")
+    fp_eng = _engine(params, cfg)
+    assert wire_page_bytes(int8_eng) < wire_page_bytes(fp_eng)
+
+
+def test_bf16_wire_for_fp32_pool_is_lossy_but_served(setup):
+    """The opt-in half-width wire on an fp32 pool: the round trip is
+    not bit-exact (documented), but requests are still served to
+    completion and the census follows the pool's FP wire arithmetic
+    (host_tier_wire changes the transfer dtype, not the census rule)."""
+    cfg, params, prefixes, suffixes = setup
+    tier = HostTier(1 << 20)
+    eng = _engine(params, cfg, host_tier=tier, host_tier_wire="bf16")
+    outs, _, restored, _ = _replay(eng, prefixes, suffixes)
+    assert len(outs) == 3 * len(suffixes)
+    assert all(len(o) > 0 for o in outs)
+    assert restored > 0
+
+
+# --- attribution -----------------------------------------------------------
+
+
+def test_attribution_sums_to_e2e_with_restore_phase(setup):
+    """queue + prefill + restore + transfer + decode + stall == e2e
+    EXACTLY for every request, with a nonzero restore phase on the
+    replayed prefix, and the serving.attrib.restore_seconds histogram
+    fed."""
+    cfg, params, prefixes, suffixes = setup
+    reg = MetricsRegistry(enabled=True)
+    tracer = RequestTracer(registry=reg, keep_completed=16)
+    eng = _engine(params, cfg, host_tier=HostTier(1 << 20), registry=reg)
+    eng.attach_tracer(tracer)
+    _, _, restored, _ = _replay(eng, prefixes, suffixes)
+    assert restored > 0
+    assert not tracer.snapshot()["in_flight"]
+    done = list(tracer.completed)
+    assert len(done) == 3 * len(suffixes)
+    for tl in done:
+        total = sum(tl.components.values())
+        assert total == pytest.approx(tl.e2e_s, abs=1e-6)
+    assert any(tl.components["restore_s"] > 0 for tl in done)
+    snap = reg.snapshot()
+    assert snap["histograms"]["serving.attrib.restore_seconds"]["count"] \
+        == len(done)
+
+
+# --- cross-replica pull ----------------------------------------------------
+
+
+@pytest.mark.parametrize("kv_dtype", [None, "int8"], ids=["fp", "int8kv"])
+def test_cross_replica_pull_token_identical(setup, kv_dtype):
+    """A cold engine pulls the warm peer's prefix pages (HBM and tier
+    entries both) instead of recomputing them — same tokens as a
+    self-contained reference, and chunked prefill resumes for the
+    suffix only."""
+    cfg, params, prefixes, suffixes = setup
+    ref = _engine(params, cfg, num_pages=AMPLE, kv_dtype=kv_dtype)
+    ref_outs, _ = ref.run(_phase(prefixes[0], suffixes))
+    peer = _engine(params, cfg, num_pages=33, kv_dtype=kv_dtype,
+                   host_tier=HostTier(1 << 20))
+    peer.run(_phase(prefixes[0], suffixes))     # warm the peer
+    puller = _engine(params, cfg, num_pages=33, kv_dtype=kv_dtype)
+    puller.set_peer_source(peer)
+    outs, m = puller.run(_phase(prefixes[0], suffixes))
+    _assert_streams_equal([o.generated for o in ref_outs],
+                          [o.generated for o in outs],
+                          f"{kv_dtype or 'fp'} pull")
+    assert m["kv_tier"]["pulls"] > 0
+    assert m["kv_tier"]["pulled_tokens"] >= 12   # the 3-page prefix
+    # the pull replaced prefix prefill: only suffix/tail tokens forwarded
+    assert m["prefill_tokens"] < sum(
+        len(r.prompt) for r in _phase(prefixes[0], suffixes))
+
+
+def test_pull_from_tier_only_peer(setup):
+    """A peer whose HBM copy was evicted (tier-only inventory) still
+    serves the pull — tier entries ship as-is, they are already wire
+    slabs."""
+    cfg, params, prefixes, suffixes = setup
+    ref = _engine(params, cfg, num_pages=AMPLE)
+    ref_outs, _ = ref.run(_phase(prefixes[0], suffixes))
+    peer = _engine(params, cfg, host_tier=HostTier(1 << 20))
+    _replay(peer, prefixes, suffixes)
+    # force prefix[0] out of the peer's HBM: run prefix[1] again
+    peer.run(_phase(prefixes[1], suffixes))
+    puller = _engine(params, cfg, num_pages=33)
+    puller.set_peer_source(peer)
+    outs, m = puller.run(_phase(prefixes[0], suffixes))
+    _assert_streams_equal([o.generated for o in ref_outs],
+                          [o.generated for o in outs], "tier-only pull")
+    assert m["kv_tier"]["pulls"] > 0
+
+
+def test_pull_tp2_peer_to_tp1_puller(setup, devices):
+    """The reshard cell: the warm peer runs tp=2 head-sharded pools,
+    the puller is a single-device engine — the host hop between the
+    jitted export and import IS the resharding point, tokens exact."""
+    cfg, params, prefixes, suffixes = setup
+    ref = _engine(params, cfg, num_pages=AMPLE)
+    ref_outs, _ = ref.run(_phase(prefixes[0], suffixes))
+    ctx = ParallelContext(tensor_parallel_size=2, data_parallel_size=4)
+    with ctx.mesh:
+        peer = _engine(params, cfg, num_pages=33, mesh=ctx.mesh,
+                       param_specs=bloom.tp_specs(params))
+        peer.run(_phase(prefixes[0], suffixes))
+        puller = _engine(params, cfg, num_pages=33)
+        puller.set_peer_source(peer)
+        outs, m = puller.run(_phase(prefixes[0], suffixes))
+    _assert_streams_equal([o.generated for o in ref_outs],
+                          [o.generated for o in outs], "tp2->tp1 pull")
+    assert m["kv_tier"]["pulls"] > 0
+
+
+# --- the fleet directory drives the pull -----------------------------------
+
+
+def test_fleet_directory_pull_token_identical(setup):
+    """Through the control plane: round-robin sends the second
+    occurrence of prefix B to a replica that never prefilled it — the
+    directory names the warm peer, the pages ship cross-replica, and
+    the fleet's outputs match a single ample-pool engine."""
+    from pipegoose_tpu.serving.control_plane.plane import ControlPlane
+
+    cfg, params, prefixes, suffixes = setup
+    A, B = prefixes
+    rng = np.random.RandomState(11)
+
+    def factory(name, reg):
+        return ServingEngine(params, cfg, num_slots=1, num_pages=24,
+                             page_size=PS, max_context=32,
+                             prefill_chunk=CHUNK, prefix_cache=True,
+                             registry=reg, host_tier=HostTier(1 << 26))
+
+    plane = ControlPlane(factory, n_replicas=2, policy="round_robin")
+    sfx = [rng.randint(1, 64, (2,)) for _ in range(3)]
+    # A -> rep0, B -> rep1, B -> rep0: rep0 must pull B from rep1
+    reqs = [Request(prompt=np.concatenate([p, s]), max_new_tokens=4)
+            for p, s in zip((A, B, B), sfx)]
+    outs, m = plane.run(reqs)
+    pulls = sum(pm.get("kv_tier", {}).get("pulls", 0)
+                for pm in m["per_replica"].values())
+    assert pulls >= 1, "the directory never drove a cross-replica pull"
+    assert m["kv_directory"]["publishes_total"] > 0
+    ref = _engine(params, cfg, num_pages=AMPLE)
+    routs, _ = ref.run([Request(prompt=o.prompt, max_new_tokens=4)
+                        for o in outs])
+    got = sorted(tuple(int(t) for t in o.generated) for o in outs)
+    want = sorted(tuple(int(t) for t in o.generated) for o in routs)
+    assert got == want, "fleet pull diverged from the reference"
+
+
+def test_plane_retracts_directory_on_drain(setup):
+    """Drain mirrors the router's shadow drop: the drained replica's
+    directory claims disappear (its cache is going away with it)."""
+    from pipegoose_tpu.serving.control_plane.plane import ControlPlane
+
+    cfg, params, prefixes, suffixes = setup
+
+    def factory(name, reg):
+        return ServingEngine(params, cfg, num_slots=1, num_pages=24,
+                             page_size=PS, max_context=32,
+                             prefill_chunk=CHUNK, prefix_cache=True,
+                             registry=reg)
+
+    plane = ControlPlane(factory, n_replicas=2, policy="round_robin")
+    plane.run([Request(prompt=np.concatenate([prefixes[0], suffixes[0]]),
+                       max_new_tokens=2)])
+    d = plane.directory
+    assert d is not None and d.longest_holder(prefixes[0])[1] is not None
+    holder = d.longest_holder(prefixes[0])[1]
+    plane.start_drain(holder)
+    plane.run([])
+    assert d.longest_holder(prefixes[0], exclude=None)[1] != holder
+
+
+# --- failure: chaos kind + fallback ----------------------------------------
+
+
+def test_host_tier_io_error_chaos_degrades_to_recompute(setup, tmp_path):
+    """The seeded chaos kind: a transient tier I/O fault mid-restore
+    falls back to recomputing the prefix — token-identical, ONE
+    consumed kv_tier_fallback black box naming the prefix, /healthz
+    never flips, nothing lost or stalled."""
+    from pipegoose_tpu.telemetry.flightrec import FlightRecorder
+    from pipegoose_tpu.testing.chaos import (
+        ChaosMonkey,
+        ChaosSchedule,
+        Injection,
+    )
+
+    cfg, params, prefixes, suffixes = setup
+    ref = _engine(params, cfg, num_pages=AMPLE)
+    ref_outs, _, _, _ = _replay(ref, prefixes, suffixes)
+    recorder = FlightRecorder(str(tmp_path), capacity=64)
+    eng = _engine(params, cfg, host_tier=HostTier(1 << 20),
+                  recorder=recorder)
+    # warm phases clean, then arm the fault for the replay that restores
+    outs = []
+    for pfx in (prefixes[0], prefixes[1]):
+        done, _ = eng.run(_phase(pfx, suffixes))
+        outs += [o.generated for o in done]
+    schedule = ChaosSchedule(
+        [Injection(1, "host_tier_io_error", (("fail_times", 1),))])
+    monkey = ChaosMonkey(schedule, recorder=recorder)
+    try:
+        done, m = eng.run(_phase(prefixes[0], suffixes),
+                          tick_hook=monkey.tick_hook)
+    finally:
+        monkey.disarm()
+    outs += [o.generated for o in done]
+    _assert_streams_equal(ref_outs, outs, "chaos fallback")
+    assert len(monkey.applied) == 1
+    assert m["kv_tier"]["fallbacks"] == 1
+    # one black box names the prefix; the trigger is already consumed
+    assert recorder.last_trigger is None, "/healthz would flip"
+    boxes = [p for p in recorder.dumps if "kv_tier_fallback" in open(p).read()]
+    assert len(boxes) == 1
+    content = open(boxes[0]).read()
+    assert str(int(prefixes[0][0])) in content
+
+
+def test_seeded_schedule_with_tier_kind_is_reproducible():
+    from pipegoose_tpu.testing.chaos import (
+        KINDS,
+        SERVING_KINDS,
+        ChaosSchedule,
+        schedule_fingerprint,
+    )
+
+    assert "host_tier_io_error" in KINDS
+    assert "host_tier_io_error" in SERVING_KINDS
+    a = ChaosSchedule.seeded(5, max_step=8, host_tier_io_error=2,
+                             transfer_flap=1)
+    b = ChaosSchedule.seeded(5, max_step=8, host_tier_io_error=2,
+                             transfer_flap=1)
+    assert schedule_fingerprint(a) == schedule_fingerprint(b)
+    assert sum(1 for i in a.injections
+               if i.kind == "host_tier_io_error") == 2
+
+
+def test_spill_fault_drops_the_copy_never_the_eviction(setup):
+    """A faulting SPILL loses only the tier copy: eviction proceeds,
+    the run completes, outputs stay correct (the tier is best-effort
+    by contract)."""
+    cfg, params, prefixes, suffixes = setup
+    ref = _engine(params, cfg, num_pages=AMPLE)
+    ref_outs, _, _, _ = _replay(ref, prefixes, suffixes)
+    tier = HostTier(1 << 20)
+    eng = _engine(params, cfg, host_tier=tier)
+
+    def boom(op, key, n_pages):
+        if op == "spill":
+            raise HostTierError("injected spill fault")
+
+    prev = set_host_tier_fault(boom)
+    try:
+        outs, _, restored, _ = _replay(eng, prefixes, suffixes)
+    finally:
+        set_host_tier_fault(prev)
+    _assert_streams_equal(ref_outs, outs, "spill fault")
+    assert tier.spills == 0 and tier.spill_drops > 0
+    assert restored == 0                 # nothing tiered, nothing restored

@@ -54,16 +54,16 @@ def test_components_are_contiguous_segments_and_sum_to_e2e(reg):
     tr.on_done(r, 6.0)                       # decode += 1.0
     (row,) = tr.attribution_summary()["requests"]
     assert row["components"] == {
-        "queue_s": 1.0, "prefill_s": 2.0, "transfer_s": 0.0,
-        "decode_s": 2.0, "stall_s": 1.0,
+        "queue_s": 1.0, "prefill_s": 2.0, "restore_s": 0.0,
+        "transfer_s": 0.0, "decode_s": 2.0, "stall_s": 1.0,
     }
     assert row["e2e_s"] == 6.0
     assert sum(row["components"].values()) == pytest.approx(row["e2e_s"])
     # TTFT decomposes from the accumulator snapshot at the first token
     assert row["ttft_s"] == 2.0
     assert row["ttft_components"] == {
-        "queue_s": 1.0, "prefill_s": 1.0, "transfer_s": 0.0,
-        "decode_s": 0.0, "stall_s": 0.0,
+        "queue_s": 1.0, "prefill_s": 1.0, "restore_s": 0.0,
+        "transfer_s": 0.0, "decode_s": 0.0, "stall_s": 0.0,
     }
     assert row["preemptions"] == 1
     # cache-savings estimate: prefill paid 2.0s for 12 forwarded tokens,
@@ -293,8 +293,8 @@ def test_transfer_phase_is_additive_and_exact(reg):
     tr.on_done(r, 5.0)                       # decode += 2.0
     (row,) = tr.attribution_summary()["requests"]
     assert row["components"] == {
-        "queue_s": 1.0, "prefill_s": 1.0, "transfer_s": 1.0,
-        "decode_s": 2.0, "stall_s": 0.0,
+        "queue_s": 1.0, "prefill_s": 1.0, "restore_s": 0.0,
+        "transfer_s": 1.0, "decode_s": 2.0, "stall_s": 0.0,
     }
     assert sum(row["components"].values()) == pytest.approx(row["e2e_s"])
     assert row["ttft_s"] == 2.0              # queue + prefill, no transfer

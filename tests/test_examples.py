@@ -18,6 +18,23 @@ ENV = {
     ),
 }
 
+# SERVING demos share the session's persistent XLA compilation cache
+# (tests/conftest.py): they jit the same tiny-config engine programs
+# the serving suite already compiled, so each subprocess starts warm.
+# Training-step demos stay uncached — this jaxlib segfaults
+# deserializing hybrid train-step executables (see conftest.py).
+SERVING_DEMOS = {
+    "serve_bloom.py", "request_trace_demo.py", "disagg_serving_demo.py",
+    "quantized_serving_demo.py", "control_plane_demo.py",
+    "kv_tier_demo.py",
+}
+CACHE_ENV = {
+    "JAX_COMPILATION_CACHE_DIR": os.environ.get(
+        "JAX_COMPILATION_CACHE_DIR", "/tmp/pipegoose_jax_cache"),
+    "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS": "0",
+    "JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES": "0",
+}
+
 CASES = [
     ("hybrid_parallelism.py", ["--fake-devices", "4", "--tp", "2", "--dp", "2"]),
     ("moe_training.py", ["--fake-devices", "8"]),
@@ -49,14 +66,16 @@ CASES = [
     ("control_plane_demo.py", ["--fake-devices", "8", "--requests", "10",
                                "--out-dir",
                                "/tmp/pipegoose_control_plane_demo_test"]),
+    ("kv_tier_demo.py", ["--fake-devices", "8", "--requests", "4"]),
 ]
 
 
 @pytest.mark.parametrize("script,args", CASES, ids=[c[0] for c in CASES])
 def test_example_runs(script, args):
+    env = {**ENV, **CACHE_ENV} if script in SERVING_DEMOS else ENV
     proc = subprocess.run(
         [sys.executable, str(REPO / "examples" / script), *args, "--steps", "2"],
-        capture_output=True, text=True, timeout=900, cwd=str(REPO), env=ENV,
+        capture_output=True, text=True, timeout=900, cwd=str(REPO), env=env,
     )
     assert proc.returncode == 0, proc.stderr[-2000:]
     assert "done:" in proc.stdout, proc.stdout[-500:]
