@@ -539,6 +539,15 @@ def run_bench(force_cpu: bool) -> None:
         reqtrace_path = os.environ.get(
             "BENCH_REQTRACE_JSON", "bench_request_trace.json"
         )
+        # fleet-trace artifact (BENCH_FLEETTRACE_JSON, default
+        # bench_fleet_trace.json; empty disables): one EXTRA traced
+        # control-plane replay AFTER the measurement whose stitched
+        # cross-replica attribution (ISSUE 17) reports per-hop p50/p99
+        # (ingress/ledger/route/dispatch/replica) plus the top-3
+        # slowest tail exemplars, each naming its dominant hop
+        fleettrace_path = os.environ.get(
+            "BENCH_FLEETTRACE_JSON", "bench_fleet_trace.json"
+        )
         was_enabled = reg.enabled
         reg.disable()
         try:
@@ -567,7 +576,8 @@ def run_bench(force_cpu: bool) -> None:
             )
 
             res["control_plane"] = control_plane_replay_benchmark(
-                sparams, scfg, seed=0, **cp_kw,
+                sparams, scfg, seed=0,
+                fleet_trace=bool(fleettrace_path), **cp_kw,
             )
             # disaggregated prefill/decode (ISSUE 13): the same skewed
             # replay through a prefill pool streaming int8 KV pages
@@ -605,6 +615,21 @@ def run_bench(force_cpu: bool) -> None:
             }, indent=1))
             res["prefix_replay"]["request_trace_summary"] = rt["summary"]
             res["prefix_replay"]["request_trace_json"] = reqtrace_path
+        if fleettrace_path and "fleet_trace" in res["control_plane"]:
+            from pipegoose_tpu.telemetry.exporters import (
+                atomic_write_text as _awt,
+                safe_json_dumps as _sjd,
+            )
+
+            # per-hop rows + exemplar traces live in the sibling
+            # artifact; the stdout payload keeps only the pointer
+            ftr = res["control_plane"].pop("fleet_trace")
+            _awt(fleettrace_path, _sjd({
+                "device": device_kind,
+                "replay": {k: v for k, v in cp_kw.items()},
+                **ftr,
+            }, indent=1))
+            res["control_plane"]["fleet_trace_json"] = fleettrace_path
         if tel is not None:
             srng = np.random.RandomState(0)
             vocab = getattr(scfg, "valid_vocab_size", None) or scfg.vocab_size

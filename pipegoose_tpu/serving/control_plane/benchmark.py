@@ -102,11 +102,20 @@ def control_plane_replay_benchmark(
         n_replicas: int = 2, num_slots: int = 1, num_pages: int = 41,
         page_size: int = 8, max_context: int = 96,
         prefill_chunk: Optional[int] = None, drain_check: bool = True,
-        drain_at_tick: int = 3, affinity_slack_tokens: int = 192):
+        drain_at_tick: int = 3, affinity_slack_tokens: int = 192,
+        fleet_trace: bool = False):
     """Measure the routing arms on one multi-tenant trace (module
     docstring); returns a JSON-able dict with per-arm rows, a summary
     (prefill-token reduction + TTFT p99 speedup of cache-aware over
-    round-robin), and the drain zero-drop verdict."""
+    round-robin), and the drain zero-drop verdict.
+
+    ``fleet_trace=True`` runs one EXTRA cache-aware replay AFTER the
+    measurement on a :class:`~pipegoose_tpu.telemetry.fleettrace.
+    FleetTracer`-equipped plane (tracing overhead never pollutes the
+    measured rows) and attaches its stitched attribution — per-hop
+    p50/p99 over ingress/ledger/route/dispatch/replica plus the top-3
+    slowest tail exemplars per objective — as ``results["fleet_
+    trace"]`` (bench.py writes it to ``bench_fleet_trace.json``)."""
     vocab = getattr(config, "valid_vocab_size", None) or config.vocab_size
     replay = make_skewed_replay(
         n_requests=n_requests, n_prefixes=n_prefixes, prefix_len=prefix_len,
@@ -204,4 +213,21 @@ def control_plane_replay_benchmark(
             "dropped": n_requests - len(drain_outs),
             "outputs_token_identical": bool(identical),
         }
+    if fleet_trace:
+        # one traced replay on a fresh cache-aware plane: the stitched
+        # per-hop attribution (conservation-exact: plane hops + replica
+        # phases == fleet e2e) plus the slowest tail exemplars, each
+        # naming its dominant hop — the "where does fleet p99 go" row
+        from pipegoose_tpu.telemetry.fleettrace import FleetTracer
+        from pipegoose_tpu.telemetry.registry import MetricsRegistry
+
+        tracer = FleetTracer(registry=MetricsRegistry(enabled=True))
+        plane = ControlPlane(factory(), n_replicas=n_replicas,
+                             policy="cache_aware", pull_hints=False,
+                             affinity_slack_tokens=affinity_slack_tokens,
+                             fleet_tracer=tracer)
+        plane.run(_requests(replay))       # compile warmup
+        tracer.reset()                     # warmup traces don't report
+        plane.run(_requests(replay))       # the traced replay
+        results["fleet_trace"] = tracer.summary_payload(top_n=3)
     return results

@@ -103,7 +103,8 @@ class ControlPlane:
                  suspect_after_ticks: int = 5,
                  failed_after_ticks: int = 20,
                  probation_ticks: int = 8,
-                 pull_hints: bool = True):
+                 pull_hints: bool = True,
+                 fleet_tracer: Optional[Any] = None):
         """``recorder``: optional ``telemetry.FlightRecorder`` — every
         replica failure dumps ONE ``replica_failure`` black box naming
         the replica and the salvaged/resubmitted/lost uids; an
@@ -118,7 +119,13 @@ class ControlPlane:
         ``pull_hints``: hint cross-replica KV pulls through the fleet
         prefix directory at placement (serving/kv_tier/); off, replicas
         recompute what their own cache misses — the routing benchmark
-        disables it to isolate placement from fleet prefix sharing."""
+        disables it to isolate placement from fleet prefix sharing.
+        ``fleet_tracer``: optional ``telemetry.fleettrace.FleetTracer``
+        — the plane mints a ``trace_id`` per ingress, marks every hop
+        hand-over, attaches one named ``RequestTracer`` per replica
+        (unless the factory attached its own), and the tracer stitches
+        them into one cross-replica timeline per request (plane hops +
+        replica phases == fleet e2e, the PR 8 contract fleet-wide)."""
         if n_replicas < 1:
             raise ValueError(f"n_replicas must be >= 1, got {n_replicas}")
         if stall_patience < 1:
@@ -143,6 +150,10 @@ class ControlPlane:
         self.replica_factory = replica_factory
         self.recorder = recorder
         self.pull_hints = pull_hints
+        self.fleettrace = fleet_tracer
+        if (fleet_tracer is not None and recorder is not None
+                and hasattr(recorder, "set_fleet_tracer")):
+            recorder.set_fleet_tracer(fleet_tracer)
         self.suspect_after_ticks = suspect_after_ticks
         self.failed_after_ticks = failed_after_ticks
         self.probation_ticks = probation_ticks
@@ -219,6 +230,20 @@ class ControlPlane:
                 _dir.publish(_name, tokens, location)
 
             engine.on_prefix_publish = _publish
+        if self.fleettrace is not None:
+            # one NAMED RequestTracer per replica (fragments the
+            # stitcher seals/joins); a factory-attached tracer is kept
+            # — shared-tracer fleets still stitch via the composite
+            # (trace_id, uid) timeline key
+            tracer = getattr(engine, "tracer", None)
+            if tracer is None:
+                from pipegoose_tpu.telemetry.reqtrace import RequestTracer
+
+                tracer = RequestTracer(registry=reg, name=name)
+                engine.attach_tracer(tracer)
+            elif getattr(tracer, "name", None) is None:
+                tracer.name = name
+            self.fleettrace.register_replica(name, tracer)
         self.replicas.append(rep)
         self.fleet.add_member(name, reg)
         if self._running:
@@ -304,6 +329,10 @@ class ControlPlane:
         self.router.drop_replica(rep.name)
         if self.directory is not None:
             self.directory.retract_replica(rep.name)
+        if self.fleettrace is not None:
+            t_leave = self._now()
+            for req in migrated:
+                self.fleettrace.on_leave(req, rep.name, t_leave, "drain")
         self._migrated.extend(migrated)
         self._m_migrated.inc(len(migrated))
         self._m_drains.inc()
@@ -334,6 +363,12 @@ class ControlPlane:
         user-visible clock starts HERE, not at replica dispatch)."""
         if req.t_submit is None:
             req.t_submit = now
+        if self.fleettrace is not None:
+            # the trace's t0 is the SAME float as req.t_submit — the
+            # stitched sum's left edge and the user-visible clock start
+            # are one number, which is what makes the conservation
+            # contract exact rather than approximate
+            self.fleettrace.on_ingress(req, req.t_submit)
         self._order[id(req)] = len(self._order)
         self.ledger.submit(req)
 
@@ -363,6 +398,8 @@ class ControlPlane:
         )
         self._reuse.discard(id(req))
         rep.inflight[id(req)] = req
+        if self.fleettrace is not None:
+            self.fleettrace.on_dispatched(req, rep.name)
         if (self.pull_hints and self.directory is not None
                 and rep.engine.kv_tier is not None):
             # fleet prefix sharing: when a PEER holds a longer prefix
@@ -377,6 +414,13 @@ class ControlPlane:
                 peer = self._peer_engine(holder)
                 if peer is not None and peer is not rep.engine:
                     rep.engine.kv_tier.hint_pull(req, peer)
+                    tracer = getattr(rep.engine, "tracer", None)
+                    if tracer is not None:
+                        # name the pull SOURCE on the timeline — the
+                        # merged Perfetto export draws its arrow from
+                        # this event's peer to the import completion
+                        tracer.annotate(req, "pull_hint", peer=holder,
+                                        matched_tokens=int(m))
         if rep.state is ReplicaState.SUSPECT:
             rep.note_probe(tick)
             return [c for c in cands if c is not rep]
@@ -400,6 +444,8 @@ class ControlPlane:
         cands = [rep for rep in self.replicas
                  if self._dispatchable(rep, tick)]
         placed = 0
+        if self.fleettrace is not None:
+            self.fleettrace.on_dispatch_pass(now)
         still: List[Request] = []
         for req in self._migrated:
             rep = self.router.route(req, cands, now, seq=self._seq)
@@ -407,6 +453,8 @@ class ControlPlane:
                 still.append(req)
                 continue
             self._seq += 1
+            if self.fleettrace is not None:
+                self.fleettrace.on_routed(req, now, rep.name)
             cands = self._place(req, rep, cands, tick)
             placed += 1
         self._migrated = still
@@ -421,6 +469,9 @@ class ControlPlane:
         if free_slots < 1:
             return placed
         batch = self.ledger.next_batch(free_slots)
+        if self.fleettrace is not None:
+            for req in batch:
+                self.fleettrace.on_ledger_pop(req, now)
         for i, req in enumerate(batch):
             rep = self.router.route(req, cands, now, seq=self._seq)
             if rep is None:
@@ -433,6 +484,8 @@ class ControlPlane:
                     self.ledger.requeue_front(r)
                 break
             self._seq += 1
+            if self.fleettrace is not None:
+                self.fleettrace.on_routed(req, now, rep.name)
             cands = self._place(req, rep, cands, tick)
             self._m_dispatched.inc()
             placed += 1
@@ -465,11 +518,15 @@ class ControlPlane:
             reg.histogram(
                 f"serving.tenant.{tenant}.e2e_latency_seconds"
             ).observe(out.e2e_latency_s)
+        if self.fleettrace is not None:
+            self.fleettrace.on_finished(req, out)
         self._outputs[self._seq_for(req)] = out
 
     def _shed_expired(self, now: float) -> None:
         for req in self.ledger.shed_expired(now):
             self._m_shed.inc()
+            if self.fleettrace is not None:
+                self.fleettrace.on_plane_shed(req, req.t_done)
             tenant = req.tenant or "default"
             self.registry.counter(
                 f"serving.tenant.{tenant}.requests_total").inc()
@@ -587,7 +644,14 @@ class ControlPlane:
                     resubmitted.append(req.uid)
                 except Exception:  # noqa: BLE001 - truly gone
                     lost.append(req.uid)
+                    if self.fleettrace is not None:
+                        self.fleettrace.on_lost(req, self._now())
                     continue
+            if self.fleettrace is not None:
+                # seal the fragment on the dead replica: its wait to
+                # re-route books as the salvage hop from here
+                self.fleettrace.on_leave(req, rep.name, self._now(),
+                                         "salvage")
             self._migrated.append(req)
         rep.inflight.clear()
         rep.salvaged_out += len(salvaged) + len(resubmitted)
@@ -603,6 +667,15 @@ class ControlPlane:
         # overwrites last_trigger, and the recovered path below would
         # otherwise consume-and-clear a problem that is still real
         pending = self.recorder.last_trigger
+        exemplar = None
+        if self.fleettrace is not None:
+            try:
+                # the slowest completed fleet trace, dominant hop named
+                # — so the box answers "what does this failure COST"
+                # with a concrete request instead of bare counts
+                exemplar = self.fleettrace.exemplar("e2e")
+            except Exception:  # noqa: BLE001 - forensics must not raise
+                exemplar = None
         trig = self.recorder.fire_trigger(
             "replica_failure",
             f"replica {rep.name} failed at tick {tick}: {reason} — "
@@ -612,6 +685,7 @@ class ControlPlane:
             details={
                 "replica": rep.name,
                 "reason": reason,
+                "exemplar": exemplar,
                 "salvaged_uids": salvaged,
                 "resubmitted_uids": resubmitted,
                 "completed_uids": completed,
@@ -665,6 +739,8 @@ class ControlPlane:
         if self._running:
             raise RuntimeError("control plane is already running")
         self._now = now
+        if self.fleettrace is not None:
+            self.fleettrace.set_clock(now)
         self._running = True
         self._outputs = {}
         self._order = {}

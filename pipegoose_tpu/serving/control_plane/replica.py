@@ -220,6 +220,14 @@ class Replica:
             out["probation_ticks_left"] = self.probation_ticks_left
         if self.state is ReplicaState.SUSPECT:
             out["probe_backoff"] = self.probe_backoff
+        if self.inflight:
+            # (trace_id, uid) per in-flight request, so /debug/fleet
+            # rows join straight onto /debug/trace without a search
+            out["inflight"] = sorted(
+                ((getattr(r, "trace_id", None), r.uid)
+                 for r in self.inflight.values()),
+                key=lambda p: (p[0] is None, p[0] or 0, p[1] or 0),
+            )
         if self.state not in (ReplicaState.STOPPED, ReplicaState.FAILED):
             out["load"] = self.engine.sched.capacity_snapshot()
             if cache is not None:

@@ -182,6 +182,10 @@ class Router:
             "t": now,
             "seq": seq,   # control-plane dispatch sequence (uid is
             # replica-local and not assigned until the target submits)
+            # trace_id is the FLEET-stable identity (fleettrace.py):
+            # it joins this decision to the stitched timeline a uid
+            # cannot (uids change per leg, trace_ids never do)
+            "trace_id": getattr(req, "trace_id", None),
             "tenant": req.tenant,
             "replica": chosen.name,
             "policy": self.policy,
@@ -278,6 +282,7 @@ class Router:
         self.decisions.append({
             "t": now,
             "seq": seq,
+            "trace_id": getattr(req, "trace_id", None),
             "tenant": req.tenant,
             "policy": "disagg",
             "replica": decode.name,      # the pin: where the KV lands

@@ -274,6 +274,11 @@ class DecodeWorker:
         self.fallbacks += 1
         if self.owner is not None:
             self.owner._m_fallbacks.inc()
+        tr = self.engine.tracer
+        if tr is not None:
+            # stitched fleet traces surface WHY this leg re-prefilled
+            # locally instead of admitting the transferred pages
+            tr.annotate(req, "disagg_fallback")
         self.engine.submit_request(req, reuse_uid=True)
 
     def _observe(self, rec: PageHandoff, req: Request, t0: float,

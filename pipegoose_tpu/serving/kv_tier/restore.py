@@ -442,6 +442,10 @@ class RestoreManager:
             eng.sched.abort_transfer(req)
             req.clear_residency()
             eng.sched.submit(req, now(), reuse_uid=True)
+            if tr is not None:
+                # stitched fleet traces surface the degraded pull: the
+                # leg recomputed instead of importing the peer's pages
+                tr.annotate(req, "tier_fallback", path="cross-replica pull")
             self._fallback_box("cross-replica pull", req,
                                tuple(toks[:ps]), exc)
             return "no"

@@ -83,6 +83,15 @@ class Request:
     tenant: Optional[str] = None
 
     uid: Optional[int] = None
+    # fleet-trace identity (telemetry/fleettrace.py): minted once at
+    # ControlPlane.submit ingress and carried by THIS object through
+    # every dispatch, drain migration, crash salvage, disagg handoff
+    # and kv-tier pull — uids are replica-local (and reused by design
+    # on salvage), so the trace_id is the only safe cross-replica join
+    # key. None for requests that never crossed a control plane.
+    # Deliberately NOT scrubbed by clear_residency(): identity, like
+    # timestamps, survives the degraded salvage path.
+    trace_id: Optional[int] = None
     status: Status = Status.QUEUED
     generated: List[int] = field(default_factory=list)
     slot: Optional[int] = None

@@ -50,6 +50,11 @@ from pipegoose_tpu.telemetry.fleet import (
     merge_histograms,
     merge_metrics,
 )
+from pipegoose_tpu.telemetry.fleettrace import (
+    FleetTracer,
+    TailSampler,
+    fleet_trace_events,
+)
 from pipegoose_tpu.telemetry.opsserver import OpsServer, parse_prometheus_text
 from pipegoose_tpu.telemetry.reqtrace import (
     RequestTimeline,
@@ -123,6 +128,7 @@ __all__ = [
     "Counter",
     "DoctorReport",
     "FleetRegistry",
+    "FleetTracer",
     "FlightRecorder",
     "Gauge",
     "Histogram",
@@ -141,6 +147,7 @@ __all__ = [
     "StepProfile",
     "SLOMonitor",
     "SLOTarget",
+    "TailSampler",
     "ShardingRegressionError",
     "ShardingReport",
     "TelemetryCallback",
@@ -155,6 +162,7 @@ __all__ = [
     "diagnose",
     "disable",
     "enable",
+    "fleet_trace_events",
     "get_registry",
     "hbm_utilization",
     "health_stats",

@@ -40,6 +40,10 @@ PID_HOST = 1        # host-side spans (trainer/serving/decode driver)
 PID_PIPELINE = 2    # theoretical pipeline clock timeline
 PID_REQUESTS = 3    # per-request serving timelines (telemetry/reqtrace.py)
 PID_FLEET = 4       # control-plane router decisions (one track per replica)
+PID_PLANE = 5       # control-plane hop slices (telemetry/fleettrace.py)
+# multi-replica request timelines get one process EACH, allocated from
+# here up (the first tracer keeps PID_REQUESTS for backward compat)
+REPLICA_PID_BASE = 10
 
 
 def span_events_to_trace(
@@ -262,6 +266,12 @@ class ChromeTraceExporter:
         self._events: deque = deque(maxlen=self.max_events)
         self._extra: List[dict] = []        # pre-rendered trace events
         self._tids: Dict[int, int] = {}     # thread ident -> compact tid
+        # tracer identity -> pid for add_request_timelines: the FIRST
+        # tracer keeps the historical PID_REQUESTS; every further
+        # tracer (a second replica sharing this exporter) gets its own
+        # process from REPLICA_PID_BASE up, so multi-replica exports
+        # never interleave slot tracks on one pid
+        self._request_pids: Dict[int, int] = {}
         self._dropped = 0
         self._registry = registry
         if registry is not None:
@@ -294,10 +304,32 @@ class ChromeTraceExporter:
     def add_request_timelines(self, tracer: Any, **kwargs: Any) -> None:
         """Attach a ``RequestTracer``'s per-slot request timelines (see
         ``telemetry.reqtrace.request_trace_events``) as their own
-        process group next to the host spans and pipeline rows."""
+        process group next to the host spans and pipeline rows. Each
+        DISTINCT tracer gets its own pid (named after ``tracer.name``
+        when set), so a fleet's replicas render as separate processes
+        instead of interleaving slot tracks; pass ``pid=`` to pin one
+        explicitly."""
         from pipegoose_tpu.telemetry.reqtrace import request_trace_events
 
+        if "pid" not in kwargs:
+            with self._lock:
+                pid = self._request_pids.get(id(tracer))
+                if pid is None:
+                    pid = (PID_REQUESTS if not self._request_pids
+                           else REPLICA_PID_BASE
+                           + len(self._request_pids) - 1)
+                    self._request_pids[id(tracer)] = pid
+            kwargs["pid"] = pid
         self.add_events(request_trace_events(tracer, **kwargs))
+
+    def add_fleet_trace(self, fleet: Any) -> None:
+        """Attach a ``FleetTracer``'s merged cross-replica export (see
+        ``telemetry.fleettrace.fleet_trace_events``): the plane hop
+        track plus one process per registered replica with flow arrows
+        binding dispatch->admit, handoff transfers, and peer pulls."""
+        from pipegoose_tpu.telemetry.fleettrace import fleet_trace_events
+
+        self.add_events(fleet_trace_events(fleet))
 
     def add_router_decisions(self, decisions: Iterable[dict],
                              **kwargs: Any) -> None:

@@ -120,6 +120,10 @@ class FlightRecorder(Callback):
         # black box embeds the in-flight + last-N completed request
         # timelines, so a decode_stall dump NAMES the stuck request
         self._req_tracer = None
+        # fleet-trace context (telemetry/fleettrace.py): when set, a
+        # black box embeds the stitched cross-replica tail exemplars,
+        # so an slo_burn/replica_failure dump NAMES the dominant hop
+        self._fleet_tracer = None
         self.records: deque = deque(maxlen=capacity)
         self.dumps: List[str] = []
         self.last_trigger: Optional[TriggerEvent] = None
@@ -314,6 +318,12 @@ class FlightRecorder(Callback):
         embeds (``ServingEngine`` wires this when given both)."""
         self._req_tracer = tracer
 
+    def set_fleet_tracer(self, tracer: Any) -> None:
+        """Attach a ``telemetry.fleettrace.FleetTracer`` whose stitched
+        tail exemplars every subsequent black-box dump embeds
+        (``ControlPlane`` wires this when given both)."""
+        self._fleet_tracer = tracer
+
     def fire_trigger(
         self, name: str, reason: str, step: int,
         context: Optional[dict] = None,
@@ -433,6 +443,13 @@ class FlightRecorder(Callback):
             try:
                 payload["request_timelines"] = (
                     self._req_tracer.blackbox_payload()
+                )
+            except Exception:  # noqa: BLE001 - never let forensics crash
+                pass
+        if self._fleet_tracer is not None:
+            try:
+                payload["fleet_traces"] = (
+                    self._fleet_tracer.blackbox_payload()
                 )
             except Exception:  # noqa: BLE001 - never let forensics crash
                 pass

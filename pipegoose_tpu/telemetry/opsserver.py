@@ -36,6 +36,15 @@ background HTTP endpoint over the same telemetry objects:
                           (serving/control_plane/): per-replica state +
                           load, router stats, per-tenant fair-share
                           ledger, autoscaler audit log.
+- ``GET /debug/trace``    one stitched cross-replica fleet trace from
+                          the ``FleetTracer`` (telemetry/fleettrace.py)
+                          selected by ``?trace_id=`` or ``?uid=`` —
+                          plane hops + per-replica legs + dominant-hop
+                          attribution for ONE request.
+- ``GET /debug/tail``     the fleet tail sampler: the slowest completed
+                          fleet traces per objective (ttft, e2e), each
+                          with its dominant hop — "where is the p99
+                          actually going, which replica, which phase".
 
 Operational posture: rank-0-filtered (non-zero ranks never bind a
 socket — same ``RankFilter`` convention as the file exporters),
@@ -51,6 +60,7 @@ from __future__ import annotations
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional, Tuple
+from urllib.parse import parse_qs
 
 from pipegoose_tpu.telemetry.registry import MetricsRegistry, get_registry
 from pipegoose_tpu.utils.procindex import RankFilter as _RankFilter
@@ -87,6 +97,9 @@ class OpsServer:
     (e.g. ``control_plane.fleet_status``) behind ``/debug/fleet`` —
     per-replica state + load, router stats, per-tenant shares, the
     autoscaler audit log.
+    ``fleettrace``: optional ``telemetry.fleettrace.FleetTracer``
+    behind ``/debug/trace`` (one stitched trace by ``?trace_id=`` /
+    ``?uid=``) and ``/debug/tail`` (slowest-trace exemplars).
     """
 
     def __init__(
@@ -103,6 +116,7 @@ class OpsServer:
         profile: Optional[Any] = None,
         plan: Optional[Any] = None,
         fleet: Optional[Any] = None,
+        fleettrace: Optional[Any] = None,
     ):
         self.registry = registry if registry is not None else get_registry()
         self.host = host
@@ -115,6 +129,7 @@ class OpsServer:
         self._profile = profile
         self._plan = plan
         self._fleet = fleet
+        self.fleettrace = fleettrace
         self._lock = threading.Lock()
         # SLOMonitor mutates per-target state on evaluate(), so
         # concurrent /healthz probes must serialize — but on its OWN
@@ -161,6 +176,12 @@ class OpsServer:
         """Attach (or replace) the provider behind ``/debug/fleet``."""
         with self._lock:
             self._fleet = fleet
+
+    def set_fleettrace(self, fleettrace: Any) -> None:
+        """Attach (or replace) the ``FleetTracer`` behind
+        ``/debug/trace`` and ``/debug/tail``."""
+        with self._lock:
+            self.fleettrace = fleettrace
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -260,6 +281,36 @@ class OpsServer:
         with self._lock:
             return self.tracer.snapshot()
 
+    def debug_trace(self, query: Dict[str, str]) -> Tuple[int, Any]:
+        """(status_code, body) for ``/debug/trace?trace_id=``/``?uid=``:
+        one stitched cross-replica trace. trace_id is the fleet-stable
+        key; uid resolves through the tracer's dispatch index (uids are
+        replica-local, so the MOST RECENT dispatch wins a reused uid)."""
+        ft = self.fleettrace
+        if ft is None:
+            return 404, {"error": "no fleet tracer attached"}
+        try:
+            uid = int(query["uid"]) if "uid" in query else None
+            trace_id = (int(query["trace_id"])
+                        if "trace_id" in query else None)
+        except ValueError:
+            return 400, {"error": "uid/trace_id must be integers"}
+        if uid is None and trace_id is None:
+            return 400, {"error": "pass ?trace_id=N or ?uid=N"}
+        payload = ft.trace_json(uid=uid, trace_id=trace_id)
+        if payload is None:
+            return 404, {"error": "no trace for "
+                         f"trace_id={trace_id} uid={uid}"}
+        return 200, payload
+
+    def debug_tail(self) -> Tuple[int, Any]:
+        """(status_code, body) for ``/debug/tail``: the slowest
+        completed fleet traces per objective with dominant hops."""
+        ft = self.fleettrace
+        if ft is None:
+            return 404, {"error": "no fleet tracer attached"}
+        return 200, ft.tail_payload()
+
 
 def _make_handler(ops: OpsServer):
     """Handler class closed over the server object (BaseHTTPRequestHandler
@@ -299,6 +350,17 @@ def _make_handler(ops: OpsServer):
                                               "attached"})
                     else:
                         self._send_json(200, payload)
+                elif path == "/debug/trace":
+                    parts = self.path.split("?", 1)
+                    query = {
+                        k: v[-1]
+                        for k, v in parse_qs(parts[1]).items()
+                    } if len(parts) == 2 else {}
+                    code, payload = ops.debug_trace(query)
+                    self._send_json(code, payload)
+                elif path == "/debug/tail":
+                    code, payload = ops.debug_tail()
+                    self._send_json(code, payload)
                 elif path in _PROVIDER_ENDPOINTS:
                     attr, label = _PROVIDER_ENDPOINTS[path]
                     report = ops._resolve_provider(attr)
@@ -314,7 +376,8 @@ def _make_handler(ops: OpsServer):
                         "endpoints": ["/metrics", "/healthz",
                                       "/debug/requests", "/debug/doctor",
                                       "/debug/profile", "/debug/plan",
-                                      "/debug/fleet"],
+                                      "/debug/fleet", "/debug/trace",
+                                      "/debug/tail"],
                     })
                 else:
                     self._send_json(404, {"error": f"unknown path {path!r}"})

@@ -85,8 +85,8 @@ class RequestTimeline:
     """
 
     __slots__ = (
-        "uid", "tenant", "prompt_len", "max_new_tokens", "slot", "events",
-        "dropped",
+        "uid", "trace_id", "tenant", "prompt_len", "max_new_tokens",
+        "slot", "events", "dropped",
         "t_submit", "t_first_token", "t_done", "finish_reason",
         "components", "ttft_s", "ttft_components", "e2e_s",
         "hit_tokens", "prefill_tokens", "prefill_chunks", "cow_copies",
@@ -98,8 +98,13 @@ class RequestTimeline:
         "cache_saved_est_s", "_phase", "_t_phase",
     )
 
-    def __init__(self, uid: int, max_events: int):
+    def __init__(self, uid: int, max_events: int,
+                 trace_id: Optional[int] = None):
         self.uid = uid
+        # fleet-trace join key (telemetry/fleettrace.py): None outside
+        # a control plane. uids are replica-local AND deliberately
+        # reused on salvage, so cross-replica stitching keys on this.
+        self.trace_id = trace_id
         self.tenant: Optional[str] = None
         self.prompt_len = 0
         self.max_new_tokens = 0
@@ -160,6 +165,7 @@ class RequestTimeline:
         """JSON-able attribution record (the ``serving.attrib.*`` shape)."""
         out: Dict[str, Any] = {
             "uid": self.uid,
+            "trace_id": self.trace_id,
             "tenant": self.tenant,
             "prompt_len": self.prompt_len,
             "components": dict(self.components),
@@ -277,6 +283,16 @@ class NullRequestTracer:
     def on_shed(self, req: Any, t: float) -> None:
         pass
 
+    def annotate(self, req: Any, kind: str, t: Optional[float] = None,
+                 **fields: Any) -> None:
+        """Free-form forensic marker (no phase change, no accounting) —
+        the fleet paths use it to stamp routing context onto the
+        replica-side timeline: ``pull_hint`` (peer a kv-tier pull was
+        hinted from), ``disagg_fallback`` (shipment failed, local
+        re-prefill), ``tier_fallback`` (host-tier read failed,
+        recompute)."""
+        pass
+
 
 #: Shared no-op instance — handy where an always-callable tracer is
 #: wanted instead of a ``None`` guard (the engine itself guards).
@@ -301,7 +317,7 @@ class RequestTracer(NullRequestTracer):
     """
 
     __slots__ = (
-        "registry", "clock", "max_events", "keep_completed",
+        "registry", "clock", "max_events", "keep_completed", "name",
         "in_flight", "completed", "_wall_offset", "_lock",
         "_h_queue", "_h_prefill", "_h_restore", "_h_transfer",
         "_h_decode", "_h_stall",
@@ -312,7 +328,8 @@ class RequestTracer(NullRequestTracer):
 
     def __init__(self, registry: Optional[MetricsRegistry] = None,
                  max_events: int = 256, keep_completed: int = 64,
-                 clock: Callable[[], float] = time.perf_counter):
+                 clock: Callable[[], float] = time.perf_counter,
+                 name: Optional[str] = None):
         if max_events < 8:
             raise ValueError(f"max_events must be >= 8, got {max_events}")
         if keep_completed < 1:
@@ -323,7 +340,16 @@ class RequestTracer(NullRequestTracer):
         self.clock = clock
         self.max_events = int(max_events)
         self.keep_completed = int(keep_completed)
-        self.in_flight: Dict[int, RequestTimeline] = {}
+        # display identity for multi-tracer exports: the control plane
+        # names each replica's tracer after the replica so the merged
+        # Perfetto export gets one labelled process per replica
+        self.name = name
+        # keyed by (trace_id, uid), NOT bare uid: a salvaged reuse_uid
+        # request keeps its uid across replicas by design, so two
+        # replicas sharing one tracer would otherwise silently merge
+        # two half-timelines into one record (regression-pinned in
+        # tests/telemetry/test_fleettrace.py)
+        self.in_flight: Dict[Any, RequestTimeline] = {}
         self.completed: deque = deque(maxlen=self.keep_completed)
         # wall-clock anchor so Perfetto rows line up with the span rows
         # (which timestamp with time.time()) despite the perf_counter
@@ -358,17 +384,28 @@ class RequestTracer(NullRequestTracer):
     def wall_offset(self) -> float:
         return self._wall_offset
 
+    @staticmethod
+    def _key(req: Any) -> Any:
+        """In-flight map key: (trace_id, uid). For untraced requests
+        (trace_id None — any engine outside a control plane) this
+        degrades to the historical bare-uid keying; for fleet requests
+        it keeps a salvaged reuse_uid request's second-replica fragment
+        distinct from any same-uid stranger on a shared tracer."""
+        return (getattr(req, "trace_id", None), req.uid)
+
     def _get(self, req: Any, t: float) -> RequestTimeline:
         """Timeline for ``req`` (created lazily: a tracer attached
         mid-flight starts accounting from the first event it sees)."""
-        tl = self.in_flight.get(req.uid)
+        key = self._key(req)
+        tl = self.in_flight.get(key)
         if tl is None:
-            tl = RequestTimeline(req.uid, self.max_events)
+            tl = RequestTimeline(req.uid, self.max_events,
+                                 trace_id=key[0])
             tl.tenant = getattr(req, "tenant", None)
             tl.prompt_len = int(req.prompt_len)
             tl.max_new_tokens = int(req.max_new_tokens)
             tl.t_submit = t
-            self.in_flight[req.uid] = tl
+            self.in_flight[key] = tl
         return tl
 
     # -- lifecycle hooks (Scheduler) ---------------------------------------
@@ -422,7 +459,7 @@ class RequestTracer(NullRequestTracer):
 
     def on_done(self, req: Any, t: float) -> None:
         with self._lock:
-            tl = self.in_flight.pop(req.uid, None)
+            tl = self.in_flight.pop(self._key(req), None)
             if tl is None:
                 return
             tl.transition(None, t)
@@ -459,7 +496,7 @@ class RequestTracer(NullRequestTracer):
         distributions with it would mask exactly the degradation
         shedding is supposed to make visible."""
         with self._lock:
-            tl = self.in_flight.pop(req.uid, None)
+            tl = self.in_flight.pop(self._key(req), None)
             if tl is None:
                 return
             tl.transition(None, t)
@@ -514,6 +551,18 @@ class RequestTracer(NullRequestTracer):
             tl.spec_accepted += int(accepted)
             tl.add_event("spec", t, dur_s=dur_s, drafted=int(drafted),
                          accepted=int(accepted))
+
+    def annotate(self, req: Any, kind: str, t: Optional[float] = None,
+                 **fields: Any) -> None:
+        """Forensic marker on the request's timeline: one ring event,
+        no phase transition, no component accounting — so fleet paths
+        (pull hints, fallback verdicts) can stamp context without ever
+        perturbing the sum-to-e2e contract."""
+        if t is None:
+            t = self.clock()
+        with self._lock:
+            tl = self._get(req, t)
+            tl.add_event(kind, t, **fields)
 
     # -- disagg transfer hooks (serving/disagg/) ---------------------------
 
@@ -656,8 +705,9 @@ class RequestTracer(NullRequestTracer):
         }
 
 
-def request_trace_events(tracer: RequestTracer, *, pid: Optional[int] = None
-                         ) -> List[dict]:
+def request_trace_events(tracer: RequestTracer, *,
+                         pid: Optional[int] = None,
+                         process_name: Optional[str] = None) -> List[dict]:
     """Render a tracer's timelines as Perfetto ``trace_event`` rows —
     ONE TRACK PER DECODE SLOT (plus a queue track for pre-admission and
     preempted waits), phase slices (``req<uid> prefill`` /
@@ -669,6 +719,10 @@ def request_trace_events(tracer: RequestTracer, *, pid: Optional[int] = None
 
     if pid is None:
         pid = PID_REQUESTS
+    if process_name is None:
+        name = getattr(tracer, "name", None)
+        process_name = (f"serving requests ({name})" if name
+                        else "serving requests (per-slot timelines)")
     off = tracer.wall_offset
     queue_tid = 1_000  # after any realistic slot count
     transfer_tid = 2_000  # disagg cross-pool page streaming track
@@ -676,7 +730,7 @@ def request_trace_events(tracer: RequestTracer, *, pid: Optional[int] = None
     events: List[dict] = [
         {
             "name": "process_name", "ph": "M", "pid": pid,
-            "args": {"name": "serving requests (per-slot timelines)"},
+            "args": {"name": process_name},
         },
         {
             "name": "thread_name", "ph": "M", "pid": pid, "tid": queue_tid,

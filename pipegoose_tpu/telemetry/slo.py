@@ -109,8 +109,11 @@ class SLOMonitor:
 
     ``recorder``: optional ``telemetry.FlightRecorder`` — a breach
     transition fires a structured ``slo_burn`` trigger (black-box dump)
-    through it. ``clock`` is injectable for tests (defaults to
-    ``time.monotonic``; only deltas are used).
+    through it. ``exemplars``: optional zero-arg callable (typically
+    ``FleetTracer.exemplar``) whose result is embedded in the trigger
+    details as ``exemplar`` — the slowest stitched fleet trace naming
+    the dominant hop behind the burn. ``clock`` is injectable for tests
+    (defaults to ``time.monotonic``; only deltas are used).
     """
 
     def __init__(
@@ -124,6 +127,7 @@ class SLOMonitor:
         recorder: Optional[Any] = None,
         clock: Callable[[], float] = time.monotonic,
         history: int = 1024,
+        exemplars: Optional[Callable[[], Any]] = None,
     ):
         if not targets:
             raise ValueError("SLOMonitor needs at least one target")
@@ -146,6 +150,11 @@ class SLOMonitor:
         self.burn_threshold = float(burn_threshold)
         self.recorder = recorder
         self.clock = clock
+        # zero-arg provider of a tail exemplar (typically
+        # FleetTracer.exemplar): a breach black box then NAMES the
+        # slowest stitched fleet trace and its dominant hop, so the
+        # page says "replica_1:stall_s" instead of just "e2e burning"
+        self.exemplars = exemplars
         self._state = {t.name: _TargetState(history) for t in self.targets}
         self._evals = 0
 
@@ -235,6 +244,12 @@ class SLOMonitor:
                 st.alerts += 1
                 reg.counter("slo.alerts_total").inc()
                 if self.recorder is not None:
+                    exemplar = None
+                    if self.exemplars is not None:
+                        try:
+                            exemplar = self.exemplars()
+                        except Exception:  # noqa: BLE001 - an exemplar
+                            pass  # provider bug must not eat the page
                     self.recorder.fire_trigger(
                         "slo_burn",
                         f"SLO {target.name!r} burning at "
@@ -250,6 +265,7 @@ class SLOMonitor:
                             "burn_slow": burn_slow,
                             "bad_fraction_fast": rate_fast,
                             "events_fast": n_fast,
+                            "exemplar": exemplar,
                         },
                     )
             st.breaching = breaching

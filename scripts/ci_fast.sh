@@ -502,6 +502,79 @@ print(f"kv-tier smoke OK: {tier.spills} page(s) spilled, "
       f"{tier.resident_pages} x {wire} B int8 wire slabs resident")
 PY
 
+# Fleet-trace smoke (telemetry/fleettrace.py, ISSUE 17): a 2-replica
+# plane with a seeded replica_crash mid-run — every stitched
+# cross-replica trace (plane hops + per-replica phases, INCLUDING the
+# salvaged request's victim + survivor legs) must sum to its fleet e2e
+# at 1e-6, and the replica_failure black box must embed a tail
+# exemplar naming the dominant hop. The distributed-tracing exactness
+# contract stays exercised on every CI run before the tier proper.
+echo "== fleet-trace smoke (2 replicas, stitched crash-salvage trace) =="
+env $JAX_SERVING_CACHE_ENV python - <<'PY'
+import json
+import tempfile
+
+from pipegoose_tpu.testing import ChaosMonkey, ChaosSchedule, force_cpu_devices
+from pipegoose_tpu.testing.chaos import Injection
+
+force_cpu_devices(1)
+
+import jax
+
+from pipegoose_tpu.models import bloom
+from pipegoose_tpu.serving import Request, ServingEngine, make_skewed_replay
+from pipegoose_tpu.serving.control_plane import ControlPlane
+from pipegoose_tpu.telemetry import FleetTracer, FlightRecorder
+from pipegoose_tpu.telemetry.registry import MetricsRegistry
+
+cfg = bloom.BloomConfig(vocab_size=64, hidden_size=32, n_layer=2, n_head=2)
+params = bloom.init_params(cfg, jax.random.PRNGKey(0))
+replay = make_skewed_replay(n_requests=8, n_prefixes=3, prefix_len=32,
+                            suffix_lens=(2, 4), max_new=2, vocab=64,
+                            seed=0, n_tenants=2)
+
+def factory(name, registry):
+    return ServingEngine(params, cfg, num_slots=1, num_pages=33,
+                         page_size=8, max_context=96, prefix_cache=True,
+                         registry=registry)
+
+out = tempfile.mkdtemp(prefix="fleettrace_smoke_")
+reg = MetricsRegistry(enabled=True)
+ft = FleetTracer(registry=reg)
+recorder = FlightRecorder(out, capacity=64)
+plane = ControlPlane(factory, n_replicas=2, registry=reg,
+                     recorder=recorder, fleet_tracer=ft)
+monkey = ChaosMonkey(
+    ChaosSchedule([Injection(4, "replica_crash", (("replica", 1),))]),
+    recorder=recorder,
+)
+outs, _ = plane.run(
+    [Request(prompt=p, max_new_tokens=m, tenant=t) for p, m, t in replay],
+    tick_hook=monkey.fleet_hook,
+)
+assert len(outs) == 8 and len(monkey.applied) == 1, len(outs)
+done = [t for t in ft.completed if not t.lost]
+assert len(done) == 8, len(done)
+salvaged = [t for t in done if len(t.legs) > 1]
+assert salvaged, "crash produced no multi-leg stitched trace"
+for t in done:
+    row = t.attribution()
+    assert abs(row["stitched_total_s"] - t.e2e_s) < 1e-6, (
+        t.trace_id, row["stitched_total_s"], t.e2e_s)
+    for leg in t.legs:
+        assert leg["timeline"].trace_id == t.trace_id
+box_path = [p for p in recorder.dumps if "replica_failure" in p][0]
+with open(box_path) as f:
+    box = json.load(f)
+ex = box["trigger"]["details"]["exemplar"]
+assert ex and ex["dominant_hop"], "black box lost its exemplar"
+assert "fleet_traces" in box, "flight recorder dropped the trace embed"
+print(f"fleet-trace smoke OK: {len(done)} stitched traces exact at 1e-6 "
+      f"({len(salvaged)} salvaged across replicas, "
+      f"{max(len(t.legs) for t in done)} legs max); replica_failure "
+      f"exemplar names {ex['dominant_hop']}")
+PY
+
 echo "== fast tier =="
 python -m pytest tests/ -q -m fast -p no:cacheprovider \
     --continue-on-collection-errors "$@"

@@ -101,6 +101,7 @@ FAST_FILES = {
     "tests/telemetry/test_flightrec.py",        # flight recorder (host-only)
     "tests/telemetry/test_chrometrace.py",      # Perfetto export + bubble
     "tests/telemetry/test_reqtrace.py",         # request tracing + attribution
+    "tests/telemetry/test_fleettrace.py",       # fleet trace stitching (ISSUE 17)
     "tests/telemetry/test_slo.py",              # SLO burn-rate monitor
     "tests/telemetry/test_opsserver.py",        # live ops endpoint
     "tests/telemetry/test_sentinel.py",         # perf-regression sentinel
@@ -263,6 +264,14 @@ FAST_TESTS = {
     "tests/serving/test_kv_tier.py::test_spill_restore_token_identical[int8kv]",
     "tests/serving/test_kv_tier.py::test_attribution_sums_to_e2e_with_restore_phase",
     "tests/serving/test_kv_tier.py::test_host_tier_io_error_chaos_degrades_to_recompute",
+    # fleet request tracing (ISSUE 17): the crash-salvage conservation
+    # cell (stitched plane hops + both replica legs == e2e at 1e-6
+    # through a seeded crash) and the host_stall SLO-exemplar
+    # acceptance pin; the pure-unit layer rides its whole-file entry
+    # and the remaining matrix cells (drain, pull, disagg, int8) stay
+    # tier-1
+    "tests/serving/test_fleet_trace.py::test_crash_salvage_conservation[fp]",
+    "tests/serving/test_fleet_trace.py::test_host_stall_slo_exemplar_names_dominant_hop",
 }
 
 

@@ -1,0 +1,333 @@
+"""Fleet-wide distributed request tracing (telemetry/fleettrace.py,
+ISSUE 17): the conservation matrix — {plain route, drain migration,
+crash salvage, disagg handoff, kv-tier peer pull} x {fp, int8kv} —
+pins stitched plane hops + per-replica attributions == fleet e2e to
+1e-6 with every fragment carrying the minted trace_id; plus the
+acceptance exemplar: an injected host_stall on one replica produces
+an slo_burn black box whose embedded exemplar names that replica's
+hop as dominant."""
+import json
+
+import jax
+import numpy as np
+import pytest
+
+from pipegoose_tpu.models import bloom
+from pipegoose_tpu.serving import (
+    DisaggEngine,
+    Request,
+    ServingEngine,
+    make_skewed_replay,
+)
+from pipegoose_tpu.serving.control_plane import ControlPlane
+from pipegoose_tpu.serving.kv_tier import HostTier
+from pipegoose_tpu.telemetry import MetricsRegistry
+from pipegoose_tpu.telemetry.fleettrace import FleetTracer
+from pipegoose_tpu.telemetry.flightrec import FlightRecorder
+from pipegoose_tpu.telemetry.reqtrace import RequestTracer
+from pipegoose_tpu.telemetry.slo import SLOMonitor, SLOTarget
+from pipegoose_tpu.testing.chaos import (
+    ChaosMonkey,
+    ChaosSchedule,
+    Injection,
+)
+
+KV_IDS = ["fp", "int8kv"]
+KV_DTYPES = [None, "int8"]
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = bloom.BloomConfig(vocab_size=64, hidden_size=32, n_layer=2,
+                            n_head=2)
+    params = bloom.init_params(cfg, jax.random.PRNGKey(0))
+    return params, cfg
+
+
+def _factory(params, cfg, *, kv_dtype=None, host_tier=False,
+             page_size=8, num_pages=33, max_context=96,
+             prefill_chunk=None):
+    def make(name, registry):
+        kw = {}
+        if host_tier:
+            kw["host_tier"] = HostTier(1 << 26)
+        if prefill_chunk is not None:
+            kw["prefill_chunk"] = prefill_chunk
+        return ServingEngine(params, cfg, num_slots=1,
+                             num_pages=num_pages, page_size=page_size,
+                             max_context=max_context, prefix_cache=True,
+                             registry=registry, kv_dtype=kv_dtype, **kw)
+    return make
+
+
+def _requests(n=10, seed=0):
+    replay = make_skewed_replay(
+        n_requests=n, n_prefixes=3, prefix_len=32, suffix_lens=(2, 4),
+        max_new=3, vocab=64, seed=seed, n_tenants=2,
+    )
+    return [Request(prompt=p, max_new_tokens=m, tenant=t)
+            for p, m, t in replay]
+
+
+def _assert_conserved(ft, n_expected=None):
+    """THE contract: for every completed (served, not lost) trace,
+    plane hops + per-leg replica components == fleet e2e within 1e-6,
+    and every leg's fragment carries the trace's trace_id."""
+    done = [t for t in ft.completed
+            if not t.lost and t.finish_reason != "shed"]
+    if n_expected is not None:
+        assert len(done) == n_expected
+    assert done, "no completed traces to check"
+    for trace in done:
+        row = trace.attribution()
+        assert row["legs"], f"trace {trace.trace_id} never dispatched"
+        assert abs(row["stitched_total_s"] - trace.e2e_s) < 1e-6, (
+            f"trace {trace.trace_id}: stitched "
+            f"{row['stitched_total_s']} != e2e {trace.e2e_s} "
+            f"(hops {row['hops']}, legs {row['legs']})"
+        )
+        for leg in trace.legs:
+            tl = leg.get("timeline")
+            assert tl is not None, (
+                f"trace {trace.trace_id}: leg on {leg['replica']} "
+                f"has no sealed fragment"
+            )
+            assert tl.trace_id == trace.trace_id
+    return done
+
+
+# --- the conservation matrix ------------------------------------------------
+
+
+@pytest.mark.parametrize("kv_dtype", KV_DTYPES, ids=KV_IDS)
+def test_plain_route_conservation(tiny, kv_dtype):
+    """Matrix cell 1: every request takes exactly one dispatch — one
+    leg, distinct monotonic trace_ids, stitched sum == e2e."""
+    params, cfg = tiny
+    ft = FleetTracer(registry=MetricsRegistry(enabled=True))
+    plane = ControlPlane(_factory(params, cfg, kv_dtype=kv_dtype),
+                         n_replicas=2, fleet_tracer=ft)
+    reqs = _requests()
+    outs, _ = plane.run(reqs)
+    assert len(outs) == len(reqs)
+    done = _assert_conserved(ft, n_expected=len(reqs))
+    assert len({t.trace_id for t in done}) == len(reqs)
+    assert all(len(t.legs) == 1 for t in done)
+    # the minted identity rode on the Request itself
+    assert sorted(r.trace_id for r in reqs) == sorted(
+        t.trace_id for t in done)
+
+
+def test_drain_migration_conservation(tiny):
+    """Matrix cell 2: a drained replica's requests re-admit elsewhere;
+    the migrated trace carries a sealed leg (leave_reason='drain') and
+    still sums exactly."""
+    params, cfg = tiny
+    ft = FleetTracer(registry=MetricsRegistry(enabled=True))
+    plane = ControlPlane(_factory(params, cfg), n_replicas=2,
+                         fleet_tracer=ft)
+
+    def hook(p, tick):
+        if tick == 3 and len(p.serving_replicas()) == 2:
+            p.start_drain(p.serving_replicas()[0].name)
+
+    reqs = _requests(seed=1)
+    outs, _ = plane.run(reqs, tick_hook=hook)
+    assert len(outs) == len(reqs)
+    done = _assert_conserved(ft, n_expected=len(reqs))
+    drained = [t for t in done if len(t.legs) > 1]
+    assert drained, "the drain never migrated a dispatched request"
+    for t in drained:
+        assert t.legs[0]["leave_reason"] == "drain"
+        assert t.hops()["salvage_s"] >= 0.0
+
+
+@pytest.mark.parametrize("kv_dtype", KV_DTYPES, ids=KV_IDS)
+def test_crash_salvage_conservation(tiny, kv_dtype, tmp_path):
+    """Matrix cell 3 (the acceptance pin): a seeded replica_crash
+    mid-run — the salvaged request's stitched trace has a sealed
+    victim leg, a survivor leg, and the sum still hits e2e at 1e-6;
+    the replica_failure black box embeds an exemplar."""
+    params, cfg = tiny
+    reg = MetricsRegistry(enabled=True)
+    ft = FleetTracer(registry=reg)
+    recorder = FlightRecorder(str(tmp_path), capacity=64)
+    plane = ControlPlane(_factory(params, cfg, kv_dtype=kv_dtype),
+                         n_replicas=2, recorder=recorder,
+                         fleet_tracer=ft)
+    schedule = ChaosSchedule(
+        [Injection(4, "replica_crash", (("replica", 1),))])
+    monkey = ChaosMonkey(schedule, recorder=recorder)
+    reqs = _requests(seed=2)
+    outs, _ = plane.run(reqs, tick_hook=monkey.fleet_hook)
+    assert len(outs) == len(reqs)
+    assert plane._m_failures.value == 1.0
+    assert plane._m_lost.value == 0.0
+    done = _assert_conserved(ft, n_expected=len(reqs))
+    salvaged = [t for t in done
+                if any(leg.get("leave_reason") == "salvage"
+                       for leg in t.legs)]
+    assert salvaged, "the crash never salvaged a dispatched request"
+    for t in salvaged:
+        assert len(t.legs) >= 2
+        # the victim leg and the survivor leg are different replicas
+        assert t.legs[0]["replica"] != t.legs[-1]["replica"]
+    # fleet attribution histograms observed one row per trace
+    snap = reg.metrics()
+    assert snap["fleet.attrib.traces_total"].value == len(reqs)
+    # the replica_failure black box embeds the exemplar field
+    box_path = [p for p in recorder.dumps if "replica_failure" in p][0]
+    with open(box_path) as f:
+        det = json.load(f)["trigger"]["details"]
+    assert "exemplar" in det
+    # ...and the flight recorder's fleet_traces embed rode along
+    with open(box_path) as f:
+        box = json.load(f)
+    assert "fleet_traces" in box
+
+
+@pytest.mark.parametrize("kv_dtype", KV_DTYPES, ids=KV_IDS)
+def test_kv_tier_peer_pull_conservation(tiny, kv_dtype):
+    """Matrix cell 4: the fleet directory hints a cross-replica pull
+    (A->rep0, B->rep1, B->rep0 under round robin); the pulled trace's
+    fragment shows the pull_hint event and the stitched sum holds
+    through the transfer phase."""
+    params, cfg = tiny
+    rng = np.random.RandomState(11)
+    A, B = (rng.randint(1, 64, (12,)) for _ in range(2))
+    ft = FleetTracer(registry=MetricsRegistry(enabled=True))
+    plane = ControlPlane(
+        _factory(params, cfg, kv_dtype=kv_dtype, host_tier=True,
+                 page_size=4, num_pages=24, max_context=32,
+                 prefill_chunk=4),
+        n_replicas=2, policy="round_robin", fleet_tracer=ft,
+    )
+    reqs = [Request(prompt=np.concatenate([p, rng.randint(1, 64, (2,))]),
+                    max_new_tokens=4)
+            for p in (A, B, B)]
+    outs, m = plane.run(reqs)
+    assert len(outs) == len(reqs)
+    pulls = sum(pm.get("kv_tier", {}).get("pulls", 0)
+                for pm in m["per_replica"].values())
+    assert pulls >= 1, "the directory never drove a cross-replica pull"
+    done = _assert_conserved(ft, n_expected=len(reqs))
+    hinted = [
+        t for t in done
+        if any(ev["kind"] == "pull_hint"
+               for leg in t.legs for ev in leg["timeline"].events)
+    ]
+    assert hinted, "no fragment recorded the pull_hint annotation"
+    # the pulled leg really took the transfer phase
+    assert any(
+        leg["components"].get("transfer_s", 0.0) > 0.0
+        for t in hinted for leg in t.legs if leg.get("components")
+    )
+
+
+@pytest.mark.parametrize("kv_dtype", KV_DTYPES, ids=KV_IDS)
+def test_disagg_handoff_conservation(tiny, kv_dtype):
+    """Matrix cell 5: a prefill->decode handoff inside a DisaggEngine
+    (one shared tracer across both pools). The trace_id minted before
+    submit survives the handoff and the fragment's components — now
+    including the first-class transfer phase — sum to its e2e."""
+    params, cfg = tiny
+    reg = MetricsRegistry(enabled=True)
+    tracer = RequestTracer(registry=reg, keep_completed=64)
+
+    def pool(prefill_only=False, stall_patience=None):
+        kw = {"prefill_only": True} if prefill_only else {}
+        if stall_patience is not None:
+            kw["stall_patience"] = stall_patience
+        return ServingEngine(params, cfg, num_slots=2, num_pages=32,
+                             page_size=4, max_context=48,
+                             prefix_cache=True, prefill_chunk=8,
+                             kv_dtype=kv_dtype,
+                             registry=MetricsRegistry(), **kw)
+
+    dis = DisaggEngine(pool(prefill_only=True),
+                       pool(stall_patience=10_000),
+                       registry=MetricsRegistry(enabled=True),
+                       tracer=tracer)
+    rng = np.random.RandomState(3)
+    reqs = [Request(prompt=rng.randint(1, 64, (9 + 2 * i,)),
+                    max_new_tokens=4) for i in range(3)]
+    for i, req in enumerate(reqs):
+        req.trace_id = 1000 + i       # plane-ingress stand-in
+    outs, m = dis.run(reqs)
+    assert len(outs) == len(reqs)
+    assert m["transfer"]["handoffs"] == len(reqs)
+    assert len(tracer.completed) == len(reqs)
+    for tl in tracer.completed:
+        assert tl.trace_id in {1000, 1001, 1002}
+        assert tl.components["transfer_s"] > 0.0
+        assert abs(sum(tl.components.values()) - tl.e2e_s) < 1e-6, (
+            tl.trace_id, dict(tl.components), tl.e2e_s)
+    assert ({tl.trace_id for tl in tracer.completed}
+            == {1000, 1001, 1002})
+
+
+# --- acceptance: the injected slow hop names itself ------------------------
+
+
+def test_host_stall_slo_exemplar_names_dominant_hop(tiny, tmp_path):
+    """A host_stall injected while ONE replica serves the only request
+    inflates that replica's phase; the slo_burn black box's embedded
+    exemplar names <that replica>:<phase> as the dominant hop."""
+    params, cfg = tiny
+    reg = MetricsRegistry(enabled=True)
+    ft = FleetTracer(registry=reg)
+    recorder = FlightRecorder(str(tmp_path), capacity=64)
+    slo = SLOMonitor(
+        [SLOTarget("fleet_e2e", metric="fleet.attrib.replica_seconds",
+                   objective=0.05, target=0.9)],
+        registry=reg, recorder=recorder, exemplars=ft.exemplar,
+        clock=lambda: 0.0,
+    )
+    slo.evaluate(now=0.0)             # baseline sample (zero counts)
+    plane = ControlPlane(_factory(params, cfg), n_replicas=2,
+                         recorder=recorder, fleet_tracer=ft)
+    schedule = ChaosSchedule(
+        [Injection(2, "host_stall", (("stall_s", 0.25),))])
+    monkey = ChaosMonkey(schedule, recorder=recorder)
+    rng = np.random.RandomState(5)
+    reqs = [Request(prompt=rng.randint(1, 64, (12,)), max_new_tokens=8)]
+    outs, _ = plane.run(reqs, tick_hook=monkey.fleet_hook)
+    assert len(outs) == 1 and len(monkey.applied) == 1
+    done = _assert_conserved(ft, n_expected=1)
+    victim = done[0].legs[0]["replica"]
+    # the exemplar names the stalled replica's hop as dominant
+    ex = ft.exemplar("e2e")
+    assert ex is not None
+    assert ex["dominant_hop"].startswith(f"{victim}:")
+    assert ex["dominant_s"] >= 0.2
+    assert ex["dominant_share"] > 0.5
+    # the breach transition embeds it in the slo_burn black box
+    status = slo.evaluate(now=61.0)
+    assert not status["ok"]
+    box_path = [p for p in recorder.dumps if "slo_burn" in p][0]
+    with open(box_path) as f:
+        det = json.load(f)["trigger"]["details"]
+    assert det["exemplar"]["dominant_hop"].startswith(f"{victim}:")
+    assert det["exemplar"]["trace"]["trace_id"] == done[0].trace_id
+
+
+def test_lost_request_trace_is_flagged(tiny, tmp_path):
+    """The degraded terminal path: when salvage loses a request, its
+    trace completes flagged lost (excluded from conservation and from
+    the tail) and fleet.attrib.lost_total counts it."""
+    reg = MetricsRegistry(enabled=True)
+    ft = FleetTracer(registry=reg)
+
+    class _Req:
+        tenant = None
+        trace_id = None
+        uid = 7
+
+    req = _Req()
+    ft.on_ingress(req, 1.0)
+    ft.on_dispatch_pass(1.5)
+    ft.on_lost(req, 2.0)
+    assert not ft.active
+    assert len(ft.completed) == 1 and ft.completed[0].lost
+    assert reg.metrics()["fleet.attrib.lost_total"].value == 1.0
+    assert ft.exemplar("e2e") is None     # lost traces never exemplify

@@ -1,0 +1,383 @@
+"""Fleet trace stitching unit layer (telemetry/fleettrace.py, ISSUE
+17): the synthetic mark/fragment walk (exact telescoping sums without
+an engine), the TailSampler bounds, the (trace_id, uid) composite-key
+regression on a shared RequestTracer, per-tracer pid allocation in the
+Chrome exporter, the merged Perfetto export, and the /debug/trace +
+/debug/tail endpoints."""
+import json
+from types import SimpleNamespace
+from urllib.error import HTTPError
+from urllib.request import urlopen
+
+import pytest
+
+from pipegoose_tpu.telemetry.chrometrace import (
+    PID_PLANE,
+    PID_REQUESTS,
+    REPLICA_PID_BASE,
+    ChromeTraceExporter,
+)
+from pipegoose_tpu.telemetry.fleettrace import (
+    OBJECTIVES,
+    PLANE_HOPS,
+    FleetTracer,
+    TailSampler,
+    fleet_trace_events,
+)
+from pipegoose_tpu.telemetry.opsserver import OpsServer
+from pipegoose_tpu.telemetry.registry import MetricsRegistry
+from pipegoose_tpu.telemetry.reqtrace import (
+    RequestTracer,
+    request_trace_events,
+)
+
+
+def _get(url):
+    try:
+        r = urlopen(url, timeout=5)
+        return r.status, r.read().decode()
+    except HTTPError as e:  # 4xx/5xx still carry a JSON body
+        return e.code, e.read().decode()
+
+
+class _Req:
+    """Duck-typed Request: what the tracer hooks actually touch."""
+
+    def __init__(self, uid=None, tenant=None):
+        self.uid = uid
+        self.tenant = tenant
+        self.trace_id = None
+        self.prompt_len = 4
+        self.max_new_tokens = 2
+        self.generated = []
+        self.finish_reason = None
+        self.t_submit = None
+        self.t_done = None
+        self.slot = 0
+        self.hit_tokens = 0
+
+
+def _stitch_one(ft, tracer, *, uid=11, t0=1.0, decode_s=0.6,
+                tenant="acme"):
+    """Drive one request through the full hook sequence with hand-
+    placed timestamps: plane hops 0.5/0.2/0.1/0.2, replica fragment
+    queue 0.2 + prefill 0.3 + decode ``decode_s``."""
+    req = _Req(tenant=tenant)
+    req.t_submit = t0
+    ft.on_ingress(req, t0)
+    ft.on_dispatch_pass(t0 + 0.5)
+    ft.on_ledger_pop(req, t0 + 0.7)
+    ft.on_routed(req, t0 + 0.8, "replica0")
+    req.uid = uid
+    tracer.on_submit(req, t0 + 1.0)
+    ft.on_dispatched(req, "replica0")
+    tracer.on_admit(req, t0 + 1.2)
+    tracer.on_first_token(req, t0 + 1.5)
+    t_done = t0 + 1.5 + decode_s
+    req.finish_reason = "length"
+    req.t_done = t_done
+    tracer.on_done(req, t_done)
+    out = SimpleNamespace(e2e_latency_s=t_done - t0,
+                          ttft_s=1.5, finish_reason="length")
+    ft.on_finished(req, out)
+    return req
+
+
+@pytest.fixture()
+def ft_pair():
+    reg = MetricsRegistry(enabled=True)
+    ft = FleetTracer(registry=reg)
+    tracer = RequestTracer(registry=MetricsRegistry(), name="replica0")
+    ft.register_replica("replica0", tracer)
+    return ft, tracer, reg
+
+
+# --- stitching ---------------------------------------------------------------
+
+
+def test_synthetic_stitch_is_exact_and_queryable(ft_pair):
+    ft, tracer, reg = ft_pair
+    req = _stitch_one(ft, tracer)
+    assert req.trace_id == 1
+    row = ft.trace_json(trace_id=1)
+    assert row is not None
+    assert row["hops"] == pytest.approx(
+        {"ingress_s": 0.5, "ledger_s": 0.2, "route_s": 0.1,
+         "dispatch_s": 0.2, "salvage_s": 0.0})
+    assert row["replica_s"] == pytest.approx(1.1)   # 0.2 + 0.3 + 0.6
+    assert row["stitched_total_s"] == pytest.approx(row["e2e_s"],
+                                                    abs=1e-9)
+    assert row["dominant_hop"] == "replica0:decode_s"
+    assert row["dominant_s"] == pytest.approx(0.6)
+    assert row["legs"][0]["replica"] == "replica0"
+    assert row["legs"][0]["uid"] == 11
+    # uid lookup resolves through the dispatch index to the same row
+    assert ft.trace_json(uid=11)["trace_id"] == 1
+    assert ft.trace_json(uid=999) is None
+    assert ft.trace_json(trace_id=999) is None
+    # the fleet histograms saw one observation each
+    snap = reg.metrics()
+    assert snap["fleet.attrib.traces_total"].value == 1.0
+    assert snap["fleet.attrib.legs_total"].value == 1.0
+    h = snap["fleet.attrib.replica_seconds"]
+    assert h._count == 1
+
+
+def test_requeue_retry_books_as_route_wait(ft_pair):
+    """A popped request no replica could admit requeues and re-pops:
+    first-pop-wins keeps the retry gap inside route_s, never a
+    double-counted ledger wait."""
+    ft, tracer, _ = ft_pair
+    req = _Req(tenant=None)
+    ft.on_ingress(req, 0.0)
+    ft.on_dispatch_pass(1.0)
+    ft.on_ledger_pop(req, 1.0)
+    ft.on_ledger_pop(req, 2.0)          # retry pop: ignored
+    ft.on_routed(req, 3.0, "replica0")
+    req.uid = 1
+    tracer.on_submit(req, 3.5)
+    ft.on_dispatched(req, "replica0")
+    trace = ft.active[req.trace_id]
+    hops = trace.hops()
+    assert hops["ingress_s"] == pytest.approx(1.0)
+    assert hops["route_s"] == pytest.approx(2.0)    # 1.0 -> 3.0
+    assert hops["dispatch_s"] == pytest.approx(0.5)
+
+
+def test_plane_shed_finalizes_without_tail(ft_pair):
+    ft, _tracer, _ = ft_pair
+    req = _Req()
+    ft.on_ingress(req, 0.0)
+    ft.on_dispatch_pass(0.4)
+    ft.on_plane_shed(req, 2.0)
+    assert not ft.active
+    assert ft.completed[0].finish_reason == "shed"
+    assert ft.completed[0].e2e_s == pytest.approx(2.0)
+    assert ft.exemplar("e2e") is None   # sheds never exemplify
+    assert ft.tail_payload()["e2e"] == []
+
+
+def test_tail_sampler_bounds_and_ordering():
+    with pytest.raises(ValueError, match="k must be"):
+        TailSampler(k=0)
+    ts = TailSampler(k=2)
+    traces = []
+    for i, e2e in enumerate((0.3, 0.9, 0.1, 0.5)):
+        tr = SimpleNamespace(ttft_s=None if i == 0 else e2e / 2,
+                             e2e_s=e2e,
+                             attribution=lambda: {"stub": True})
+        traces.append(tr)
+        ts.offer(tr)
+    top = ts.top("e2e")
+    assert [v for v, _ in top] == [0.9, 0.5]        # slowest first, k=2
+    assert [v for v, _ in ts.top("ttft")] == [0.45, 0.25]
+    assert [v for v, _ in ts.top("e2e", 1)] == [0.9]
+    payload = ts.payload()
+    assert set(payload) == set(OBJECTIVES)
+    assert payload["e2e"][0]["value_s"] == 0.9
+    with pytest.raises(ValueError, match="unknown objective"):
+        ts.top("p99")
+
+
+def test_fleettracer_validation():
+    with pytest.raises(ValueError, match="keep_completed"):
+        FleetTracer(registry=MetricsRegistry(), keep_completed=0)
+
+
+def test_exemplar_and_blackbox_payloads(ft_pair):
+    ft, tracer, _ = ft_pair
+    _stitch_one(ft, tracer, uid=1, t0=0.0, decode_s=0.2)
+    _stitch_one(ft, tracer, uid=2, t0=10.0, decode_s=1.4)  # the slow one
+    live = _Req()
+    ft.on_ingress(live, 20.0)           # still active at dump time
+    ex = ft.exemplar("e2e")
+    assert ex["objective"] == "e2e"
+    assert ex["trace"]["uid"] == 2
+    assert ex["dominant_hop"] == "replica0:decode_s"
+    assert ex["dominant_share"] == pytest.approx(
+        1.4 / ex["trace"]["e2e_s"])
+    box = ft.blackbox_payload(top_n=1)
+    assert len(box["active"]) == 1
+    assert box["active"][0]["trace_id"] == live.trace_id
+    assert len(box["tail"]["e2e"]) == 1
+    json.dumps(box)                     # the embed must be JSON-able
+    summary = ft.summary_payload()
+    assert summary["traces"] == 2
+    assert set(summary["per_hop"]) == set(PLANE_HOPS + ("replica_s",))
+    assert summary["per_hop"]["replica_s"]["p99"] >= \
+        summary["per_hop"]["replica_s"]["p50"]
+
+
+# --- satellite 1: composite-key regression on a shared tracer ---------------
+
+
+def test_shared_tracer_reuse_uid_keeps_two_timelines():
+    """THE uid-collision hazard: a salvaged reuse_uid request lands on
+    a second replica sharing the tracer while a stranger already flies
+    under the same bare uid — the (trace_id, uid) key must keep the
+    two timelines distinct instead of silently merging them."""
+    tracer = RequestTracer(registry=MetricsRegistry())
+    a, b = _Req(uid=5, tenant="a"), _Req(uid=5, tenant="b")
+    a.trace_id, b.trace_id = 1, 2       # two requests, ONE uid
+    tracer.on_submit(a, 1.0)
+    tracer.on_submit(b, 1.5)
+    assert len(tracer.in_flight) == 2   # pre-fix this was 1
+    tla = tracer.in_flight[(1, 5)]
+    tlb = tracer.in_flight[(2, 5)]
+    assert tla is not tlb
+    assert tla.trace_id == 1 and tlb.trace_id == 2
+    assert tla.tenant == "a" and tlb.tenant == "b"
+    a.finish_reason = b.finish_reason = "length"
+    tracer.on_done(a, 2.0)
+    tracer.on_done(b, 3.0)
+    assert len(tracer.completed) == 2
+    e2es = sorted(tl.e2e_s for tl in tracer.completed)
+    assert e2es == [pytest.approx(1.0), pytest.approx(1.5)]
+    rows = [tl.attribution() for tl in tracer.completed]
+    assert sorted(r["trace_id"] for r in rows) == [1, 2]
+
+
+def test_untraced_requests_keep_bare_uid_behavior():
+    """Requests that never crossed a control plane (trace_id None)
+    degrade to the historical keying: same uid == same timeline."""
+    tracer = RequestTracer(registry=MetricsRegistry())
+    a = _Req(uid=7)
+    tracer.on_submit(a, 1.0)
+    tracer.on_admit(a, 1.5)
+    assert len(tracer.in_flight) == 1
+    assert tracer.in_flight[(None, 7)].trace_id is None
+
+
+# --- satellite 2: per-tracer pids in the Chrome exporter --------------------
+
+
+def test_two_replica_export_has_disjoint_pids(tmp_path):
+    """Two tracers through one exporter: first keeps PID_REQUESTS
+    (backward compat), second gets its own replica pid — no
+    interleaved slot tracks; repeated adds reuse the same pid."""
+    tr0 = RequestTracer(registry=MetricsRegistry(), name="replica0")
+    tr1 = RequestTracer(registry=MetricsRegistry(), name="replica1")
+    for i, tr in enumerate((tr0, tr1)):
+        req = _Req(uid=i)
+        tr.on_submit(req, 1.0)
+        tr.on_admit(req, 1.5)
+        req.finish_reason = "length"
+        tr.on_done(req, 2.0)
+    exp = ChromeTraceExporter(str(tmp_path / "trace.json"))
+    exp.add_request_timelines(tr0)
+    exp.add_request_timelines(tr1)
+    exp.add_request_timelines(tr0)      # re-add: stable pid, no drift
+    path = exp.write()
+    with open(path) as f:
+        events = json.load(f)["traceEvents"]
+    pids = {}
+    for ev in events:
+        if ev.get("ph") == "M" and ev.get("name") == "process_name":
+            name = ev["args"]["name"]
+            if "replica0" in name or "replica1" in name:
+                pids[name] = ev["pid"]
+    assert pids == {
+        "serving requests (replica0)": PID_REQUESTS,
+        "serving requests (replica1)": REPLICA_PID_BASE,
+    }
+    slice_pids = {ev["pid"] for ev in events
+                  if ev.get("ph") == "X" and ev.get("cat", "")
+                  .startswith("request.")}
+    assert slice_pids == {PID_REQUESTS, REPLICA_PID_BASE}
+
+
+def test_request_trace_events_default_name_unchanged():
+    """An unnamed tracer keeps the historical process title — existing
+    single-engine traces must not re-title themselves."""
+    tr = RequestTracer(registry=MetricsRegistry())
+    req = _Req(uid=1)
+    tr.on_submit(req, 1.0)
+    req.finish_reason = "length"
+    tr.on_done(req, 2.0)
+    evs = request_trace_events(tr)
+    meta = [e for e in evs if e.get("ph") == "M"
+            and e.get("name") == "process_name"]
+    assert meta[0]["args"]["name"] == \
+        "serving requests (per-slot timelines)"
+    assert meta[0]["pid"] == PID_REQUESTS
+
+
+# --- merged Perfetto export --------------------------------------------------
+
+
+def test_fleet_trace_events_merged_export(ft_pair):
+    ft, tracer, _ = ft_pair
+    _stitch_one(ft, tracer)
+    events = fleet_trace_events(ft)
+    json.dumps(events)
+    meta = {(e["pid"], e["args"]["name"]) for e in events
+            if e.get("ph") == "M" and e["name"] == "process_name"}
+    assert (PID_PLANE, "control plane (fleet hops)") in meta
+    assert (REPLICA_PID_BASE, "replica replica0") in meta
+    hop_slices = [e for e in events if e.get("ph") == "X"
+                  and e.get("cat", "").startswith("fleet.")]
+    assert {e["name"] for e in hop_slices} >= {
+        "trace1 ingress", "trace1 ledger", "trace1 route",
+        "trace1 dispatch", "trace1 replica"}
+    assert all(e["pid"] == PID_PLANE for e in hop_slices)
+    # the dispatch flow arrow binds the plane track to the replica pid
+    flows = [e for e in events if e.get("cat") == "fleet.flow"]
+    starts = [e for e in flows if e["ph"] == "s"]
+    finishes = [e for e in flows if e["ph"] == "f"]
+    assert starts and finishes
+    assert {e["id"] for e in starts} == {e["id"] for e in finishes}
+    s, f = starts[0], finishes[0]
+    assert s["pid"] == PID_PLANE and f["pid"] == REPLICA_PID_BASE
+    assert f["bp"] == "e"
+    # one process per replica: the fragment slices render there
+    frag = [e for e in events if e.get("ph") == "X"
+            and e.get("cat", "").startswith("request.")]
+    assert frag and all(e["pid"] == REPLICA_PID_BASE for e in frag)
+
+
+# --- ops endpoints -----------------------------------------------------------
+
+
+def test_debug_trace_and_tail_endpoints(ft_pair):
+    ft, tracer, _ = ft_pair
+    req = _stitch_one(ft, tracer)
+    with OpsServer(registry=MetricsRegistry(enabled=True), port=0,
+                   fleettrace=ft) as srv:
+        code, body = _get(srv.url + "/")
+        assert code == 200
+        listing = json.loads(body)["endpoints"]
+        assert "/debug/trace" in listing and "/debug/tail" in listing
+        code, body = _get(srv.url + f"/debug/trace?trace_id="
+                          f"{req.trace_id}")
+        assert code == 200
+        row = json.loads(body)
+        assert row["trace_id"] == req.trace_id
+        assert row["dominant_hop"] == "replica0:decode_s"
+        code, body = _get(srv.url + f"/debug/trace?uid={req.uid}")
+        assert code == 200 and json.loads(body)["uid"] == req.uid
+        code, body = _get(srv.url + "/debug/trace")
+        assert code == 400
+        code, body = _get(srv.url + "/debug/trace?uid=bogus")
+        assert code == 400
+        code, body = _get(srv.url + "/debug/trace?trace_id=404")
+        assert code == 404
+        code, body = _get(srv.url + "/debug/tail")
+        assert code == 200
+        tail = json.loads(body)
+        assert tail["e2e"][0]["trace_id"] == req.trace_id
+
+
+def test_debug_trace_404_without_tracer():
+    with OpsServer(registry=MetricsRegistry(enabled=True),
+                   port=0) as srv:
+        code, body = _get(srv.url + "/debug/trace?uid=1")
+        assert code == 404
+        assert "no fleet tracer" in json.loads(body)["error"]
+        code, _body = _get(srv.url + "/debug/tail")
+        assert code == 404
+    # late attach mirrors the other debug surfaces
+    srv = OpsServer(registry=MetricsRegistry(enabled=True), port=0)
+    srv.set_fleettrace(FleetTracer(registry=MetricsRegistry()))
+    with srv:
+        code, _body = _get(srv.url + "/debug/tail")
+        assert code == 200
