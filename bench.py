@@ -633,12 +633,26 @@ def run_bench(force_cpu: bool) -> None:
         if tel is not None:
             srng = np.random.RandomState(0)
             vocab = getattr(scfg, "valid_vocab_size", None) or scfg.vocab_size
-            engine = ServingEngine(sparams, scfg, **kw)
-            engine.run([
+            # the instrumented replay also carries the live memory
+            # ledger (ISSUE 18): peak per-owner-class occupancy +
+            # fragmentation land in the serving payload and the
+            # BENCH_HISTORY row, conservation-checked for free
+            engine = ServingEngine(sparams, scfg, memledger=True, **kw)
+            _, smetrics = engine.run([
                 Request(prompt=srng.randint(1, vocab, (int(s),)),
                         max_new_tokens=int(n))
                 for s, n in specs
             ])
+            mem = smetrics.get("memory")
+            if mem is not None:
+                res["memory"] = mem
+                reg.event("bench.serving_memory",
+                          peak_pages=mem["peak_pages"],
+                          peak_bytes=mem["peak_bytes"],
+                          peak_fragmentation=mem["peak_fragmentation"],
+                          conservation_failures=mem[
+                              "conservation_failures"],
+                          leaks=mem["leaks"])
         return res
 
     def emit(results, serving=None) -> bool:
@@ -925,6 +939,18 @@ def run_bench(force_cpu: bool) -> None:
                     "comm_fraction": round(prof.comm_fraction, 4),
                     "idle_fraction": round(prof.idle_fraction, 4),
                     "measured_mfu": prof.mfu,
+                }
+            # the instrumented serving replay's memory-ledger peaks
+            # (ISSUE 18) ride the same trajectory row, so per-class KV
+            # occupancy creep is as machine-readable as tokens/s
+            if isinstance(serving, dict) and "memory" in serving:
+                smem = serving["memory"]
+                row["serving_memory"] = {
+                    "peak_pages": smem["peak_pages"],
+                    "peak_fragmentation": smem["peak_fragmentation"],
+                    "conservation_failures":
+                        smem["conservation_failures"],
+                    "leaks": smem["leaks"],
                 }
             # baseline = same-device healthy rows only: a CPU-fallback
             # run judged against a TPU trajectory (or vice versa) would

@@ -34,6 +34,12 @@ class AutoscalerConfig:
     scale_up_burn: float = 2.0     # fast burn >= this on ANY target -> up
     scale_down_burn: float = 0.5   # fast burn <= this on ALL targets -> down
     cooldown_ticks: int = 50
+    # memory-ledger capacity signal (telemetry/memledger.py): scale up
+    # when any replica's steps-to-exhaustion forecast falls to this or
+    # below — BEFORE the first admission deferral, which is the whole
+    # point of forecasting. 0 disables (the default: fleets without a
+    # ledger attached never see the signal).
+    scale_up_memory_steps: float = 0.0
 
     def __post_init__(self):
         if not 1 <= self.min_replicas <= self.max_replicas:
@@ -49,6 +55,8 @@ class AutoscalerConfig:
             )
         if self.cooldown_ticks < 0:
             raise ValueError("cooldown_ticks must be >= 0")
+        if self.scale_up_memory_steps < 0:
+            raise ValueError("scale_up_memory_steps must be >= 0")
 
 
 class Autoscaler:
@@ -66,7 +74,8 @@ class Autoscaler:
 
     def decide(self, tick: int, n_serving: int, backlog: int,
                now: Optional[float] = None,
-               n_failed: int = 0) -> Optional[str]:
+               n_failed: int = 0,
+               memory_steps: Optional[float] = None) -> Optional[str]:
         """One evaluation: returns "up", "down", or None. ``n_serving``
         counts SERVING replicas (draining ones are already leaving),
         ``backlog`` the control plane's undispatched ingress — scaling
@@ -76,7 +85,13 @@ class Autoscaler:
         rejoins since): any loss is an immediate scale-up signal — the
         burn rate would discover it eventually, but only after users
         paid the latency — and a fleet carrying a failure never scales
-        DOWN (the backlog guard's crash sibling)."""
+        DOWN (the backlog guard's crash sibling). ``memory_steps`` is
+        the FLEET MINIMUM of the memory ledger's steps-to-exhaustion
+        forecast (None = no ledger attached anywhere): at or below
+        ``scale_up_memory_steps`` it scales up ahead of the first
+        admission deferral, and a fleet under memory pressure never
+        scales down — shedding capacity while KV headroom runs out is
+        the one move guaranteed to convert a forecast into a breach."""
         cfg = self.config
         if (self._last_action_tick is not None
                 and tick < self._last_action_tick):
@@ -91,12 +106,21 @@ class Autoscaler:
         status = self.monitor.evaluate(now)
         burns = {name: t.get("burn_fast", 0.0)
                  for name, t in status.get("targets", {}).items()}
+        mem_pressure = (
+            cfg.scale_up_memory_steps > 0
+            and memory_steps is not None
+            and memory_steps <= cfg.scale_up_memory_steps)
         decision = None
         reason = ""
         if n_failed > 0 and n_serving < cfg.max_replicas:
             decision = "up"
             reason = (f"{n_failed} failed replica(s): unplanned "
                       f"capacity loss")
+        elif mem_pressure and n_serving < cfg.max_replicas:
+            decision = "up"
+            reason = (f"memory ledger forecasts {memory_steps:.0f} "
+                      f"step(s) to KV exhaustion <= "
+                      f"{cfg.scale_up_memory_steps:.0f}")
         elif burns and max(burns.values()) >= cfg.scale_up_burn:
             if n_serving < cfg.max_replicas:
                 hot = max(burns, key=burns.get)
@@ -105,6 +129,7 @@ class Autoscaler:
                           f"{cfg.scale_up_burn}x")
             # at max: nothing to add — shedding stays the pressure valve
         elif (burns and backlog == 0 and n_failed == 0
+                and not mem_pressure
                 and n_serving > cfg.min_replicas
                 and max(burns.values()) <= cfg.scale_down_burn):
             decision = "down"
@@ -120,5 +145,6 @@ class Autoscaler:
                 "n_serving": n_serving,
                 "backlog": backlog,
                 "n_failed": n_failed,
+                "memory_steps": memory_steps,
             })
         return decision

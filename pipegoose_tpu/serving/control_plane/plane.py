@@ -32,6 +32,7 @@ builds one SLO target per tenant over them.
 """
 from __future__ import annotations
 
+import math
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -104,7 +105,8 @@ class ControlPlane:
                  failed_after_ticks: int = 20,
                  probation_ticks: int = 8,
                  pull_hints: bool = True,
-                 fleet_tracer: Optional[Any] = None):
+                 fleet_tracer: Optional[Any] = None,
+                 memledger: bool = False):
         """``recorder``: optional ``telemetry.FlightRecorder`` — every
         replica failure dumps ONE ``replica_failure`` black box naming
         the replica and the salvaged/resubmitted/lost uids; an
@@ -125,7 +127,11 @@ class ControlPlane:
         hand-over, attaches one named ``RequestTracer`` per replica
         (unless the factory attached its own), and the tracer stitches
         them into one cross-replica timeline per request (plane hops +
-        replica phases == fleet e2e, the PR 8 contract fleet-wide)."""
+        replica phases == fleet e2e, the PR 8 contract fleet-wide).
+        ``memledger``: attach one ``telemetry.MemoryLedger`` per
+        replica (factory-attached ledgers are kept) — the fleet-minimum
+        steps-to-exhaustion then feeds the autoscaler and
+        ``fleet_status()`` grows a per-replica memory rollup."""
         if n_replicas < 1:
             raise ValueError(f"n_replicas must be >= 1, got {n_replicas}")
         if stall_patience < 1:
@@ -150,6 +156,7 @@ class ControlPlane:
         self.replica_factory = replica_factory
         self.recorder = recorder
         self.pull_hints = pull_hints
+        self.memledger = memledger
         self.fleettrace = fleet_tracer
         if (fleet_tracer is not None and recorder is not None
                 and hasattr(recorder, "set_fleet_tracer")):
@@ -230,6 +237,10 @@ class ControlPlane:
                 _dir.publish(_name, tokens, location)
 
             engine.on_prefix_publish = _publish
+        if self.memledger and getattr(engine, "memledger", None) is None:
+            from pipegoose_tpu.telemetry.memledger import MemoryLedger
+
+            engine.attach_memledger(MemoryLedger())
         if self.fleettrace is not None:
             # one NAMED RequestTracer per replica (fragments the
             # stitcher seals/joins); a factory-attached tracer is kept
@@ -709,6 +720,19 @@ class ControlPlane:
             if pending is not None:
                 self.recorder.last_trigger = pending
 
+    def _fleet_memory_steps(self) -> Optional[float]:
+        """Fleet MINIMUM of the per-replica steps-to-exhaustion
+        forecast — the autoscaler's memory capacity signal. None when
+        no serving replica has a ledger attached or every forecast is
+        still infinite (no consumption trend yet)."""
+        steps = [
+            ml.steps_to_exhaustion
+            for rep in self.serving_replicas()
+            if (ml := getattr(rep.engine, "memledger", None)) is not None
+        ]
+        finite = [s for s in steps if not math.isinf(s)]
+        return min(finite) if finite else None
+
     def _autoscale(self, tick: int, now: float) -> None:
         if self.autoscaler is None:
             return
@@ -720,6 +744,7 @@ class ControlPlane:
             self.ledger.pending() + len(self._migrated),
             now=now,
             n_failed=self._capacity_gap,
+            memory_steps=self._fleet_memory_steps(),
         )
         if decision == "up":
             self.scale_up()
@@ -877,10 +902,55 @@ class ControlPlane:
 
     # -- observability -----------------------------------------------------
 
+    def fleet_memory(self) -> Optional[Dict[str, Any]]:
+        """Fleet memory rollup: each replica's ledger condensed to the
+        numbers an operator pages on — per-class pages, conservation
+        verdict, leak tally, exhaustion forecast, host-tier bytes —
+        plus fleet aggregates (total bytes by class, the minimum
+        forecast, whether ANY replica ever broke conservation). None
+        when no replica carries a ledger."""
+        per: Dict[str, Any] = {}
+        totals: Dict[str, int] = {}
+        for rep in self.replicas:
+            ml = getattr(rep.engine, "memledger", None)
+            if ml is None:
+                continue
+            c = ml.counts()
+            cons = ml.conservation()
+            steps = ml.steps_to_exhaustion
+            per[rep.name] = {
+                "classes_pages": c,
+                "bytes_per_page": ml.bytes_per_page,
+                "conservation_ok": cons["ok"],
+                "conservation_failures": ml.conservation_failures,
+                "leaks": (len(ml.last_audit["leaks"])
+                          if ml.last_audit else 0),
+                "mismatched_releases": ml.mismatched_releases,
+                "steps_to_exhaustion": (None if math.isinf(steps)
+                                        else steps),
+                "fragmentation": round(ml.pool.fragmentation(), 4),
+                "host_tier_bytes": (ml.host_tier.resident_bytes
+                                    if ml.host_tier is not None else None),
+            }
+            for k, v in c.items():
+                totals[k] = totals.get(k, 0) + v * ml.bytes_per_page
+        if not per:
+            return None
+        return {
+            "replicas": per,
+            "total_bytes_by_class": totals,
+            "min_steps_to_exhaustion": self._fleet_memory_steps(),
+            "conservation_ok": all(r["conservation_ok"]
+                                   for r in per.values()),
+            "conservation_failures": sum(r["conservation_failures"]
+                                         for r in per.values()),
+            "leaks": sum(r["leaks"] for r in per.values()),
+        }
+
     def fleet_status(self) -> Dict[str, Any]:
         """The ``/debug/fleet`` payload: per-replica state + load,
-        router stats, per-tenant ledger shares, autoscaler audit log —
-        everything JSON-able, snapshot-style."""
+        router stats, per-tenant ledger shares, autoscaler audit log,
+        memory-ledger rollup — everything JSON-able, snapshot-style."""
         return {
             "replicas": [rep.status() for rep in self.replicas],
             "serving": len(self.serving_replicas()),
@@ -893,4 +963,5 @@ class ControlPlane:
             "migrated_pending": len(self._migrated),
             "autoscaler": (list(self.autoscaler.log)
                            if self.autoscaler is not None else None),
+            "memory": self.fleet_memory(),
         }

@@ -110,7 +110,9 @@ class ShadowIndex:
 class Router:
     def __init__(self, policy: str = "cache_aware", *, registry=None,
                  max_decisions: int = 512,
-                 affinity_slack_tokens: int = 192):
+                 affinity_slack_tokens: int = 192,
+                 memory_pressure_steps: float = 0.0,
+                 memory_pressure_penalty_tokens: int = 8192):
         if policy not in POLICIES:
             raise ValueError(
                 f"unknown routing policy {policy!r} (expected one of "
@@ -121,8 +123,22 @@ class Router:
                 f"affinity_slack_tokens must be >= 0, got "
                 f"{affinity_slack_tokens}"
             )
+        if memory_pressure_steps < 0 or memory_pressure_penalty_tokens < 0:
+            raise ValueError(
+                "memory_pressure_steps and memory_pressure_penalty_tokens "
+                "must be >= 0"
+            )
         self.policy = policy
         self.affinity_slack_tokens = int(affinity_slack_tokens)
+        # memory-ledger routing signal: a replica whose steps-to-
+        # exhaustion forecast (capacity_snapshot, present only when a
+        # MemoryLedger is attached) is at or below
+        # ``memory_pressure_steps`` carries a synthetic token debt, so
+        # cache affinity stops piling prefixes onto a pool about to
+        # start evicting them. 0 disables (default).
+        self.memory_pressure_steps = float(memory_pressure_steps)
+        self.memory_pressure_penalty_tokens = int(
+            memory_pressure_penalty_tokens)
         self.registry = registry if registry is not None else get_registry()
         self.decisions: deque = deque(maxlen=max_decisions)
         self._rr_next = 0
@@ -203,8 +219,13 @@ class Router:
         # its unmaterialized tail + decode budget (scheduler ledger),
         # but it IS load this pool will pay — count it or disagg
         # dispatch piles onto a pool whose queue merely LOOKS empty
-        return (snap["queued_tokens"] + snap["active_tokens_remaining"]
+        load = (snap["queued_tokens"] + snap["active_tokens_remaining"]
                 + snap.get("transfer_tokens_owed", 0))
+        if self.memory_pressure_steps > 0:
+            steps = snap.get("steps_to_exhaustion")
+            if steps is not None and steps <= self.memory_pressure_steps:
+                load += self.memory_pressure_penalty_tokens
+        return load
 
     def _pick_cache_aware(self, cands: List[Replica], tokens):
         """The cache-aware scoring shared by ``cache_aware`` routing

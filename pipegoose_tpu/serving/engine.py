@@ -199,7 +199,8 @@ class ServingEngine:
                  sentinel=None,
                  host_tier=None,
                  host_tier_wire: Optional[str] = None,
-                 cost_model=None):
+                 cost_model=None,
+                 memledger=None):
         """``recorder``: optional ``telemetry.FlightRecorder`` — every
         decode step lands in its ring, and the no-decode-progress
         watchdog dumps a black box through it before raising.
@@ -242,7 +243,13 @@ class ServingEngine:
         format). ``cost_model``: optional calibrated
         ``planner.cost.CostModel`` — its fitted launch/bandwidth/
         overhead constants decide restore-vs-recompute per prefix
-        length; default None always restores."""
+        length; default None always restores.
+
+        ``memledger``: optional ``telemetry.memledger.MemoryLedger``
+        (or ``True`` to construct one) — live byte-exact per-owner-
+        class page accounting with leak audits and an exhaustion
+        forecast. Default None keeps every pool event and tick at one
+        attribute read + branch (guard-tested < 5 µs)."""
         if max_context % page_size:
             raise ValueError("max_context must be a multiple of page_size")
         if prefill_only and prefill_chunk is None:
@@ -578,6 +585,15 @@ class ServingEngine:
             self.k_pages = jax.device_put(self.k_pages, sharding)
             self.v_pages = jax.device_put(self.v_pages, sharding)
             self._pspec = pspec
+        # live memory ledger (telemetry/memledger.py) — attached LAST:
+        # bytes-per-page is measured from the live pool arrays above
+        self.memledger = None
+        if memledger:
+            from pipegoose_tpu.telemetry.memledger import MemoryLedger
+
+            self.attach_memledger(
+                memledger if isinstance(memledger, MemoryLedger)
+                else MemoryLedger())
 
     def doctor(self, large_bytes: int = 1 << 20, registry=None):
         """Mesh-doctor report (telemetry/doctor.py) for the compiled
@@ -763,6 +779,40 @@ class ServingEngine:
         if self.recorder is not None:
             self.recorder.set_request_tracer(tracer)
 
+    def attach_memledger(self, ledger) -> None:
+        """Attach (or detach, with None) a ``telemetry.memledger.
+        MemoryLedger``: binds it to the pool (as the synchronous event
+        observer), the scheduler, the prefix cache, the host tier, the
+        flight recorder, and the registry, with the bytes-per-page
+        MEASURED from the live pool arrays (q+scale planes for int8
+        pools — the same census ``memory_report`` does). Post-hoc
+        attachment adopts a warm pool via the ledger's ``resync``."""
+        if ledger is None:
+            if self.memledger is not None:
+                self.memledger.unbind()
+            self.memledger = None
+            return
+        total = 0
+        for leaf in jax.tree_util.tree_leaves((self.k_pages, self.v_pages)):
+            total += int(leaf.size) * int(np.dtype(leaf.dtype).itemsize)
+        ledger.bind(
+            self.pool, sched=self.sched, cache=self.prefix_cache,
+            host_tier=self.host_tier, recorder=self.recorder,
+            registry=self.registry,
+            bytes_per_page=total // self.pool.num_pages,
+        )
+        self.memledger = ledger
+
+    def _ledger_tick(self, rs) -> None:
+        """Per-tick ledger hook (conservation check + forecast +
+        occupancy sample). With no ledger attached (the default) the
+        cost is this one attribute read + branch — the disabled-path
+        guard test times exactly this call."""
+        ml = self.memledger
+        if ml is None:
+            return
+        ml.on_tick(rs.tick, t=rs.now())
+
     def set_handoff_hook(self, hook) -> None:
         """Install (or clear, with None) the disagg handoff seam:
         ``hook(engine, req, first_token, t)`` runs at each prefill's
@@ -899,6 +949,8 @@ class ServingEngine:
                 self.k_pages, self.v_pages,
                 jnp.asarray(src, jnp.int32), jnp.asarray(dst, jnp.int32),
             )
+            if self.pool.ledger is not None:
+                self.pool.tag = ("cow", req.uid)
             self.pool.release([src])   # the PrefixCache.acquire pin
             req.cow = None
             req.prefilled_len += m
@@ -1293,6 +1345,7 @@ class ServingEngine:
                 if rs.stalled >= self.stall_patience:
                     self._stall(rs.steps, now() - rs.t0)
             rs.t_last_decode = None
+            self._ledger_tick(rs)
             # everything admitted finished at prefill
             return bool(admitted or chunked_this_tick or shed_now)
         rs.stalled = 0
@@ -1382,6 +1435,7 @@ class ServingEngine:
                 self.sched.record_token(req, int(nxt[req.slot]), t)
                 if req.status is Status.DONE:
                     rs.done.append(req)
+        self._ledger_tick(rs)
         return True
 
     def _build_output(self, r: Request) -> RequestOutput:
@@ -1524,6 +1578,10 @@ class ServingEngine:
             metrics["kv_tier"] = dict(self.kv_tier.run_stats())
             if self.host_tier is not None:
                 metrics["kv_tier"]["host"] = self.host_tier.stats()
+        if self.memledger is not None:
+            # peak per-class occupancy + fragmentation + leak/audit
+            # verdicts: the memory trajectory one bench row carries
+            metrics["memory"] = self.memledger.run_summary()
         if self.speculative is not None:
             metrics["speculative"] = {
                 "draft_tokens": rs.spec_drafted,

@@ -128,18 +128,31 @@ class PagePool:
     a refcount decrement on a still-shared page). Bounded so a
     long-lived engine never accumulates host memory per request."""
 
-    def __init__(self, num_pages: int, page_size: int):
+    def __init__(self, num_pages: int, page_size: int,
+                 history_limit: int = 1024):
         if num_pages < 2:
             raise ValueError("need >= 2 pages (page 0 is the null page)")
         if page_size < 1:
             raise ValueError(f"page_size must be positive, got {page_size}")
+        if history_limit < 1:
+            raise ValueError(
+                f"history_limit must be >= 1, got {history_limit}")
         self.num_pages = num_pages
         self.page_size = page_size
         self._free: List[int] = list(range(num_pages - 1, 0, -1))
         self._ref: Dict[int, int] = {}   # page -> refcount (allocated only)
         self.history: Deque[Tuple[str, Tuple[int, ...], int]] = deque(
-            maxlen=1024
+            maxlen=history_limit
         )
+        # events the bounded ring has silently evicted — the ring
+        # itself must not look lossless once it wraps
+        self.history_dropped = 0
+        # optional synchronous observer (telemetry/memledger.py): gets
+        # every (event, pages) pair history records plus the owner tag
+        # the call site declared through ``tag``. None (the default)
+        # costs one attribute read + branch per pool event.
+        self.ledger = None
+        self.tag = None                  # owner tag for the NEXT event
 
     @property
     def free_count(self) -> int:
@@ -180,6 +193,19 @@ class PagePool:
             best = max(best, runs)
         return 1.0 - best / len(self._free)
 
+    def _record(self, event: str, pages: Tuple[int, ...],
+                delta: int) -> None:
+        """Ring the event (counting what the bounded ring drops) and
+        feed the attached ledger, consuming the one-shot owner tag."""
+        h = self.history
+        if len(h) == h.maxlen:
+            self.history_dropped += 1
+        h.append((event, pages, delta))
+        led = self.ledger
+        if led is not None:
+            led.on_pool_event(event, pages, self.tag)
+            self.tag = None
+
     def alloc(self, n: int) -> List[int]:
         if n > len(self._free):
             raise RuntimeError(
@@ -192,7 +218,7 @@ class PagePool:
                 raise RuntimeError(f"allocator invariant broken: page {p} "
                                    f"double-allocated or null")
             self._ref[p] = 1
-        self.history.append(("alloc", tuple(pages), +1))
+        self._record("alloc", tuple(pages), +1)
         return pages
 
     def share(self, pages: List[int]) -> None:
@@ -203,7 +229,7 @@ class PagePool:
                 raise RuntimeError(f"sharing page {p} that is not allocated")
         for p in pages:
             self._ref[p] += 1
-        self.history.append(("share", tuple(pages), +1))
+        self._record("share", tuple(pages), +1)
 
     def release(self, pages: List[int]) -> None:
         """Drop one reference per page; pages reaching refcount 0 return
@@ -217,7 +243,7 @@ class PagePool:
             if self._ref[p] == 0:
                 del self._ref[p]
                 self._free.append(p)
-        self.history.append(("release", tuple(pages), -1))
+        self._record("release", tuple(pages), -1)
 
     # pre-sharing name: release IS free when nothing is shared
     free = release

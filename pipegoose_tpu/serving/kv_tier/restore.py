@@ -310,12 +310,16 @@ class RestoreManager:
                                             tokens=ps, pages=1, nbytes=nb)
             except (HostTierError, TransferError, KeyError) as exc:
                 if pages[len(done):]:
+                    if eng.pool.ledger is not None:
+                        eng.pool.tag = ("restore",)
                     eng.pool.release(pages[len(done):])
                 self._fallback_box("host tier restore", req,
                                    keys[0], exc)
             if done:
                 m = h + len(done)
                 cache.insert(toks[:m * ps], list(hit.pages) + done)
+                if eng.pool.ledger is not None:
+                    eng.pool.tag = ("restore",)
                 eng.pool.release(done)   # cache's share now owns them
                 tier.note_restored(len(done))
                 self.restored_tokens += len(done) * ps
@@ -324,10 +328,15 @@ class RestoreManager:
                 tr.on_restore_done(req, now())
             return bool(done)
         finally:
-            # drop the probe pins acquire() took
+            # drop the probe pins acquire() took (anonymous owner=None
+            # pins — the ledger tags must match acquire's)
             if hit.pages:
+                if eng.pool.ledger is not None:
+                    eng.pool.tag = ("req", None)
                 eng.pool.release(hit.pages)
             if hit.cow_page is not None:
+                if eng.pool.ledger is not None:
+                    eng.pool.tag = ("cow", None)
                 eng.pool.release([hit.cow_page])
 
     # -- cross-replica pull -------------------------------------------------

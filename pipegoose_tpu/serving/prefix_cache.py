@@ -220,17 +220,24 @@ class PrefixCache:
 
     # -- mutation ----------------------------------------------------------
 
-    def acquire(self, hit: PrefixHit) -> None:
+    def acquire(self, hit: PrefixHit, owner=None) -> None:
         """Take one reference per matched page on behalf of a request
         and refresh the chain's recency. The COW candidate is pinned
         TOO: the copy is a device op the engine performs a tick later,
         and an eviction in between could hand the source page to a new
         owner who overwrites it — the engine releases the pin right
-        after :func:`~pipegoose_tpu.serving.kv_pool.copy_page` runs."""
+        after :func:`~pipegoose_tpu.serving.kv_pool.copy_page` runs.
+        ``owner`` (a request uid, or None for anonymous probe pins)
+        labels the references for the memory ledger."""
+        pool = self.pool
         if hit.pages:
-            self.pool.share(hit.pages)
+            if pool.ledger is not None:
+                pool.tag = ("req", owner)
+            pool.share(hit.pages)
         if hit.cow_page is not None:
-            self.pool.share([hit.cow_page])
+            if pool.ledger is not None:
+                pool.tag = ("cow", owner)
+            pool.share([hit.cow_page])
         for node in hit.nodes:
             self._clock += 1
             node.last_used = self._clock
@@ -255,6 +262,8 @@ class PrefixCache:
             node = children.get(blk)
             if node is None:
                 node = _Node(blk, int(pages[i]), parent)
+                if self.pool.ledger is not None:
+                    self.pool.tag = ("cache",)
                 self.pool.share([node.page])
                 children[blk] = node
                 self._nodes[id(node)] = node
@@ -289,6 +298,8 @@ class PrefixCache:
                     # it) proceeds regardless
                     pass
             self._remove(victim)
+            if self.pool.ledger is not None:
+                self.pool.tag = ("cache",)
             self.pool.release([victim.page])
             freed += 1
         return freed

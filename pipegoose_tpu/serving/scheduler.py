@@ -373,7 +373,7 @@ class Scheduler:
                 return 0
             return max(r.max_new_tokens - len(r.generated), 0)
 
-        return {
+        snap = {
             "free_slots": sum(1 for s in self.slots if s is None),
             "num_slots": self.num_slots,
             "free_pages": self.pool.free_count,
@@ -393,6 +393,14 @@ class Scheduler:
                 for s in self.transfers.values()
             ),
         }
+        led = self.pool.ledger
+        if led is not None:
+            # memory-pressure signal for the router/autoscaler: the
+            # ledger forecaster's steps-to-exhaustion (None = no trend)
+            s = led.steps_to_exhaustion
+            snap["steps_to_exhaustion"] = (
+                None if s == float("inf") else s)
+        return snap
 
     def withdraw(self, req: Request) -> Request:
         """Remove a QUEUED request from this scheduler (control-plane
@@ -433,6 +441,11 @@ class Scheduler:
             target = req.target_len
             worst = self.pool.pages_for(self._worst_tokens(req))
             fits, hit = self._admission_check(req)
+            led = self.pool.ledger
+            if led is not None:
+                # admission-pressure feed for the exhaustion forecaster:
+                # the head's worst-case need, and whether memory let it in
+                led.note_admission(worst, fits)
             if not fits:
                 break  # FIFO head-of-line: deterministic admission order
             shared: List[int] = hit.pages if hit is not None else []
@@ -450,7 +463,8 @@ class Scheduler:
             req.pages = []
             req.prefilled_len = req.hit_tokens = 0
             if hit is not None:
-                self.cache.acquire(hit)  # pins shared + COW source pages
+                # pins shared + COW source pages, tagged to this request
+                self.cache.acquire(hit, owner=req.uid)
                 req.pages = list(shared)
                 req.prefilled_len = hit.tokens
                 req.hit_tokens = hit.total_tokens
@@ -461,7 +475,7 @@ class Scheduler:
                 req.prefilled_len + cow_tokens + self.chunk_tokens, target
             )
             n_now = self.pool.pages_for(chunk_end) - len(req.pages)
-            req.pages += self._alloc(n_now)
+            req.pages += self._alloc(n_now, tag=("req", req.uid))
             req.outstanding = need_new - n_now
             self._outstanding_total += req.outstanding
             admitted.append(req)
@@ -583,7 +597,8 @@ class Scheduler:
                 f"transfer_pages on unstaged uid={req.uid}"
             )
         while len(stage["pages"]) * self.pool.page_size < n_tokens:
-            stage["pages"] += self._alloc(1, owner=req)
+            stage["pages"] += self._alloc(1, owner=req,
+                                          tag=("stage", req.uid))
             stage["outstanding"] -= 1
             self._outstanding_total -= 1
         stage["tokens"] = max(stage["tokens"], n_tokens)
@@ -598,6 +613,8 @@ class Scheduler:
         if stage is None:
             raise ValueError(f"uid={req.uid} is not staged here")
         if stage["pages"]:
+            if self.pool.ledger is not None:
+                self.pool.tag = ("stage", req.uid)
             self.pool.release(stage["pages"])
         self._outstanding_total -= stage["outstanding"]
 
@@ -615,6 +632,8 @@ class Scheduler:
         if self.cache is not None and self.pool.free_count < n:
             self.cache.evict(n - self.pool.free_count)
         got = min(n, self.pool.free_count)
+        if got and self.pool.ledger is not None:
+            self.pool.tag = ("restore",)
         return self.pool.alloc(got) if got else []
 
     def admit_with_pages(self, req: Request, first_token: Optional[int],
@@ -656,6 +675,11 @@ class Scheduler:
         self.slots[req.slot] = req
         req.status = Status.PREFILL   # momentary: record_token -> DECODE
         req.pages = list(stage["pages"])
+        led = self.pool.ledger
+        if led is not None:
+            # ownership handover, no refcount change: staged transfer
+            # pages become this request's KV in the ledger too
+            led.retag(req.pages, ("stage", req.uid), ("req", req.uid))
         req.outstanding = stage["outstanding"]
         req.cow = None
         if req.t_admit is None:
@@ -706,7 +730,7 @@ class Scheduler:
                 f"(retracted mid-batch by a neighbor's lazy growth?)"
             )
         while len(req.pages) * self.pool.page_size < n_tokens:
-            req.pages += self._alloc(1, owner=req)
+            req.pages += self._alloc(1, owner=req, tag=("req", req.uid))
             req.outstanding -= 1
             self._outstanding_total -= 1
 
@@ -726,14 +750,16 @@ class Scheduler:
         elif len(req.generated) >= req.max_new_tokens:
             self._finish(req, "length", now)
 
-    def _alloc(self, n: int, owner: Optional[Request] = None) -> List[int]:
+    def _alloc(self, n: int, owner: Optional[Request] = None,
+               tag=None) -> List[int]:
         """Pool alloc that treats LRU-evictable cache pages as free.
         With ``owner`` set (the must-not-fail reservation path), a
         shortfall that eviction cannot cover retracts newest-first
         OTHER active requests until it can — see :meth:`ensure_pages`.
         Admission never passes ``owner``: its ledger check and alloc
         are atomic within one ``admit`` iteration (no insert can
-        intervene), and a blocked admission simply waits."""
+        intervene), and a blocked admission simply waits. ``tag`` is
+        the memory-ledger owner label for the allocated pages."""
         if n <= 0:
             return []
         if self.cache is not None and self.pool.free_count < n:
@@ -748,13 +774,22 @@ class Scheduler:
                     self.cache.evict(n - self.pool.free_count)
                     if self.pool.free_count >= n:
                         break
+        if self.pool.ledger is not None:
+            # set AFTER any eviction/retraction above: those release
+            # with their own tags, each event consuming the one-shot tag
+            self.pool.tag = tag if tag is not None else (
+                ("req", owner.uid) if owner is not None else None)
         return self.pool.alloc(n)
 
     def _release_all(self, req: Request) -> None:
         if req.cow is not None:          # un-run COW copy: drop the pin
+            if self.pool.ledger is not None:
+                self.pool.tag = ("cow", req.uid)
             self.pool.release([req.cow[0]])
             req.cow = None
         if req.pages:
+            if self.pool.ledger is not None:
+                self.pool.tag = ("req", req.uid)
             self.pool.release(req.pages)
             req.pages = []
 

@@ -31,7 +31,10 @@ The MEASURED layer closes the loop: ``profile_step``/``StepProfile``
 per-mesh-axis collectives / idle from XLA profiler traces, and
 ``PerfSentinel`` (telemetry/sentinel.py) watches runs against a
 rolling baseline, firing ``perf_regression`` black boxes that name
-the regressed component.
+the regressed component. ``MemoryLedger`` (telemetry/memledger.py)
+keeps a byte-exact per-owner-class account of the serving KV pool —
+conservation-checked every tick, with leak audits, exhaustion
+forecasting, and Perfetto counter tracks (``memory_trace_events``).
 
 See docs/observability.md for the metric catalog and the MFU
 methodology.
@@ -39,6 +42,7 @@ methodology.
 from pipegoose_tpu.telemetry.callback import TelemetryCallback
 from pipegoose_tpu.telemetry.chrometrace import (
     ChromeTraceExporter,
+    memory_trace_events,
     pipeline_trace_events,
     register_pipeline_gauges,
     router_trace_events,
@@ -102,6 +106,7 @@ from pipegoose_tpu.telemetry.exporters import (
     PrometheusTextfileExporter,
 )
 from pipegoose_tpu.telemetry.flightrec import FlightRecorder, TriggerEvent
+from pipegoose_tpu.telemetry.memledger import MemoryLedger
 from pipegoose_tpu.telemetry.health import health_stats, host_health
 from pipegoose_tpu.telemetry.registry import (
     Counter,
@@ -133,6 +138,7 @@ __all__ = [
     "Gauge",
     "Histogram",
     "JSONLExporter",
+    "MemoryLedger",
     "MemoryReport",
     "MetricsRegistry",
     "HBM_BYTES",
@@ -168,6 +174,7 @@ __all__ = [
     "health_stats",
     "host_health",
     "iter_collectives",
+    "memory_trace_events",
     "merge_histograms",
     "merge_metrics",
     "mfu",

@@ -41,6 +41,7 @@ PID_PIPELINE = 2    # theoretical pipeline clock timeline
 PID_REQUESTS = 3    # per-request serving timelines (telemetry/reqtrace.py)
 PID_FLEET = 4       # control-plane router decisions (one track per replica)
 PID_PLANE = 5       # control-plane hop slices (telemetry/fleettrace.py)
+PID_MEMORY = 6      # memory-ledger counter tracks (telemetry/memledger.py)
 # multi-replica request timelines get one process EACH, allocated from
 # here up (the first tracer keeps PID_REQUESTS for backward compat)
 REPLICA_PID_BASE = 10
@@ -233,6 +234,62 @@ def router_trace_events(decisions: Iterable[dict], *,
             "pid": pid, "tid": replicas.index(d["replica"]),
             "args": {k: v for k, v in d.items() if k != "t"},
         })
+    return events
+
+
+def memory_trace_events(ledger: Any, *,
+                        pid: int = PID_MEMORY,
+                        wall_offset: float = 0.0) -> List[dict]:
+    """Render a ``MemoryLedger``'s per-tick occupancy samples
+    (telemetry/memledger.py) as Perfetto COUNTER tracks: one stacked
+    ``kv bytes`` counter with the per-owner-class split (request /
+    staged / cow / cached / reserved / free), plus scalar tracks for
+    fragmentation, the steps-to-exhaustion forecast, and — when a host
+    tier is bound — host-DRAM resident bytes. Loadable next to the
+    request timelines, so "who owned the pool when this request
+    queued" is one track group away. Samples without a wall clock
+    (``t is None`` — replay without a ``now``) fall back to 1ms per
+    engine tick."""
+    samples = list(ledger.samples)
+    bpp = int(getattr(ledger, "bytes_per_page", 1))
+    events: List[dict] = [{
+        "name": "process_name", "ph": "M", "pid": pid,
+        "args": {"name": "serving memory (ledger counter tracks)"},
+    }]
+    for s in samples:
+        t = s.get("t")
+        ts = ((t + wall_offset) * 1e6 if t is not None
+              else (wall_offset * 1e6 + s.get("step", 0) * 1e3))
+        events.append({
+            "name": "kv bytes", "cat": "memory", "ph": "C",
+            "ts": ts, "pid": pid,
+            "args": {
+                "request": s.get("request", 0) * bpp,
+                "staged": s.get("staged", 0) * bpp,
+                "cow": s.get("cow", 0) * bpp,
+                "cached": s.get("cached", 0) * bpp,
+                "reserved": s.get("reserved_unmaterialized", 0) * bpp,
+                "free": s.get("free", 0) * bpp,
+            },
+        })
+        events.append({
+            "name": "fragmentation", "cat": "memory", "ph": "C",
+            "ts": ts, "pid": pid,
+            "args": {"fragmentation": s.get("fragmentation", 0.0)},
+        })
+        ste = s.get("steps_to_exhaustion")
+        if ste is not None:
+            events.append({
+                "name": "steps_to_exhaustion", "cat": "memory",
+                "ph": "C", "ts": ts, "pid": pid,
+                "args": {"steps": ste},
+            })
+        if "host_tier_bytes" in s:
+            events.append({
+                "name": "host tier bytes", "cat": "memory", "ph": "C",
+                "ts": ts, "pid": pid,
+                "args": {"resident": s["host_tier_bytes"]},
+            })
     return events
 
 

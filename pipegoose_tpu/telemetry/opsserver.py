@@ -36,6 +36,10 @@ background HTTP endpoint over the same telemetry objects:
                           (serving/control_plane/): per-replica state +
                           load, router stats, per-tenant fair-share
                           ledger, autoscaler audit log.
+- ``GET /debug/memory``   the live memory ledger (telemetry/
+                          memledger.py) as JSON — per-owner-class byte
+                          account, conservation verdict, leak-audit
+                          findings, steps-to-exhaustion forecast.
 - ``GET /debug/trace``    one stitched cross-replica fleet trace from
                           the ``FleetTracer`` (telemetry/fleettrace.py)
                           selected by ``?trace_id=`` or ``?uid=`` —
@@ -76,6 +80,7 @@ _PROVIDER_ENDPOINTS = {
     "/debug/profile": ("_profile", "step profile"),
     "/debug/plan": ("_plan", "plan report"),
     "/debug/fleet": ("_fleet", "fleet status provider"),
+    "/debug/memory": ("_memory", "memory ledger"),
 }
 
 
@@ -97,6 +102,10 @@ class OpsServer:
     (e.g. ``control_plane.fleet_status``) behind ``/debug/fleet`` —
     per-replica state + load, router stats, per-tenant shares, the
     autoscaler audit log.
+    ``memory``: a JSON-able dict or a zero-arg callable returning one
+    (e.g. ``engine.memledger.report``) behind ``/debug/memory`` — the
+    live memory ledger's per-owner-class byte account, conservation
+    verdict, leak-audit findings, and steps-to-exhaustion forecast.
     ``fleettrace``: optional ``telemetry.fleettrace.FleetTracer``
     behind ``/debug/trace`` (one stitched trace by ``?trace_id=`` /
     ``?uid=``) and ``/debug/tail`` (slowest-trace exemplars).
@@ -117,6 +126,7 @@ class OpsServer:
         plan: Optional[Any] = None,
         fleet: Optional[Any] = None,
         fleettrace: Optional[Any] = None,
+        memory: Optional[Any] = None,
     ):
         self.registry = registry if registry is not None else get_registry()
         self.host = host
@@ -129,6 +139,7 @@ class OpsServer:
         self._profile = profile
         self._plan = plan
         self._fleet = fleet
+        self._memory = memory
         self.fleettrace = fleettrace
         self._lock = threading.Lock()
         # SLOMonitor mutates per-target state on evaluate(), so
@@ -176,6 +187,11 @@ class OpsServer:
         """Attach (or replace) the provider behind ``/debug/fleet``."""
         with self._lock:
             self._fleet = fleet
+
+    def set_memory(self, memory: Any) -> None:
+        """Attach (or replace) the provider behind ``/debug/memory``."""
+        with self._lock:
+            self._memory = memory
 
     def set_fleettrace(self, fleettrace: Any) -> None:
         """Attach (or replace) the ``FleetTracer`` behind
@@ -376,8 +392,8 @@ def _make_handler(ops: OpsServer):
                         "endpoints": ["/metrics", "/healthz",
                                       "/debug/requests", "/debug/doctor",
                                       "/debug/profile", "/debug/plan",
-                                      "/debug/fleet", "/debug/trace",
-                                      "/debug/tail"],
+                                      "/debug/fleet", "/debug/memory",
+                                      "/debug/trace", "/debug/tail"],
                     })
                 else:
                     self._send_json(404, {"error": f"unknown path {path!r}"})

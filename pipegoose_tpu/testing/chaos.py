@@ -78,6 +78,22 @@ Injection kinds (``KINDS``):
                      (token-identically, one consumed
                      ``kv_tier_fallback`` black box), never stall or
                      lose the request.
+``page_leak``        take one EXTRA pool reference on a live KV page,
+                     owner-tagged ``("chaos", "page_leak")`` — the
+                     classic lost-owner leak: the page survives its
+                     real owner's release forever. Nothing crashes and
+                     conservation stays exact (the reference is real);
+                     only the memory ledger's ``audit()``
+                     (telemetry/memledger.py) can catch it — one
+                     ``memory_leak`` black box naming the page, the
+                     chaos owner tag, and the ownership trail.
+``stranded_reservation``  silently inflate the scheduler's admission
+                     ledger (``_outstanding_total``) by ``pages`` —
+                     phantom reserved pages no request backs, shrinking
+                     every future admission's headroom. Detected by
+                     ``audit()``'s reservation cross-check
+                     (``stranded_reservation`` black box), not by any
+                     crash.
 
 Host-side by design (and jit-safety-allowlisted): injections run in
 callback/tick context, never inside compiled code.
@@ -105,12 +121,15 @@ KINDS: Tuple[str, ...] = (
     "replica_wedge",
     "transfer_flap",
     "host_tier_io_error",
+    "page_leak",
+    "stranded_reservation",
 )
 
 #: kinds applied by the serving tick hook (matched on engine tick
 #: number); the rest are trainer-callback injections (matched on step)
 SERVING_KINDS: Tuple[str, ...] = ("host_stall", "transfer_flap",
-                                  "host_tier_io_error")
+                                  "host_tier_io_error", "page_leak",
+                                  "stranded_reservation")
 
 #: kinds applied by the FLEET hook (``ControlPlane.run(tick_hook=
 #: monkey.fleet_hook)``), matched on the control-plane tick number
@@ -189,12 +208,15 @@ class ChaosSchedule:
         replica_wedge: int = 0,
         transfer_flap: int = 0,
         host_tier_io_error: int = 0,
+        page_leak: int = 0,
+        stranded_reservation: int = 0,
         n_lose: int = 1,
         module_groups: Sequence[str] = ("embed",),
         stall_s: float = 0.05,
         fail_times: int = 1,
         n_replicas: int = 2,
         flap_times: int = 1,
+        strand_pages: int = 1,
         min_step: int = 1,
     ) -> "ChaosSchedule":
         """Draw ``<kind>=count`` injections at distinct steps in
@@ -214,6 +236,8 @@ class ChaosSchedule:
             "replica_wedge": replica_wedge,
             "transfer_flap": transfer_flap,
             "host_tier_io_error": host_tier_io_error,
+            "page_leak": page_leak,
+            "stranded_reservation": stranded_reservation,
         }
         span = max_step - min_step + 1
         total = sum(counts.values())
@@ -250,9 +274,17 @@ class ChaosSchedule:
                     args = _args(replica=int(rng.randint(n_replicas)))
                 elif kind == "transfer_flap":
                     args = _args(fail_times=int(flap_times))
-                else:  # host_tier_io_error (shares flap_times: both
-                    # are transient wire faults with a retry budget)
+                elif kind == "host_tier_io_error":
+                    # shares flap_times: both are transient wire
+                    # faults with a retry budget
                     args = _args(fail_times=int(flap_times))
+                elif kind == "page_leak":
+                    # victim drawn per injection, resolved modulo the
+                    # LIVE allocated pages at fire time (same contract
+                    # as the replica-fault victim index)
+                    args = _args(page_index=int(rng.randint(4096)))
+                else:  # stranded_reservation
+                    args = _args(pages=int(strand_pages))
                 injections.append(Injection(step, kind, args))
         return cls(injections, seed=seed, max_step=max_step)
 
@@ -548,6 +580,32 @@ class ChaosMonkey:
             self._tier_armed = True
         self._log(inj)
 
+    def _apply_page_leak(self, engine: Any, inj: Injection) -> None:
+        pool = engine.pool
+        allocated = sorted(pool._ref)
+        if not allocated:
+            self._log(inj, skipped="no allocated page to leak")
+            return
+        page = allocated[int(inj.kwargs.get("page_index", 0))
+                         % len(allocated)]
+        # a REAL extra reference through the pool's own API (the ledger
+        # mirrors it under the chaos owner tag), with no owner that
+        # will ever release it — conservation stays exact; only the
+        # ledger's audit() refcount-vs-holders cross-check can tell
+        if pool.ledger is not None:
+            pool.tag = ("chaos", "page_leak")
+        pool.share([page])
+        self._log(inj, page=int(page))
+
+    def _apply_stranded_reservation(self, engine: Any,
+                                    inj: Injection) -> None:
+        n = int(inj.kwargs.get("pages", 1))
+        # silent admission-ledger inflation: no pool traffic, no
+        # crash — n phantom pages every future admission pays for,
+        # visible only to audit()'s reservation cross-check
+        engine.sched._outstanding_total += n
+        self._log(inj)   # `pages` already rides in inj.kwargs
+
     def _apply_replica_fault(self, plane: Any, inj: Injection,
                              kind: str) -> None:
         from pipegoose_tpu.serving.control_plane.replica import ReplicaState
@@ -656,6 +714,10 @@ class ChaosMonkey:
                 self._apply_host_stall(inj)
             elif inj.kind == "host_tier_io_error":
                 self._apply_host_tier_io_error(inj)
+            elif inj.kind == "page_leak":
+                self._apply_page_leak(engine, inj)
+            elif inj.kind == "stranded_reservation":
+                self._apply_stranded_reservation(engine, inj)
             else:  # transfer_flap
                 self._apply_transfer_flap(inj)
 

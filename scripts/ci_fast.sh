@@ -575,6 +575,69 @@ print(f"fleet-trace smoke OK: {len(done)} stitched traces exact at 1e-6 "
       f"exemplar names {ex['dominant_hop']}")
 PY
 
+# Memory-audit smoke (telemetry/memledger.py, ISSUE 18): a skewed
+# overflow replay with the live memory ledger attached and the leak
+# audit running EVERY tick — per-owner-class page accounting must sum
+# to pool capacity exactly on every tick, the audit must find zero
+# leaks/double-owners/strands, and /debug/memory must serve a parsing
+# JSON report over real HTTP. The byte-exact conservation contract
+# stays exercised on every CI run before the tier proper.
+echo "== memory-audit smoke (ledger conservation + /debug/memory) =="
+env $JAX_SERVING_CACHE_ENV python - <<'PY'
+import json
+from urllib.request import urlopen
+
+from pipegoose_tpu.testing import force_cpu_devices
+
+force_cpu_devices(1)
+
+import jax
+
+from pipegoose_tpu.models import bloom
+from pipegoose_tpu.serving import Request, ServingEngine, make_skewed_replay
+from pipegoose_tpu.telemetry import MemoryLedger
+from pipegoose_tpu.telemetry.opsserver import OpsServer
+from pipegoose_tpu.telemetry.registry import MetricsRegistry
+
+cfg = bloom.BloomConfig(vocab_size=64, hidden_size=32, n_layer=2, n_head=2)
+params = bloom.init_params(cfg, jax.random.PRNGKey(0))
+replay = make_skewed_replay(n_requests=8, n_prefixes=2, prefix_len=8,
+                            suffix_lens=(2, 4), max_new=4, vocab=64,
+                            seed=0, working_set_factor=1.5,
+                            num_pages=17, page_size=4)
+eng = ServingEngine(params, cfg, num_slots=2, num_pages=17, page_size=4,
+                    max_context=32, prefix_cache=True, prefill_chunk=4,
+                    memledger=MemoryLedger(audit_every=1),
+                    registry=MetricsRegistry(enabled=True))
+breaks = []
+
+def hook(engine, tick):
+    cons = engine.memledger.conservation()
+    if not cons["ok"]:
+        breaks.append((tick, cons))
+
+outs, metrics = eng.run(
+    [Request(prompt=p, max_new_tokens=m) for p, m in replay],
+    tick_hook=hook)
+assert len(outs) == 8, len(outs)
+ml = eng.memledger
+assert breaks == [], f"conservation broke: {breaks[:3]}"
+mem = metrics["memory"]
+assert mem["conservation_failures"] == 0, mem
+assert mem["leaks"] == 0 and ml.audits_run > 0, mem
+assert ml.last_audit["ok"], ml.last_audit
+with OpsServer(registry=eng.registry, port=0, memory=ml.report) as srv:
+    body = urlopen(srv.url + "/debug/memory", timeout=5).read().decode()
+rep = json.loads(body)
+assert rep["conservation"]["ok"] is True, rep["conservation"]
+total = sum(c["pages"] for c in rep["classes"].values())
+assert total == rep["capacity_pages"], (total, rep["capacity_pages"])
+print(f"memory-audit smoke OK: {ml.ticks} ticks conserved exactly, "
+      f"{ml.audits_run} audits clean (0 leaks), /debug/memory parses "
+      f"({rep['capacity_bytes']} B capacity, "
+      f"peak request {mem['peak_pages'].get('request', 0)} page(s))")
+PY
+
 echo "== fast tier =="
 python -m pytest tests/ -q -m fast -p no:cacheprovider \
     --continue-on-collection-errors "$@"

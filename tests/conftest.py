@@ -103,6 +103,7 @@ FAST_FILES = {
     "tests/telemetry/test_reqtrace.py",         # request tracing + attribution
     "tests/telemetry/test_fleettrace.py",       # fleet trace stitching (ISSUE 17)
     "tests/telemetry/test_slo.py",              # SLO burn-rate monitor
+    "tests/telemetry/test_memledger.py",        # memory ledger units (ISSUE 18)
     "tests/telemetry/test_opsserver.py",        # live ops endpoint
     "tests/telemetry/test_sentinel.py",         # perf-regression sentinel
     "tests/trainer/test_logger.py",             # rank-0 logging (host-only)
@@ -264,6 +265,11 @@ FAST_TESTS = {
     "tests/serving/test_kv_tier.py::test_spill_restore_token_identical[int8kv]",
     "tests/serving/test_kv_tier.py::test_attribution_sums_to_e2e_with_restore_phase",
     "tests/serving/test_kv_tier.py::test_host_tier_io_error_chaos_degrades_to_recompute",
+    # live memory ledger (ISSUE 18): conservation + leak audit + forecast
+    "tests/serving/test_memory_ledger.py::test_conservation_exact_and_tokens_identical[int8-chunked-cache]",
+    "tests/serving/test_memory_ledger.py::test_ledger_tick_disabled_under_5us",
+    "tests/serving/test_memory_ledger.py::test_seeded_page_leak_fires_one_memory_leak_box",
+    "tests/serving/test_memory_ledger.py::test_forecast_monotone_to_zero_before_first_admission_block",
     # fleet request tracing (ISSUE 17): the crash-salvage conservation
     # cell (stitched plane hops + both replica legs == e2e at 1e-6
     # through a seeded crash) and the host_stall SLO-exemplar

@@ -641,3 +641,81 @@ def test_exception_teardown_aborts_remaining_replicas_past_a_raising_abort(tiny)
     rep0.engine.abort_run()            # operator clears the wedged one
     outs, _ = plane.run(reqs())        # fleet reusable end to end
     assert len(outs) >= 6
+
+
+# -- memory-ledger capacity signal (ISSUE 18) -------------------------------
+
+
+def test_autoscaler_memory_pressure_scales_up_and_vetoes_down():
+    """The exhaustion forecast as a capacity signal: a replica about
+    to run out of KV pages scales the fleet up even with SLOs green,
+    and vetoes a burn-based scale-down — shedding capacity while
+    memory runs out converts a forecast into a breach."""
+    mon = _FakeMonitor()
+    asc = Autoscaler(mon, AutoscalerConfig(
+        min_replicas=1, max_replicas=3, cooldown_ticks=1,
+        scale_up_memory_steps=8.0,
+    ))
+    mon.burns = {"ttft": 0.1}            # SLOs healthy throughout
+    assert asc.decide(1, n_serving=2, backlog=0, memory_steps=5.0) == "up"
+    assert "exhaustion" in asc.log[-1]["reason"]
+    assert asc.log[-1]["memory_steps"] == 5.0
+    # above the threshold: no pressure, healthy burn + no backlog -> down
+    assert asc.decide(10, n_serving=2, backlog=0,
+                      memory_steps=500.0) == "down"
+    # at max replicas nothing can scale up, but the pressure still
+    # vetoes the burn-based down — the fleet holds
+    assert asc.decide(20, n_serving=3, backlog=0,
+                      memory_steps=8.0) is None
+    # no ledger anywhere (None): the signal is absent, not zero
+    assert asc.decide(30, n_serving=2, backlog=0,
+                      memory_steps=None) == "down"
+    # default config (0 = off): a dire forecast changes nothing — the
+    # healthy-burn baseline decision ("down") goes through untouched
+    asc_off = Autoscaler(mon, AutoscalerConfig(cooldown_ticks=1))
+    assert asc_off.decide(1, n_serving=2, backlog=0,
+                          memory_steps=0.0) == "down"
+    with pytest.raises(ValueError, match="scale_up_memory_steps"):
+        AutoscalerConfig(scale_up_memory_steps=-1.0)
+
+
+def test_router_memory_pressure_penalty():
+    from pipegoose_tpu.serving.control_plane.router import Router
+
+    base = {"queued_tokens": 10, "active_tokens_remaining": 5}
+    router = Router("round_robin", memory_pressure_steps=4.0,
+                    memory_pressure_penalty_tokens=1000)
+    assert router._replica_load(None, dict(base)) == 15
+    assert router._replica_load(
+        None, dict(base, steps_to_exhaustion=3.0)) == 1015
+    assert router._replica_load(
+        None, dict(base, steps_to_exhaustion=50.0)) == 15
+    # default-off: near-exhaustion is invisible to routing
+    off = Router("round_robin")
+    assert off._replica_load(
+        None, dict(base, steps_to_exhaustion=0.0)) == 15
+    with pytest.raises(ValueError, match="memory_pressure"):
+        Router("round_robin", memory_pressure_steps=-1.0)
+
+
+def test_plane_memledger_knob_and_fleet_memory_rollup(tiny):
+    params, cfg = tiny
+    reqs = _replay_requests(n=8)
+    plane = ControlPlane(_factory(params, cfg), n_replicas=2,
+                         memledger=True)
+    outs, _ = plane.run(reqs())
+    assert len(outs) == 8
+    fm = plane.fleet_memory()
+    assert set(fm["replicas"]) == {"replica0", "replica1"}
+    for row in fm["replicas"].values():
+        assert row["conservation_ok"] is True
+        assert row["conservation_failures"] == 0 and row["leaks"] == 0
+        assert row["bytes_per_page"] > 0
+    assert fm["conservation_ok"] is True and fm["leaks"] == 0
+    assert fm["total_bytes_by_class"]["cached"] > 0    # warm tries
+    assert plane.fleet_status()["memory"]["total_bytes_by_class"] == \
+        fm["total_bytes_by_class"]
+    # default plane: no ledgers, the rollup reports absence as None
+    bare = ControlPlane(_factory(params, cfg), n_replicas=1)
+    assert bare.fleet_memory() is None
+    assert bare.fleet_status()["memory"] is None

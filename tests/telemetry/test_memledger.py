@@ -1,0 +1,342 @@
+"""Memory-ledger unit contract (telemetry/memledger.py, ISSUE 18):
+the owner-tag multiset mirrors pool refcounts exactly, pages classify
+by strongest owner, conservation is integer-exact every tick, the
+audit cross-check catches leaks / double owners / stranded
+reservations and fires each black box ONCE, the exhaustion forecast
+walks monotonically to zero under steady consumption, and the
+Perfetto counter-track renderer emits one "C" event set per sample."""
+import math
+from types import SimpleNamespace
+
+import pytest
+
+from pipegoose_tpu.serving.kv_pool import PagePool
+from pipegoose_tpu.telemetry.chrometrace import (
+    PID_MEMORY,
+    memory_trace_events,
+)
+from pipegoose_tpu.telemetry.flightrec import FlightRecorder
+from pipegoose_tpu.telemetry.memledger import MemoryLedger
+from pipegoose_tpu.telemetry.registry import MetricsRegistry
+
+
+def _pool(n=16, ps=4, **kw):
+    return PagePool(n, ps, **kw)
+
+
+def _bound(pool=None, **kw):
+    pool = pool if pool is not None else _pool()
+    led = MemoryLedger()
+    led.bind(pool, **kw)
+    return pool, led
+
+
+def _alloc(pool, n, tag):
+    pool.tag = tag
+    return pool.alloc(n)
+
+
+# --- observer feed: tags, classes, priority --------------------------------
+
+
+def test_alloc_share_release_mirror_refcounts_and_classify():
+    pool, led = _bound()
+    pages = _alloc(pool, 2, ("req", 7))
+    assert led.counts()["request"] == 2
+    # a cache share on a request page: counted ONCE, strongest owner
+    pool.tag = ("cache",)
+    pool.share([pages[0]])
+    c = led.counts()
+    assert c["request"] == 2 and c["cached"] == 0
+    # the request side releases: the page DEMOTES to cached, not freed
+    pool.tag = ("req", 7)
+    pool.release([pages[0]])
+    c = led.counts()
+    assert c["request"] == 1 and c["cached"] == 1
+    assert pool.refcount(pages[0]) == 1
+    assert led.conservation()["ok"]
+    assert led.mismatched_releases == 0
+
+
+def test_untagged_release_drops_weakest_tag():
+    pool, led = _bound()
+    (p,) = _alloc(pool, 1, ("req", 1))
+    pool.tag = ("cache",)
+    pool.share([p])
+    # untagged release (legacy call site): the WEAKEST owner goes, the
+    # page stays request-class — a ledger gap may misattribute, never
+    # demote a live request's page
+    pool.release([p])
+    assert led.counts()["request"] == 1
+    assert led.counts()["cached"] == 0
+
+
+def test_mismatched_release_counted_not_raised():
+    pool, led = _bound()
+    (p,) = _alloc(pool, 1, ("req", 1))
+    pool.tag = ("stage", 99)         # release a tag the page never had
+    pool.release([p])
+    assert led.mismatched_releases == 1
+    assert led.counts()["request"] == 0   # refcount 0: fully freed
+    assert led.conservation()["ok"]
+
+
+def test_retag_moves_staged_to_request_without_refcount_change():
+    pool, led = _bound()
+    pages = _alloc(pool, 2, ("stage", 3))
+    assert led.counts()["staged"] == 2
+    led.retag(pages, ("stage", 3), ("req", 3))
+    c = led.counts()
+    assert c["staged"] == 0 and c["request"] == 2
+    assert pool.used_count == 2 and led.conservation()["ok"]
+
+
+def test_trail_records_transitions_and_survives_free():
+    pool, led = _bound()
+    (p,) = _alloc(pool, 1, ("req", 5))
+    pool.tag = ("req", 5)
+    pool.release([p])
+    trail = led.trail(p)
+    assert [e["event"] for e in trail] == ["alloc", "release"]
+    assert trail[0]["owner"] == ["req", 5]
+    assert p not in led._tags            # freed, but the trail remains
+
+
+def test_resync_adopts_warm_pool_as_untracked():
+    pool = _pool()
+    pages = pool.alloc(3)                # allocated BEFORE any ledger
+    led = MemoryLedger()
+    led.bind(pool)
+    assert led.counts()["request"] == 3  # untracked counts as request
+    assert led.conservation()["ok"]
+    # the adopted refs release cleanly (weakest-tag drop)
+    pool.release(pages)
+    assert led.counts()["request"] == 0
+
+
+# --- conservation with reservations ----------------------------------------
+
+
+def test_reserved_unmaterialized_completes_the_partition():
+    pool = _pool(16)
+    sched = SimpleNamespace(_outstanding_total=5, transfers={},
+                            active=lambda: [])
+    led = MemoryLedger()
+    led.bind(pool, sched=sched)
+    _alloc(pool, 4, ("req", 1))
+    c = led.counts()
+    assert c["reserved_unmaterialized"] == 5
+    assert c["free"] == pool.free_count - 5
+    cons = led.conservation()
+    assert cons["ok"]
+    assert cons["sum_pages"] == pool.capacity
+    # reservations beyond the physically free pages report as
+    # evictable-backed overlap, keeping the capacity sum a partition
+    sched._outstanding_total = pool.free_count + 3
+    cons = led.conservation()
+    assert cons["ok"] and cons["reserved_evictable_backed"] == 3
+
+
+def test_on_tick_conservation_break_fires_once_and_never_raises(tmp_path):
+    pool = _pool()
+    rec = FlightRecorder(str(tmp_path), capacity=8)
+    led = MemoryLedger()
+    led.bind(pool, recorder=rec)
+    _alloc(pool, 2, ("req", 1))
+    # corrupt the mirror behind the ledger's back: classified != used
+    led._tags.clear()
+    led._class.clear()
+    led._counts = {k: 0 for k in led._counts}
+    led.on_tick(1)
+    led.on_tick(2)
+    assert led.conservation_failures == 2
+    trig = rec.take_trigger()
+    assert trig is not None and trig.name == "ledger_conservation"
+    assert rec.take_trigger() is None    # fired ONCE across both ticks
+
+
+# --- audit: leaks, double owners, stranded reservations --------------------
+
+
+def test_audit_detects_leak_with_owner_trail_and_fires_once(tmp_path):
+    pool = _pool()
+    rec = FlightRecorder(str(tmp_path), capacity=8)
+    sched = SimpleNamespace(_outstanding_total=0, transfers={},
+                            active=lambda: [])
+    led = MemoryLedger()
+    led.bind(pool, sched=sched, recorder=rec)
+    (p,) = _alloc(pool, 1, ("req", 4))
+    # the leak: an extra reference nobody reachable owns
+    pool.tag = ("req", 4)
+    pool.share([p])
+    report = led.audit()
+    assert not report["ok"]
+    (leak,) = report["leaks"]
+    assert leak["page"] == p and leak["refcount"] == 2
+    assert leak["holders"] == 0          # the stub sched holds nothing
+    assert leak["trail"], "leak box must carry the ownership trail"
+    trig = rec.take_trigger()
+    assert trig is not None and trig.name == "memory_leak"
+    assert str(p) in trig.reason
+    led.audit()                          # re-audit: counted, quiet
+    assert led.audits_run == 2
+    assert rec.take_trigger() is None
+
+
+def test_audit_detects_double_owner(tmp_path):
+    pool = _pool()
+    rec = FlightRecorder(str(tmp_path), capacity=8)
+    led = MemoryLedger()
+    (p,) = pool.alloc(1)
+    # two requests both claim the page; the pool granted ONE reference
+    req_a = SimpleNamespace(uid=1, pages=[p], cow=None, outstanding=0)
+    req_b = SimpleNamespace(uid=2, pages=[p], cow=None, outstanding=0)
+    sched = SimpleNamespace(_outstanding_total=0, transfers={},
+                            active=lambda: [req_a, req_b])
+    led.bind(pool, sched=sched, recorder=rec)
+    report = led.audit()
+    (dbl,) = report["double_owners"]
+    assert dbl["page"] == p and dbl["holders"] == 2 and dbl["refcount"] == 1
+    trig = rec.take_trigger()
+    assert trig is not None and trig.name == "double_owner"
+
+
+def test_audit_detects_stranded_reservation(tmp_path):
+    pool = _pool()
+    rec = FlightRecorder(str(tmp_path), capacity=8)
+    sched = SimpleNamespace(_outstanding_total=3, transfers={},
+                            active=lambda: [])
+    led = MemoryLedger()
+    led.bind(pool, sched=sched, recorder=rec)
+    report = led.audit()
+    assert report["stranded_reserved_pages"] == 3
+    trig = rec.take_trigger()
+    assert trig is not None and trig.name == "stranded_reservation"
+    assert "3" in trig.reason
+
+
+def test_audit_clean_pool_is_ok():
+    pool, led = _bound()
+    req = SimpleNamespace(uid=1, pages=[], cow=None, outstanding=0)
+    sched = SimpleNamespace(_outstanding_total=0, transfers={},
+                            active=lambda: [req])
+    led.sched = sched
+    req.pages = _alloc(pool, 2, ("req", 1))
+    assert led.audit()["ok"]
+
+
+# --- exhaustion forecast ---------------------------------------------------
+
+
+def test_forecast_monotone_to_zero_under_steady_consumption():
+    pool = _pool(32)
+    sched = SimpleNamespace(_outstanding_total=0, transfers={},
+                            active=lambda: [])
+    led = MemoryLedger()
+    led.bind(pool, sched=sched)
+    seen = []
+    for t in range(1, 14):
+        _alloc(pool, 2, ("req", t))
+        led.note_admission(4, True)
+        led.on_tick(t)
+        seen.append(led.steps_to_exhaustion)
+    finite = [s for s in seen if not math.isinf(s)]
+    assert finite, "a steady drain must produce a finite forecast"
+    assert finite == sorted(finite, reverse=True)   # monotone down
+    assert finite[-1] == 0.0
+    assert led.min_steps_to_exhaustion == 0.0
+
+
+def test_forecast_infinite_without_consumption_trend():
+    pool, led = _bound()
+    for t in range(1, 4):
+        led.on_tick(t)
+    assert math.isinf(led.steps_to_exhaustion)
+
+
+def test_note_admission_block_records_first_tick():
+    pool, led = _bound()
+    led.on_tick(1)
+    led.on_tick(2)
+    led.note_admission(4, False)
+    led.note_admission(4, False)
+    assert led.first_admission_block_tick == 2   # first block only
+
+
+# --- reports, gauges, history ring, trace renderer -------------------------
+
+
+def test_report_shapes_and_gauges(tmp_path):
+    reg = MetricsRegistry(enabled=True)
+    pool = _pool()
+    led = MemoryLedger()
+    led.bind(pool, registry=reg, bytes_per_page=128)
+    _alloc(pool, 3, ("req", 1))
+    led.on_tick(1, t=0.25)
+    rep = led.report()
+    assert rep["classes"]["request"] == {"pages": 3, "bytes": 384}
+    assert rep["conservation"]["ok"]
+    assert rep["capacity_bytes"] == pool.capacity * 128
+    assert rep["forecast"]["steps_to_exhaustion"] is None   # inf -> None
+    g = reg.gauge("serving.memledger.request_bytes")
+    assert g.value == 384.0
+    assert reg.gauge("serving.memledger.steps_to_exhaustion").value == -1.0
+    summary = led.run_summary()
+    assert summary["peak_pages"]["request"] == 3
+    assert summary["peak_bytes"]["request"] == 384
+    assert summary["conservation_failures"] == 0
+
+
+def test_history_ring_bounded_with_dropped_counter():
+    pool = _pool(64, 4, history_limit=4)
+    for _ in range(6):
+        pool.release(pool.alloc(1))
+    assert len(pool.history) == 4
+    assert pool.history_dropped == 8          # 12 events, 4 kept
+    with pytest.raises(ValueError, match="history_limit"):
+        _pool(history_limit=0)
+
+
+def test_ledger_exact_after_history_ring_wraps():
+    """The observer contract: accounting stays exact even after the
+    (bounded) history ring has dropped events — the ledger is fed
+    synchronously, not parsed from the ring."""
+    pool = _pool(64, 4, history_limit=2)
+    led = MemoryLedger()
+    led.bind(pool)
+    held = []
+    for i in range(8):
+        held += _alloc(pool, 1, ("req", i))
+    assert pool.history_dropped > 0
+    assert led.counts()["request"] == 8
+    assert led.conservation()["ok"]
+    for i, p in enumerate(held):
+        pool.tag = ("req", i)
+        pool.release([p])
+    assert led.counts()["request"] == 0 and led.conservation()["ok"]
+
+
+def test_memory_trace_events_render_counter_tracks():
+    pool, led = _bound(host_tier=SimpleNamespace(
+        resident_bytes=640, resident_pages=5, byte_budget=1 << 20))
+    led.bytes_per_page = 64
+    _alloc(pool, 2, ("req", 1))
+    led.on_tick(1, t=1.0)
+    led.on_tick(2, t=1.5)
+    events = memory_trace_events(led)
+    assert events[0]["ph"] == "M" and events[0]["pid"] == PID_MEMORY
+    counters = [e for e in events if e["ph"] == "C"]
+    kv = [e for e in counters if e["name"] == "kv bytes"]
+    assert len(kv) == 2
+    assert kv[0]["ts"] == 1.0 * 1e6
+    assert kv[0]["args"]["request"] == 2 * 64
+    assert {e["name"] for e in counters} >= {
+        "kv bytes", "fragmentation", "host tier bytes"}
+
+
+def test_unbind_detaches_observer():
+    pool, led = _bound()
+    led.unbind()
+    assert pool.ledger is None
+    pool.alloc(1)
+    assert led.counts()["request"] == 0   # no longer fed

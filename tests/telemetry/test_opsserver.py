@@ -224,3 +224,35 @@ def test_root_lists_profile_and_plan_endpoints(reg):
         _, body = _get(srv.url + "/")
         eps = json.loads(body)["endpoints"]
         assert "/debug/profile" in eps and "/debug/plan" in eps
+
+
+def test_debug_memory_serves_ledger_report(reg):
+    """/debug/memory (ISSUE 18): provider-or-callable like the other
+    debug endpoints; ``MemoryLedger.report`` is the natural provider."""
+    with OpsServer(registry=reg, port=0) as srv:
+        code, body = _get(srv.url + "/debug/memory")
+        assert code == 404 and "memory" in json.loads(body)["error"]
+        _, root = _get(srv.url + "/")
+        assert "/debug/memory" in json.loads(root)["endpoints"]
+
+    from pipegoose_tpu.serving.kv_pool import PagePool
+    from pipegoose_tpu.telemetry.memledger import MemoryLedger
+
+    pool = PagePool(num_pages=8, page_size=4)
+    led = MemoryLedger()
+    led.bind(pool, bytes_per_page=64)
+    pool.tag = ("req", 1)
+    pool.alloc(2)
+    led.on_tick(1)
+    with OpsServer(registry=reg, port=0, memory=led.report) as srv:
+        code, body = _get(srv.url + "/debug/memory")
+        payload = json.loads(body)
+        assert code == 200
+        assert payload["classes"]["request"] == {"pages": 2, "bytes": 128}
+        assert payload["conservation"]["ok"] is True
+        assert payload["capacity_bytes"] == 7 * 64
+    # set_memory() wires it post-construction (the engine-side path)
+    with OpsServer(registry=reg, port=0) as srv:
+        srv.set_memory(led.report)
+        code, body = _get(srv.url + "/debug/memory")
+        assert code == 200 and json.loads(body)["ticks"] == 1
