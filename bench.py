@@ -653,6 +653,17 @@ def run_bench(force_cpu: bool) -> None:
                           conservation_failures=mem[
                               "conservation_failures"],
                           leaks=mem["leaks"])
+            # the fleet goodput ledger's wall attribution (ISSUE 19):
+            # availability lands in bench_telemetry.jsonl next to the
+            # memory peaks, so an incident-burning bench run is visible
+            # without opening the trace
+            gp = res.get("control_plane", {}).get("goodput")
+            if gp is not None:
+                reg.event("bench.serving_goodput",
+                          goodput_fraction=gp["goodput_fraction"],
+                          badput_seconds=gp["badput_seconds"],
+                          incidents=gp["incidents"],
+                          conservation_ok=gp["conservation_ok"])
         return res
 
     def emit(results, serving=None) -> bool:
@@ -951,6 +962,18 @@ def run_bench(force_cpu: bool) -> None:
                     "conservation_failures":
                         smem["conservation_failures"],
                     "leaks": smem["leaks"],
+                }
+            # fleet goodput (ISSUE 19): availability fraction +
+            # incident count per trajectory row — PerfSentinel can
+            # watch goodput the same way it watches tokens/s
+            if (isinstance(serving, dict)
+                    and isinstance(serving.get("control_plane"), dict)
+                    and serving["control_plane"].get("goodput")):
+                sgp = serving["control_plane"]["goodput"]
+                row["serving_goodput"] = {
+                    "goodput_fraction": sgp["goodput_fraction"],
+                    "incidents": sgp["incidents"],
+                    "conservation_ok": sgp["conservation_ok"],
                 }
             # baseline = same-device healthy rows only: a CPU-fallback
             # run judged against a TPU trajectory (or vice versa) would

@@ -23,8 +23,9 @@ Pull-driven like the monitor itself: the control plane calls
 """
 from __future__ import annotations
 
+from collections import deque
 from dataclasses import dataclass
-from typing import Any, Dict, List, Optional
+from typing import Any, Deque, Dict, Optional
 
 
 @dataclass(frozen=True)
@@ -66,10 +67,15 @@ class Autoscaler:
     audit trail."""
 
     def __init__(self, monitor: Any,
-                 config: Optional[AutoscalerConfig] = None):
+                 config: Optional[AutoscalerConfig] = None,
+                 max_log: int = 256):
         self.monitor = monitor
         self.config = config or AutoscalerConfig()
-        self.log: List[Dict[str, Any]] = []
+        # bounded like Router.decisions: a long-lived plane must not
+        # grow its audit trail without limit — newest kept, drops
+        # counted so a truncated trail is detectable
+        self.log: Deque[Dict[str, Any]] = deque(maxlen=max_log)
+        self.log_dropped = 0
         self._last_action_tick: Optional[int] = None
 
     def decide(self, tick: int, n_serving: int, backlog: int,
@@ -137,6 +143,9 @@ class Autoscaler:
                       f"backlog")
         if decision is not None:
             self._last_action_tick = tick
+            if (self.log.maxlen is not None
+                    and len(self.log) == self.log.maxlen):
+                self.log_dropped += 1
             self.log.append({
                 "tick": tick,
                 "decision": decision,

@@ -103,7 +103,7 @@ def control_plane_replay_benchmark(
         page_size: int = 8, max_context: int = 96,
         prefill_chunk: Optional[int] = None, drain_check: bool = True,
         drain_at_tick: int = 3, affinity_slack_tokens: int = 192,
-        fleet_trace: bool = False):
+        fleet_trace: bool = False, goodput: bool = True):
     """Measure the routing arms on one multi-tenant trace (module
     docstring); returns a JSON-able dict with per-arm rows, a summary
     (prefill-token reduction + TTFT p99 speedup of cache-aware over
@@ -115,7 +115,13 @@ def control_plane_replay_benchmark(
     measured rows) and attaches its stitched attribution — per-hop
     p50/p99 over ingress/ledger/route/dispatch/replica plus the top-3
     slowest tail exemplars per objective — as ``results["fleet_
-    trace"]`` (bench.py writes it to ``bench_fleet_trace.json``)."""
+    trace"]`` (bench.py writes it to ``bench_fleet_trace.json``).
+
+    ``goodput=True`` (default) runs the arms on goodput-ledgered
+    planes and attaches the cache-aware arm's wall attribution —
+    goodput fraction, per-class badput split, incident count — as
+    ``results["goodput"]``, so BENCH_HISTORY rows carry an
+    availability signal ``PerfSentinel`` can watch."""
     vocab = getattr(config, "valid_vocab_size", None) or config.vocab_size
     replay = make_skewed_replay(
         n_requests=n_requests, n_prefixes=n_prefixes, prefix_len=prefix_len,
@@ -145,7 +151,8 @@ def control_plane_replay_benchmark(
         # fleet_pull arm, measured separately)
         plane = ControlPlane(factory(), n_replicas=n_replicas,
                              policy=policy, pull_hints=False,
-                             affinity_slack_tokens=affinity_slack_tokens)
+                             affinity_slack_tokens=affinity_slack_tokens,
+                             goodput=goodput)
         planes[policy] = plane
         # two warmups, same convention as prefix_replay_benchmark: the
         # first compiles the miss paths and seeds every replica cache,
@@ -213,6 +220,11 @@ def control_plane_replay_benchmark(
             "dropped": n_requests - len(drain_outs),
             "outputs_token_identical": bool(identical),
         }
+    if goodput:
+        # the cache-aware arm's full-lifetime wall attribution (warmups
+        # + measured replay + drain when enabled): the availability row
+        # BENCH_HISTORY carries for PerfSentinel
+        results["goodput"] = planes["cache_aware"].goodput.summary()
     if fleet_trace:
         # one traced replay on a fresh cache-aware plane: the stitched
         # per-hop attribution (conservation-exact: plane hops + replica

@@ -106,7 +106,8 @@ class ControlPlane:
                  probation_ticks: int = 8,
                  pull_hints: bool = True,
                  fleet_tracer: Optional[Any] = None,
-                 memledger: bool = False):
+                 memledger: bool = False,
+                 goodput: Any = False):
         """``recorder``: optional ``telemetry.FlightRecorder`` — every
         replica failure dumps ONE ``replica_failure`` black box naming
         the replica and the salvaged/resubmitted/lost uids; an
@@ -131,7 +132,15 @@ class ControlPlane:
         ``memledger``: attach one ``telemetry.MemoryLedger`` per
         replica (factory-attached ledgers are kept) — the fleet-minimum
         steps-to-exhaustion then feeds the autoscaler and
-        ``fleet_status()`` grows a per-replica memory rollup."""
+        ``fleet_status()`` grows a per-replica memory rollup.
+        ``goodput``: ``True`` (or a ``telemetry.GoodputLedger``
+        instance) attributes every replica-second of the run's wall
+        into the goodput/badput taxonomy and mints one ``Incident`` per
+        failure episode (telemetry/goodput.py) — ``fleet_status()``
+        grows a ``goodput`` rollup, ``run()``'s metrics a ``goodput``
+        row, and each ``replica_failure`` black box embeds its
+        incident. Off (the default), the per-tick cost is one
+        attribute read + branch."""
         if n_replicas < 1:
             raise ValueError(f"n_replicas must be >= 1, got {n_replicas}")
         if stall_patience < 1:
@@ -166,6 +175,16 @@ class ControlPlane:
         self.probation_ticks = probation_ticks
         self.registry = (registry if registry is not None
                          else MetricsRegistry(enabled=True))
+        # goodput wall-clock ledger (telemetry/goodput.py): True
+        # constructs one publishing into the plane's registry; an
+        # instance is adopted as-is (tests inject seeded ledgers)
+        if goodput is True:
+            from pipegoose_tpu.telemetry.goodput import GoodputLedger
+
+            goodput = GoodputLedger(registry=self.registry)
+        self.goodput = goodput or None
+        self._tick = 0   # last tick seen by run() — lifecycle calls
+        #                  outside the loop (rejoin/drain) stamp it
         self.router = Router(policy, registry=self.registry,
                              affinity_slack_tokens=affinity_slack_tokens)
         self.ledger = ledger if ledger is not None else TenantLedger()
@@ -260,6 +279,10 @@ class ControlPlane:
         if self._running:
             engine.start_run((), now=self._now)
             self._started.append(rep)
+            if self.goodput is not None:
+                # mid-run scale-up: the account's alive wall starts NOW
+                self.goodput.touch(name, self._now(), "serving",
+                                   self._tick)
         self._m_replicas.set(float(len(self.serving_replicas())))
         return rep
 
@@ -276,9 +299,15 @@ class ControlPlane:
         engine compiles its programs on first use — on real fleets the
         factory hands back a pre-warmed engine. Closes one unit of
         unplanned capacity gap when a failure opened one."""
+        closed_gap = self._capacity_gap > 0
         rep = self._add_replica()
         self._m_scaleups.inc()
         self._capacity_gap = max(0, self._capacity_gap - 1)
+        if self.goodput is not None and closed_gap:
+            # replacement capacity is accepting: the OLDEST open
+            # incident's MTTR window closes here
+            self.goodput.resolve_incident(None, self._tick, self._now(),
+                                          "scale_up")
         return rep
 
     def rejoin(self, name: str, *,
@@ -305,8 +334,16 @@ class ControlPlane:
                 f"cannot rejoin (replace it with scale_up instead)"
             )
         rep.engine.inject_fault(None)
+        if self.goodput is not None:
+            # book the quarantine dwell up to this very moment, then
+            # close the replica's incident: MTTR = detection -> HERE
+            t_rejoin = self._now()
+            self.goodput.touch(rep.name, t_rejoin, rep.state.value,
+                               self._tick)
+            self.goodput.resolve_incident(rep.name, self._tick,
+                                          t_rejoin, "rejoin")
         rep.rejoin(self.probation_ticks if probation_ticks is None
-                   else probation_ticks)
+                   else probation_ticks, tick=self._tick)
         self._capacity_gap = max(0, self._capacity_gap - 1)
         if self._running and not rep.engine.run_in_progress:
             rep.engine.start_run((), now=self._now)
@@ -336,7 +373,7 @@ class ControlPlane:
             if not match:
                 raise ValueError(f"no serving replica named {name!r}")
             rep = match[0]
-        migrated = rep.start_drain()
+        migrated = rep.start_drain(tick=self._tick)
         self.router.drop_replica(rep.name)
         if self.directory is not None:
             self.directory.retract_replica(rep.name)
@@ -620,7 +657,7 @@ class ControlPlane:
         router verdict; a fully recovered failure (nothing lost,
         survivors serving) consumes its own trigger so ``/healthz``
         flips only on an UNRECOVERED failure."""
-        rep.mark_failed(reason)
+        rep.mark_failed(reason, tick=tick)
         self.router.drop_replica(rep.name)
         if self.directory is not None:
             self.directory.retract_replica(rep.name)
@@ -670,6 +707,21 @@ class ControlPlane:
         self._m_resubmitted.inc(len(resubmitted))
         self._m_lost.inc(len(lost))
         self._m_replicas.set(float(len(self.serving_replicas())))
+        incident = None
+        if self.goodput is not None:
+            # one Incident per failure episode, joined to the
+            # chaos.injection ring for detection latency; it stays open
+            # (capacity-gap integral accruing per tick) until rejoin or
+            # scale_up closes its MTTR window
+            incident = self.goodput.open_incident(
+                "wedge" if reason.startswith("wedged") else "crash",
+                rep.name, tick, self._now(), reason=reason,
+                recorder=self.recorder,
+                injection_kinds=("replica_crash", "replica_wedge"),
+                salvaged_uids=salvaged, resubmitted_uids=resubmitted,
+                completed_uids=completed, lost_uids=lost,
+                capacity_gap=self._capacity_gap,
+            )
         if self.recorder is None:
             return
         recovered = not lost and bool(self.serving_replicas())
@@ -702,6 +754,8 @@ class ControlPlane:
                 "completed_uids": completed,
                 "lost_uids": lost,
                 "recovered": recovered,
+                "incident": (incident.as_dict()
+                             if incident is not None else None),
                 "router": {
                     "verdict": "quarantined",
                     "shadow_dropped": True,
@@ -776,27 +830,44 @@ class ControlPlane:
             self._started = [rep for rep in self.replicas
                              if rep.state not in (ReplicaState.STOPPED,
                                                   ReplicaState.FAILED)]
+            gp = self.goodput
             for rep in self._started:
                 rep.engine.start_run((), now=now)
+            if gp is not None:
+                # alive wall opens at run start for every participant
+                # (existing accounts book the between-runs gap into the
+                # class their current state implies)
+                t_open = now()
+                for rep in self._started:
+                    gp.touch(rep.name, t_open, rep.state.value, 0)
             for req in requests:
                 self.submit(req, now())
             tick = 0
             idle_ticks = 0
             while self._busy():
                 tick += 1
+                self._tick = tick
                 if tick_hook is not None:
                     tick_hook(self, tick)
                 self._autoscale(tick, now())
                 self._shed_expired(now())
                 placed = self._dispatch(now(), tick)
                 progressed = placed > 0
+                marks = [] if gp is not None else None
                 for rep in self.replicas:
                     if rep.state in (ReplicaState.STOPPED,
                                      ReplicaState.FAILED):
+                        if (gp is not None
+                                and rep.state is ReplicaState.FAILED):
+                            # quarantined replicas burn wall too — the
+                            # taxonomy is exhaustive over ALIVE
+                            # replicas, and FAILED is alive-but-useless
+                            marks.append((rep, "failed_quarantine"))
                         continue
                     if rep.probation_ticks_left > 0:
                         rep.probation_ticks_left -= 1
                     eng = rep.engine
+                    pre = gp.pre_tick(rep) if gp is not None else None
                     had_work = not eng.sched.all_done()
                     ticked = False
                     if had_work:
@@ -813,6 +884,8 @@ class ControlPlane:
                                 f"{type(e).__name__}: {e}",
                             )
                             progressed = True  # handling IS progress
+                            if gp is not None:
+                                marks.append((rep, "failed_quarantine"))
                             continue
                     took = False
                     for req, out in eng.take_finished():
@@ -820,7 +893,7 @@ class ControlPlane:
                         self._observe_finished(req, out)
                         took = True
                     if ticked or took:
-                        rep.note_progress()
+                        rep.note_progress(tick)
                         progressed = True
                     elif had_work:
                         # heartbeat miss with work pending: the wedge
@@ -835,7 +908,20 @@ class ControlPlane:
                             progressed = True
                         elif n >= self.suspect_after_ticks:
                             rep.mark_suspect(tick)
-                    rep.maybe_stop()
+                    rep.maybe_stop(tick)
+                    if gp is not None:
+                        marks.append(
+                            (rep, gp.classify(rep, pre, had_work,
+                                              ticked, took)))
+                        kvt = getattr(eng, "kv_tier", None)
+                        if (kvt is not None
+                                and kvt.fallbacks > pre[2]):
+                            gp.note_transfer_flap(
+                                rep.name, tick, now(),
+                                kvt.fallbacks - pre[2],
+                                recorder=self.recorder,
+                            )
+                self._goodput_flush(marks, tick, now)
                 if progressed:
                     idle_ticks = 0
                 else:
@@ -898,7 +984,23 @@ class ControlPlane:
             metrics["kv_directory"] = self.directory.stats()
         if self.autoscaler is not None:
             metrics["autoscaler"] = list(self.autoscaler.log)
+        if self.goodput is not None:
+            self.goodput.publish()
+            metrics["goodput"] = self.goodput.summary()
         return outputs, metrics
+
+    def _goodput_flush(self, marks, tick: int, now) -> None:
+        """Book one tick's wall into the goodput ledger: every mark is
+        (replica, class) and each replica's share is the wall since ITS
+        last mark — the telescoping sum that makes conservation exact.
+        Ledger off => one attribute load + compare (the <5 µs guard)."""
+        if self.goodput is None:
+            return
+        gp = self.goodput
+        t_mark = now()
+        for rep, klass in marks:
+            gp.account(rep.name, t_mark, klass, rep.state.value, tick)
+        gp.on_tick(tick, t_mark)
 
     # -- observability -----------------------------------------------------
 
@@ -951,8 +1053,13 @@ class ControlPlane:
         """The ``/debug/fleet`` payload: per-replica state + load,
         router stats, per-tenant ledger shares, autoscaler audit log,
         memory-ledger rollup — everything JSON-able, snapshot-style."""
+        rows = [rep.status() for rep in self.replicas]
+        if self.goodput is not None:
+            for row in rows:
+                row["state_seconds"] = self.goodput.state_seconds(
+                    row["name"])
         return {
-            "replicas": [rep.status() for rep in self.replicas],
+            "replicas": rows,
             "serving": len(self.serving_replicas()),
             "failed": len(self.failed_replicas()),
             "capacity_gap": self._capacity_gap,
@@ -964,4 +1071,6 @@ class ControlPlane:
             "autoscaler": (list(self.autoscaler.log)
                            if self.autoscaler is not None else None),
             "memory": self.fleet_memory(),
+            "goodput": (self.goodput.summary()
+                        if self.goodput is not None else None),
         }

@@ -61,6 +61,11 @@ class ReplicaState(enum.Enum):
 #: rediscovered within one cap interval, not "eventually"
 MAX_PROBE_BACKOFF = 64
 
+#: state-transition history bound (newest kept): enough to read a
+#: whole crash->suspect->failed->rejoin->probation arc off a
+#: ``/debug/fleet`` row without growing per-replica state unboundedly
+MAX_STATE_HISTORY = 64
+
 
 class Replica:
     """One engine + lifecycle state + per-replica bookkeeping. The
@@ -96,6 +101,15 @@ class Replica:
         # replace the replica with scale_up instead
         self.salvage_degraded = False
         self.inflight: Dict[int, Any] = {}
+        # state-transition audit: (state, since_tick) per transition,
+        # newest-bounded — the /debug/fleet dwell trail (None tick =
+        # the transition happened outside a run's tick loop)
+        self.state_history: List[Any] = [("serving", 0)]
+
+    def _note_state(self, tick: Optional[int]) -> None:
+        self.state_history.append((self.state.value, tick))
+        if len(self.state_history) > MAX_STATE_HISTORY:
+            del self.state_history[0]
 
     @property
     def accepting(self) -> bool:
@@ -113,7 +127,7 @@ class Replica:
 
     # -- health transitions (driven by ControlPlane's heartbeat) -----------
 
-    def note_progress(self) -> bool:
+    def note_progress(self, tick: Optional[int] = None) -> bool:
         """A tick made progress: reset the heartbeat, and recover a
         SUSPECT back to SERVING (backoff reset). Returns True on the
         SUSPECT->SERVING recovery transition."""
@@ -122,6 +136,7 @@ class Replica:
             self.state = ReplicaState.SERVING
             self.probe_backoff = 1
             self.next_probe_tick = 0
+            self._note_state(tick)
             return True
         return False
 
@@ -134,10 +149,13 @@ class Replica:
             self.state = ReplicaState.SUSPECT
             self.probe_backoff = 1
             self.next_probe_tick = tick  # first probe allowed right away
+            self._note_state(tick)
 
-    def mark_failed(self, reason: str) -> None:
+    def mark_failed(self, reason: str,
+                    tick: Optional[int] = None) -> None:
         self.state = ReplicaState.FAILED
         self.failure_reason = reason
+        self._note_state(tick)
 
     def probe_allowed(self, tick: int) -> bool:
         """SUSPECT dispatch gate, side-effect-free: is a probe window
@@ -154,7 +172,8 @@ class Replica:
         self.next_probe_tick = tick + self.probe_backoff
         self.probe_backoff = min(self.probe_backoff * 2, MAX_PROBE_BACKOFF)
 
-    def rejoin(self, probation_ticks: int) -> None:
+    def rejoin(self, probation_ticks: int,
+               tick: Optional[int] = None) -> None:
         """FAILED -> SERVING on probation (the control plane clears the
         engine fault and restarts the run; this just flips the state)."""
         if self.state is not ReplicaState.FAILED:
@@ -167,10 +186,11 @@ class Replica:
         self.probe_backoff = 1
         self.next_probe_tick = 0
         self.probation_ticks_left = int(probation_ticks)
+        self._note_state(tick)
 
     # -- planned lifecycle -------------------------------------------------
 
-    def start_drain(self) -> List[Any]:
+    def start_drain(self, tick: Optional[int] = None) -> List[Any]:
         """Flip to DRAINING and give up every request: active ones are
         preempted (the scheduler requeues them with pages released),
         then the whole queue is withdrawn. Returns the migrated
@@ -182,6 +202,7 @@ class Replica:
                 f"replica {self.name!r} is {self.state.value}, not serving"
             )
         self.state = ReplicaState.DRAINING
+        self._note_state(tick)
         sched = self.engine.sched
         for req in list(sched.active()):
             sched.preempt(req)
@@ -191,7 +212,7 @@ class Replica:
             self.inflight.pop(id(req), None)
         return migrated
 
-    def maybe_stop(self) -> bool:
+    def maybe_stop(self, tick: Optional[int] = None) -> bool:
         """DRAINING -> STOPPED once the scheduler is empty; closes the
         engine's run and captures its aggregate metrics."""
         if self.state is not ReplicaState.DRAINING:
@@ -201,6 +222,7 @@ class Replica:
         if self.engine.run_in_progress:
             _, self.final_metrics = self.engine.finish_run()
         self.state = ReplicaState.STOPPED
+        self._note_state(tick)
         return True
 
     def status(self) -> Dict[str, Any]:
@@ -213,6 +235,11 @@ class Replica:
             "migrated_out": self.migrated_out,
             "salvaged_out": self.salvaged_out,
             "no_progress_ticks": self.no_progress_ticks,
+            # the dwell trail: every transition as (state, since_tick)
+            # — quarantine/probation dwell readable without the full
+            # goodput report (the plane adds state_seconds when a
+            # goodput ledger is attached)
+            "state_history": [list(h) for h in self.state_history],
         }
         if self.failure_reason is not None:
             out["failure_reason"] = self.failure_reason

@@ -407,6 +407,13 @@ class ServingEngine:
         # fleet-directory publication seam: the control plane installs
         # hook(tokens, location) per replica; None costs one branch
         self.on_prefix_publish = None
+        # goodput compile/warmup detection (telemetry/goodput.py): one
+        # entry per jitted program family x width actually executed —
+        # the control plane reads the counter delta around a tick to
+        # book first-run (compile + warmup) wall separately from
+        # steady-state productive wall
+        self._progs_seen: set = set()
+        self.programs_run = 0
         # every cached engine gets a RestoreManager (cheap — nothing
         # compiles until the first spill/pull), so it can serve as a
         # pull PEER even without a host tier of its own
@@ -803,6 +810,15 @@ class ServingEngine:
         )
         self.memledger = ledger
 
+    def _note_program(self, family: str, width: int) -> None:
+        """Record one jitted-program execution for the goodput
+        ledger's compile/warmup detection: the first (family, width)
+        pair is the tick that paid the XLA compile."""
+        key = (family, width)
+        if key not in self._progs_seen:
+            self._progs_seen.add(key)
+            self.programs_run += 1
+
     def _ledger_tick(self, rs) -> None:
         """Per-tick ledger hook (conservation check + forecast +
         occupancy sample). With no ledger attached (the default) the
@@ -903,6 +919,7 @@ class ServingEngine:
         with span("serving.prefill", registry=self.registry):
             s = req.prompt_len
             bucket = self.pool.pages_for(s) * self.page_size
+            self._note_program("prefill", bucket)
             pad = bucket - s
             ids = np.zeros((1, bucket), np.int32)
             ids[0, pad:] = np.asarray(req.prompt, np.int32)
@@ -972,6 +989,7 @@ class ServingEngine:
         # monolithic path's prompt buckets
         prog = (self.prefill_chunk if self.prefill_chunk is not None
                 else self.pool.pages_for(n) * self.page_size)
+        self._note_program("chunk", prog)
         self.sched.ensure_pages(req, end)
         ids = np.zeros((1, prog), np.int32)
         ids[0, :n] = req.tokens[begin:end]
@@ -1049,6 +1067,7 @@ class ServingEngine:
         ``done``. Returns (emitted, drafted, accepted, surviving rows
         — lazy growth may retract a neighbor mid-batch)."""
         spec_k, n_spec = self.speculative
+        self._note_program("spec", n_spec)
         table = np.zeros((self.num_slots, self.table_width), np.int32)
         seq = np.zeros((self.num_slots,), np.int32)
         tok0 = np.zeros((self.num_slots,), np.int32)
@@ -1376,6 +1395,7 @@ class ServingEngine:
                 rs.table[req.slot, :len(req.pages)] = req.pages
                 rs.seq_lens[req.slot] = req.cached_len
                 rs.tokens[req.slot] = req.generated[-1]
+            self._note_program("step", 0)
             t_step = now()
             with span("serving.decode_step", registry=reg):
                 nxt, self.k_pages, self.v_pages = self._step(

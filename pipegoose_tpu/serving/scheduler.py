@@ -202,6 +202,12 @@ class Scheduler:
         self.transfers: dict = {}
         self._outstanding_total = 0
         self._next_uid = 0
+        # lifetime count of admissions deferred by the memory ledger's
+        # worst-case check (the head didn't fit): the goodput ledger
+        # reads the per-tick delta to book a no-progress tick as
+        # admission-blocked wall rather than a stall (always on — one
+        # int increment on a path that just did pool arithmetic)
+        self.admission_deferrals = 0
 
     def _worst_tokens(self, req: Request) -> int:
         """Tokens the admission ledger reserves pages for: the decode
@@ -447,6 +453,7 @@ class Scheduler:
                 # the head's worst-case need, and whether memory let it in
                 led.note_admission(worst, fits)
             if not fits:
+                self.admission_deferrals += 1
                 break  # FIFO head-of-line: deterministic admission order
             shared: List[int] = hit.pages if hit is not None else []
             need_new = worst - len(shared)

@@ -35,6 +35,13 @@ the regressed component. ``MemoryLedger`` (telemetry/memledger.py)
 keeps a byte-exact per-owner-class account of the serving KV pool —
 conservation-checked every tick, with leak audits, exhaustion
 forecasting, and Perfetto counter tracks (``memory_trace_events``).
+``GoodputLedger`` (telemetry/goodput.py) is the wall-clock sibling:
+every replica-second attributed to productive / badput classes
+(conservation-exact), one ``Incident`` per failure episode with MTTR
+and capacity-gap accounting, availability SLO counters
+(``availability_slo_target``), Perfetto state bands
+(``goodput_trace_events``), and the ``TrainerGoodput`` callback
+mirroring the taxonomy onto training fit loops.
 
 See docs/observability.md for the metric catalog and the MFU
 methodology.
@@ -42,12 +49,19 @@ methodology.
 from pipegoose_tpu.telemetry.callback import TelemetryCallback
 from pipegoose_tpu.telemetry.chrometrace import (
     ChromeTraceExporter,
+    goodput_trace_events,
     memory_trace_events,
     pipeline_trace_events,
     register_pipeline_gauges,
     router_trace_events,
     span_events_to_trace,
     trace_from_jsonl,
+)
+from pipegoose_tpu.telemetry.goodput import (
+    GoodputLedger,
+    Incident,
+    TrainerGoodput,
+    availability_slo_target,
 )
 from pipegoose_tpu.telemetry.fleet import (
     FleetRegistry,
@@ -136,7 +150,9 @@ __all__ = [
     "FleetTracer",
     "FlightRecorder",
     "Gauge",
+    "GoodputLedger",
     "Histogram",
+    "Incident",
     "JSONLExporter",
     "MemoryLedger",
     "MemoryReport",
@@ -157,10 +173,12 @@ __all__ = [
     "ShardingRegressionError",
     "ShardingReport",
     "TelemetryCallback",
+    "TrainerGoodput",
     "TriggerEvent",
     "assert_fully_sharded",
     "assert_matches_intended",
     "assert_no_resharding",
+    "availability_slo_target",
     "collective_bytes",
     "compiled_step_stats",
     "current_span_path",
@@ -170,6 +188,7 @@ __all__ = [
     "enable",
     "fleet_trace_events",
     "get_registry",
+    "goodput_trace_events",
     "hbm_utilization",
     "health_stats",
     "host_health",

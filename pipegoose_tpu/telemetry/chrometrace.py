@@ -42,6 +42,7 @@ PID_REQUESTS = 3    # per-request serving timelines (telemetry/reqtrace.py)
 PID_FLEET = 4       # control-plane router decisions (one track per replica)
 PID_PLANE = 5       # control-plane hop slices (telemetry/fleettrace.py)
 PID_MEMORY = 6      # memory-ledger counter tracks (telemetry/memledger.py)
+PID_GOODPUT = 7     # goodput state bands + incidents (telemetry/goodput.py)
 # multi-replica request timelines get one process EACH, allocated from
 # here up (the first tracer keeps PID_REQUESTS for backward compat)
 REPLICA_PID_BASE = 10
@@ -293,6 +294,59 @@ def memory_trace_events(ledger: Any, *,
     return events
 
 
+def goodput_trace_events(ledger: Any, *,
+                         pid: int = PID_GOODPUT,
+                         wall_offset: float = 0.0) -> List[dict]:
+    """Render a ``GoodputLedger``'s per-replica state bands
+    (telemetry/goodput.py) as Perfetto rows: ONE TRACK PER REPLICA, one
+    colored slice per class episode (the color keys off the slice name,
+    so productive / stall / failed_quarantine bands read apart at a
+    glance), plus an instant marker at every incident's detection
+    (named by kind, args carrying MTTR + capacity-gap integral).
+    Loadable next to the request timelines and router decisions, so
+    "the fleet lost this replica HERE" lines up with the requests that
+    ate the latency. ``wall_offset`` aligns the clock domain with the
+    span rows (pass the owning tracer's ``wall_offset``)."""
+    events: List[dict] = [{
+        "name": "process_name", "ph": "M", "pid": pid,
+        "args": {"name": "fleet goodput (state bands + incidents)"},
+    }]
+    names = sorted(ledger.replicas)
+    for tid, name in enumerate(names):
+        events.append({
+            "name": "thread_name", "ph": "M", "pid": pid, "tid": tid,
+            "args": {"name": name},
+        })
+        acct = ledger.replicas[name]
+        for ep in acct.episodes:
+            dur = max(ep["t1"] - ep["t0"], 0.0)
+            events.append({
+                "name": ep["class"], "cat": "goodput.state", "ph": "X",
+                "ts": (ep["t0"] + wall_offset) * 1e6, "dur": dur * 1e6,
+                "pid": pid, "tid": tid,
+                "args": {"state": ep["state"], "ticks": ep["ticks"],
+                         "tick0": ep["tick0"], "tick1": ep["tick1"]},
+            })
+    for inc in ledger.incidents:
+        tid = names.index(inc.replica) if inc.replica in names else 0
+        events.append({
+            "name": f"incident {inc.kind}",
+            "cat": "goodput.incident", "ph": "i", "s": "t",
+            "ts": (inc.t_detected + wall_offset) * 1e6,
+            "pid": pid, "tid": tid,
+            "args": {
+                "id": inc.id,
+                "reason": inc.reason,
+                "detection_latency_ticks": inc.detection_latency_ticks,
+                "mttr_s": inc.mttr_s,
+                "resolved_by": inc.resolved_by,
+                "capacity_gap_integral_s": round(
+                    inc.capacity_gap_integral_s, 9),
+            },
+        })
+    return events
+
+
 class ChromeTraceExporter:
     """Registry sink accumulating span/step events as trace events;
     ``write()`` emits one Perfetto-loadable JSON file atomically.
@@ -394,6 +448,11 @@ class ChromeTraceExporter:
         :func:`router_trace_events`) — one track per replica in the
         fleet process group."""
         self.add_events(router_trace_events(decisions, **kwargs))
+
+    def add_goodput(self, ledger: Any, **kwargs: Any) -> None:
+        """Attach a ``GoodputLedger``'s per-replica state bands and
+        incident markers (see :func:`goodput_trace_events`)."""
+        self.add_events(goodput_trace_events(ledger, **kwargs))
 
     def write(self, path: Optional[str] = None) -> Optional[str]:
         """Render and atomically write the trace JSON; returns the path

@@ -81,6 +81,7 @@ _PROVIDER_ENDPOINTS = {
     "/debug/plan": ("_plan", "plan report"),
     "/debug/fleet": ("_fleet", "fleet status provider"),
     "/debug/memory": ("_memory", "memory ledger"),
+    "/debug/goodput": ("_goodput", "goodput ledger"),
 }
 
 
@@ -106,6 +107,10 @@ class OpsServer:
     (e.g. ``engine.memledger.report``) behind ``/debug/memory`` — the
     live memory ledger's per-owner-class byte account, conservation
     verdict, leak-audit findings, and steps-to-exhaustion forecast.
+    ``goodput``: a JSON-able dict or a zero-arg callable returning one
+    (e.g. ``plane.goodput.report``) behind ``/debug/goodput`` — the
+    fleet goodput ledger's wall-clock attribution, conservation
+    verdict, and incident log (MTTR, capacity-gap, SLO burn).
     ``fleettrace``: optional ``telemetry.fleettrace.FleetTracer``
     behind ``/debug/trace`` (one stitched trace by ``?trace_id=`` /
     ``?uid=``) and ``/debug/tail`` (slowest-trace exemplars).
@@ -127,6 +132,7 @@ class OpsServer:
         fleet: Optional[Any] = None,
         fleettrace: Optional[Any] = None,
         memory: Optional[Any] = None,
+        goodput: Optional[Any] = None,
     ):
         self.registry = registry if registry is not None else get_registry()
         self.host = host
@@ -140,6 +146,7 @@ class OpsServer:
         self._plan = plan
         self._fleet = fleet
         self._memory = memory
+        self._goodput = goodput
         self.fleettrace = fleettrace
         self._lock = threading.Lock()
         # SLOMonitor mutates per-target state on evaluate(), so
@@ -192,6 +199,13 @@ class OpsServer:
         """Attach (or replace) the provider behind ``/debug/memory``."""
         with self._lock:
             self._memory = memory
+
+    def set_goodput(self, goodput: Any) -> None:
+        """Attach (or replace) the provider behind ``/debug/goodput``
+        — a ``GoodputLedger.report``-shaped dict or a callable
+        returning one (``lambda: ledger.report()`` stays live)."""
+        with self._lock:
+            self._goodput = goodput
 
     def set_fleettrace(self, fleettrace: Any) -> None:
         """Attach (or replace) the ``FleetTracer`` behind
@@ -393,6 +407,7 @@ def _make_handler(ops: OpsServer):
                                       "/debug/requests", "/debug/doctor",
                                       "/debug/profile", "/debug/plan",
                                       "/debug/fleet", "/debug/memory",
+                                      "/debug/goodput",
                                       "/debug/trace", "/debug/tail"],
                     })
                 else:

@@ -638,6 +638,69 @@ print(f"memory-audit smoke OK: {ml.ticks} ticks conserved exactly, "
       f"peak request {mem['peak_pages'].get('request', 0)} page(s))")
 PY
 
+# Goodput smoke (telemetry/goodput.py, ISSUE 19): a 2-replica plane
+# with the goodput ledger attached and a SEEDED replica_crash mid-run
+# — per-replica class-seconds must sum to alive wall EXACTLY (the
+# conservation contract at 1e-6), and the crash must mint exactly ONE
+# incident that closes at rejoin with MTTR > 0 and a positive
+# capacity-gap integral. The wall-attribution contract stays exercised
+# on every CI run before the tier proper.
+echo "== goodput smoke (conservation + seeded crash incident) =="
+env $JAX_SERVING_CACHE_ENV python - <<'PY'
+import tempfile
+
+from pipegoose_tpu.testing import force_cpu_devices
+
+force_cpu_devices(1)
+
+import jax
+
+from pipegoose_tpu.models import bloom
+from pipegoose_tpu.serving import Request, ServingEngine, make_skewed_replay
+from pipegoose_tpu.serving.control_plane import ControlPlane
+from pipegoose_tpu.telemetry import FlightRecorder
+from pipegoose_tpu.testing.chaos import ChaosMonkey, ChaosSchedule, Injection
+
+cfg = bloom.BloomConfig(vocab_size=64, hidden_size=32, n_layer=2, n_head=2)
+params = bloom.init_params(cfg, jax.random.PRNGKey(0))
+replay = make_skewed_replay(n_requests=8, n_prefixes=3, prefix_len=32,
+                            suffix_lens=(2, 4), max_new=3, vocab=64,
+                            seed=0, n_tenants=2)
+
+def factory(name, registry):
+    return ServingEngine(params, cfg, num_slots=1, num_pages=33,
+                         page_size=8, max_context=96, prefix_cache=True,
+                         registry=registry)
+
+recorder = FlightRecorder(tempfile.mkdtemp(), capacity=128)
+plane = ControlPlane(factory, n_replicas=2, policy="cache_aware",
+                     recorder=recorder, goodput=True)
+monkey = ChaosMonkey(
+    ChaosSchedule([Injection(4, "replica_crash", (("replica", 1),))]),
+    recorder=recorder)
+outs, metrics = plane.run(
+    [Request(prompt=p, max_new_tokens=m, tenant=t) for p, m, t in replay],
+    tick_hook=monkey.fleet_hook)
+assert len(outs) == 8, len(outs)
+plane.rejoin("replica1")
+cons = plane.goodput.conservation()
+assert cons["ok"] and cons["max_error_s"] <= 1e-6, cons
+incidents = plane.goodput.report()["incident_log"]
+assert len(incidents) == 1, incidents
+inc = incidents[0]
+assert inc["kind"] == "crash" and not inc["open"], inc
+assert inc["resolved_by"] == "rejoin" and inc["mttr_s"] > 0, inc
+assert inc["capacity_gap_integral_s"] > 0, inc
+assert inc["detection_latency_ticks"] == 0, inc
+gs = metrics["goodput"]
+assert gs["conservation_ok"] and 0 < gs["goodput_fraction"] <= 1, gs
+print(f"goodput smoke OK: {len(cons['replicas'])} replicas conserved "
+      f"exactly (max err {cons['max_error_s']:.1e}s), 1 crash incident "
+      f"MTTR {inc['mttr_s']*1e3:.1f}ms, gap integral "
+      f"{inc['capacity_gap_integral_s']*1e3:.1f} replica-ms, goodput "
+      f"{gs['goodput_fraction']:.0%}")
+PY
+
 echo "== fast tier =="
 python -m pytest tests/ -q -m fast -p no:cacheprovider \
     --continue-on-collection-errors "$@"

@@ -104,6 +104,7 @@ FAST_FILES = {
     "tests/telemetry/test_fleettrace.py",       # fleet trace stitching (ISSUE 17)
     "tests/telemetry/test_slo.py",              # SLO burn-rate monitor
     "tests/telemetry/test_memledger.py",        # memory ledger units (ISSUE 18)
+    "tests/telemetry/test_goodput.py",          # goodput ledger units (ISSUE 19)
     "tests/telemetry/test_opsserver.py",        # live ops endpoint
     "tests/telemetry/test_sentinel.py",         # perf-regression sentinel
     "tests/trainer/test_logger.py",             # rank-0 logging (host-only)
@@ -266,6 +267,11 @@ FAST_TESTS = {
     "tests/serving/test_kv_tier.py::test_attribution_sums_to_e2e_with_restore_phase",
     "tests/serving/test_kv_tier.py::test_host_tier_io_error_chaos_degrades_to_recompute",
     # live memory ledger (ISSUE 18): conservation + leak audit + forecast
+    # goodput ledger e2e (ISSUE 19): conservation on a seeded
+    # crash+rejoin replay, the chaos->incident join, and the off-path
+    # cost guard
+    "tests/serving/test_goodput_fleet.py::test_crash_rejoin_conservation_and_incident",
+    "tests/serving/test_goodput_fleet.py::test_goodput_flush_disabled_under_5us",
     "tests/serving/test_memory_ledger.py::test_conservation_exact_and_tokens_identical[int8-chunked-cache]",
     "tests/serving/test_memory_ledger.py::test_ledger_tick_disabled_under_5us",
     "tests/serving/test_memory_ledger.py::test_seeded_page_leak_fires_one_memory_leak_box",

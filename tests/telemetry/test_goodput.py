@@ -1,0 +1,414 @@
+"""Goodput-ledger unit contract (telemetry/goodput.py, ISSUE 19): the
+telescoping wall account conserves exactly by construction (per-replica
+class-seconds == alive wall), episodes merge into state bands, lifecycle
+gaps book by state, the classify priority tree orders the taxonomy,
+incidents join seeded chaos injections by ring distance and close with
+MTTR + SLO burn, transfer-flap bursts merge, the availability ratio SLO
+reads the monotone counters, the Perfetto renderer emits one band track
+per replica, the trainer mirror conserves fit wall and prices recovery
+rewinds, and the autoscaler's audit log is bounded with a drop count."""
+from collections import deque
+from types import SimpleNamespace
+
+import pytest
+
+from pipegoose_tpu.telemetry.chrometrace import (
+    PID_GOODPUT,
+    goodput_trace_events,
+)
+from pipegoose_tpu.telemetry.goodput import (
+    CLASSES,
+    GOOD_CLASSES,
+    GoodputLedger,
+    TrainerGoodput,
+    availability_slo_target,
+)
+from pipegoose_tpu.telemetry.registry import MetricsRegistry
+from pipegoose_tpu.telemetry.slo import SLOMonitor
+
+
+def _rep(state="serving", probation=0, programs_run=0, deferrals=0):
+    eng = SimpleNamespace(
+        programs_run=programs_run,
+        sched=SimpleNamespace(admission_deferrals=deferrals),
+        kv_tier=None,
+    )
+    return SimpleNamespace(state=SimpleNamespace(value=state),
+                           engine=eng, probation_ticks_left=probation)
+
+
+# --- wall attribution: conservation, episodes, lifecycle gaps --------------
+
+
+def test_telescoping_conservation_is_exact():
+    led = GoodputLedger()
+    led.touch("r0", 10.0, "serving", 0)
+    t = 10.0
+    for tick, klass in enumerate(
+            ["compile_warmup", "productive", "productive", "idle",
+             "stall", "idle"], start=1):
+        t += 0.125  # binary fractions: even float addition is exact
+        led.account("r0", t, klass, "serving", tick)
+    cons = led.conservation()
+    assert cons["ok"] and cons["max_error_s"] == 0.0
+    acct = led.replicas["r0"]
+    assert acct.alive_wall_s == pytest.approx(0.75)
+    assert sum(acct.classes.values()) == acct.alive_wall_s
+    tot = led.totals()
+    assert tot["productive_seconds"] == pytest.approx(0.25)
+    assert tot["badput_seconds"] == pytest.approx(0.5)
+    assert tot["fraction"] == pytest.approx(1 / 3)
+
+
+def test_episodes_merge_consecutive_same_class_and_state():
+    led = GoodputLedger()
+    led.touch("r0", 0.0, "serving", 0)
+    for tick, (klass, state) in enumerate(
+            [("productive", "serving"), ("productive", "serving"),
+             ("stall", "serving"), ("stall", "suspect"),
+             ("stall", "suspect")], start=1):
+        led.account("r0", float(tick), klass, state, tick)
+    eps = led.replicas["r0"].episodes
+    # 2 productive ticks merge, stall splits on the state flip
+    assert [(e["class"], e["state"], e["ticks"]) for e in eps] == [
+        ("productive", "serving", 2),
+        ("stall", "serving", 1),
+        ("stall", "suspect", 2),
+    ]
+    assert eps[0]["t0"] == 0.0 and eps[0]["t1"] == 2.0
+    # state dwell follows the state, not the class
+    assert led.state_seconds("r0") == {"serving": 3.0, "suspect": 2.0}
+
+
+def test_touch_books_lifecycle_gap_by_state():
+    led = GoodputLedger()
+    led.touch("r0", 0.0, "serving", 0)
+    led.account("r0", 1.0, "productive", "serving", 1)
+    # between-runs gap while FAILED: the gap is quarantine wall, and
+    # conservation still telescopes to the new mark
+    led.touch("r0", 3.0, "failed", 5)
+    acct = led.replicas["r0"]
+    assert acct.classes["failed_quarantine"] == pytest.approx(2.0)
+    assert led.conservation()["ok"]
+    # a touch that does not advance the clock books nothing
+    led.touch("r0", 2.5, "failed", 6)
+    assert acct.last_mark == 3.0
+
+
+def test_classify_priority_tree():
+    led = GoodputLedger()
+    pre = (0, 0, 0)
+    # terminal states outrank everything
+    assert led.classify(_rep("failed"), pre, True, True, True) \
+        == "failed_quarantine"
+    assert led.classify(_rep("draining"), pre, True, True, False) \
+        == "draining"
+    assert led.classify(_rep("stopped"), pre, False, False, False) \
+        == "draining"
+    # progress: first-compile detection rides the programs_run delta
+    assert led.classify(_rep(programs_run=1), pre, True, True, False) \
+        == "compile_warmup"
+    assert led.classify(_rep(), pre, True, True, False) == "productive"
+    assert led.classify(_rep(), pre, True, False, True) == "productive"
+    # no progress with work: suspect > admission_blocked > stall
+    assert led.classify(_rep("suspect"), pre, True, False, False) \
+        == "suspect_probing"
+    assert led.classify(_rep(deferrals=2), pre, True, False, False) \
+        == "admission_blocked"
+    assert led.classify(_rep(), pre, True, False, False) == "stall"
+    # no work: probation > suspect-idle > idle
+    assert led.classify(_rep(probation=3), pre, False, False, False) \
+        == "probation"
+    assert led.classify(_rep("suspect"), pre, False, False, False) \
+        == "suspect_probing"
+    assert led.classify(_rep(), pre, False, False, False) == "idle"
+    for klass in ("productive", "compile_warmup", "idle", "probation",
+                  "admission_blocked", "stall", "suspect_probing",
+                  "failed_quarantine", "draining"):
+        assert klass in CLASSES
+    assert GOOD_CLASSES == ("productive",)
+
+
+# --- incidents: lifecycle, injection joins, flap merge, bounds -------------
+
+
+def _ring(*records):
+    return SimpleNamespace(records=deque(records))
+
+
+def test_incident_mttr_gap_integral_and_slo_burn():
+    led = GoodputLedger()
+    led.touch("r0", 0.0, "serving", 0)
+    led.touch("r1", 0.0, "serving", 0)
+    led.account("r0", 1.0, "productive", "serving", 1)
+    led.account("r1", 1.0, "productive", "serving", 1)
+    led.on_tick(1, 1.0)
+    inc = led.open_incident("crash", "r1", 2, 2.0, reason="boom",
+                            capacity_gap=1)
+    assert inc.open and led.open_incidents == [inc]
+    # gap integral accrues tick wall while open — 2 ticks of 1s each
+    led.account("r0", 2.0, "productive", "serving", 2)
+    led.account("r1", 2.0, "failed_quarantine", "failed", 2)
+    led.on_tick(2, 2.0)
+    led.account("r0", 3.0, "productive", "serving", 3)
+    led.account("r1", 3.0, "failed_quarantine", "failed", 3)
+    led.on_tick(3, 3.0)
+    assert inc.capacity_gap_integral_s == pytest.approx(2.0)
+    closed = led.resolve_incident("r1", 12, 4.5, "rejoin")
+    assert closed is inc and not inc.open
+    assert inc.mttr_s == pytest.approx(2.5)
+    assert inc.mttr_ticks == 10
+    assert led.open_incidents == []
+    # SLO burn over the window: r1's 2 quarantine seconds were the
+    # only badput booked between open and close
+    assert inc.slo_burn["badput_s"] == pytest.approx(2.0)
+    assert inc.slo_burn["wall_s"] == pytest.approx(4.0)
+    assert inc.slo_burn["availability"] == pytest.approx(0.5)
+    d = inc.as_dict()
+    assert d["resolved_by"] == "rejoin" and d["reason"] == "boom"
+
+
+def test_injection_join_latency_is_ring_distance_and_claims_once():
+    rec = _ring(
+        {"ts": 0.0, "kind": "chaos.injection", "injection":
+         "replica_crash", "step": 4, "victim": "r1"},
+        {"ts": 0.0, "kind": "chaos.injection", "injection":
+         "replica_wedge", "step": 6, "victim": "r0"},
+        {"ts": 0.0, "kind": "other_noise"},
+    )
+    led = GoodputLedger()
+    a = led.open_incident("crash", "r1", 7, 7.0, recorder=rec,
+                          injection_kinds=("replica_crash",
+                                           "replica_wedge"))
+    assert a.detection_latency_ticks == 3 and a.injection_step == 4
+    # victim filter: r0's wedge record, not r1's already-claimed crash
+    b = led.open_incident("wedge", "r0", 9, 9.0, recorder=rec,
+                          injection_kinds=("replica_crash",
+                                           "replica_wedge"))
+    assert b.detection_latency_ticks == 3 and b.injection_step == 6
+    # ring exhausted: organic failure, no join
+    c = led.open_incident("crash", "r1", 11, 11.0, recorder=rec,
+                          injection_kinds=("replica_crash",
+                                           "replica_wedge"))
+    assert c.detection_latency_ticks is None and c.injection_step is None
+
+
+def test_injection_join_matches_victimless_records():
+    # transfer_flap injections carry no victim field — any replica's
+    # flap may claim them
+    rec = _ring({"ts": 0.0, "kind": "chaos.injection",
+                 "injection": "transfer_flap", "step": 2})
+    led = GoodputLedger()
+    inc = led.note_transfer_flap("r0", 5, 5.0, 3, recorder=rec)
+    assert inc.detection_latency_ticks == 3
+    assert not inc.open and inc.resolved_by == "fallback"
+    assert inc.mttr_s == 0.0 and inc.events == 3
+
+
+def test_transfer_flap_bursts_merge_into_one_incident():
+    led = GoodputLedger()
+    first = led.note_transfer_flap("r0", 5, 5.0, 2)
+    assert first is not None
+    # consecutive ticks extend the SAME incident
+    assert led.note_transfer_flap("r0", 6, 6.0, 1) is None
+    assert led.note_transfer_flap("r0", 7, 7.0, 1) is None
+    assert first.events == 4
+    # a gap starts a new episode; another replica is independent
+    second = led.note_transfer_flap("r0", 10, 10.0, 1)
+    assert second is not None and second is not first
+    assert led.note_transfer_flap("r1", 10, 10.0, 1) is not None
+    assert len(led.incidents) == 3
+
+
+def test_incident_log_bounded_with_drop_counter():
+    led = GoodputLedger(max_incidents=3)
+    for i in range(5):
+        inc = led.open_incident("crash", f"r{i}", i, float(i))
+        led.resolve_incident(f"r{i}", i, float(i), "rejoin")
+        assert inc is not None
+    assert len(led.incidents) == 3
+    assert led.incidents_dropped == 2
+    assert [i.replica for i in led.incidents] == ["r2", "r3", "r4"]
+
+
+def test_resolve_without_replica_closes_oldest_open():
+    led = GoodputLedger()
+    a = led.open_incident("crash", "r0", 1, 1.0)
+    b = led.open_incident("crash", "r1", 2, 2.0)
+    closed = led.resolve_incident(None, 3, 3.0, "scale_up")
+    assert closed is a and b.open
+    assert led.resolve_incident(None, 4, 4.0, "scale_up") is b
+    assert led.resolve_incident(None, 5, 5.0, "scale_up") is None
+
+
+# --- registry surface: gauges, monotone counters, availability SLO ---------
+
+
+def test_publish_gauges_and_monotone_counters():
+    reg = MetricsRegistry(enabled=True)
+    led = GoodputLedger(registry=reg)
+    led.touch("r0", 0.0, "serving", 0)
+    led.account("r0", 1.0, "productive", "serving", 1)
+    led.account("r0", 2.0, "stall", "serving", 2)
+    led.on_tick(2, 2.0)
+    snap = reg.snapshot()
+    assert snap["gauges"]["goodput.fraction"] == pytest.approx(0.5)
+    assert snap["gauges"]["goodput.productive_seconds"] \
+        == pytest.approx(1.0)
+    assert snap["gauges"]["goodput.badput.stall_seconds"] \
+        == pytest.approx(1.0)
+    assert snap["counters"]["goodput.badput_seconds_total"] \
+        == pytest.approx(1.0)
+    assert snap["counters"]["goodput.wall_seconds_total"] \
+        == pytest.approx(2.0)
+    # counters are deltas off high-water marks: a second publish with
+    # no new wall adds nothing
+    led.publish()
+    snap2 = reg.snapshot()
+    assert snap2["counters"]["goodput.wall_seconds_total"] \
+        == snap["counters"]["goodput.wall_seconds_total"]
+
+
+def test_availability_slo_target_breaches_on_badput_burn():
+    reg = MetricsRegistry(enabled=True)
+    led = GoodputLedger(registry=reg)
+    clock = [0.0]
+    mon = SLOMonitor([availability_slo_target(target=0.95)],
+                     registry=reg, clock=lambda: clock[0],
+                     fast_window_s=10.0, slow_window_s=100.0,
+                     burn_threshold=2.0)
+    mon.evaluate()
+    led.touch("r0", 0.0, "serving", 0)
+    led.account("r0", 10.0, "failed_quarantine", "failed", 1)
+    led.on_tick(1, 10.0)
+    clock[0] = 5.0
+    st = mon.evaluate()
+    t = st["targets"]["fleet_availability"]
+    assert t["bad_fraction_fast"] == pytest.approx(1.0)
+    assert t["breaching"]
+
+
+def test_availability_target_validates():
+    t = availability_slo_target(0.99)
+    assert t.kind == "ratio" and t.target == 0.99
+    with pytest.raises(ValueError):
+        availability_slo_target(1.0)
+
+
+# --- Perfetto state bands --------------------------------------------------
+
+
+def test_goodput_trace_events_render_bands_and_incident_markers():
+    led = GoodputLedger()
+    led.touch("r0", 0.0, "serving", 0)
+    led.account("r0", 1.0, "productive", "serving", 1)
+    led.account("r0", 2.0, "stall", "serving", 2)
+    led.touch("r1", 0.0, "serving", 0)
+    led.account("r1", 2.0, "idle", "serving", 2)
+    inc = led.open_incident("crash", "r1", 2, 1.5)
+    led.resolve_incident("r1", 4, 2.0, "rejoin")
+    evs = goodput_trace_events(led)
+    procs = [e for e in evs if e["name"] == "process_name"]
+    assert procs and all(e["pid"] == PID_GOODPUT for e in evs)
+    threads = {e["args"]["name"]: e["tid"] for e in evs
+               if e["name"] == "thread_name"}
+    assert set(threads) == {"r0", "r1"}
+    bands = [e for e in evs if e.get("cat") == "goodput.state"]
+    assert all(e["ph"] == "X" for e in bands)
+    r0_bands = [e for e in bands if e["tid"] == threads["r0"]]
+    assert [e["name"] for e in r0_bands] == ["productive", "stall"]
+    assert r0_bands[0]["ts"] == 0.0 and r0_bands[0]["dur"] == 1e6
+    marks = [e for e in evs if e.get("cat") == "goodput.incident"]
+    assert len(marks) == 1 and marks[0]["ph"] == "i"
+    assert marks[0]["name"] == "incident crash"
+    assert marks[0]["tid"] == threads["r1"]
+    assert marks[0]["args"]["mttr_s"] == inc.mttr_s
+
+
+# --- trainer mirror --------------------------------------------------------
+
+
+class _FakeTrainer:
+    def __init__(self, step=0):
+        self.state = SimpleNamespace(step=step)
+
+
+def test_trainer_goodput_partitions_fit_wall_and_prices_rewind():
+    clock = [0.0]
+    gp = TrainerGoodput(clock=lambda: clock[0])
+    tr = _FakeTrainer()
+    gp.on_fit_start(tr)
+
+    def run_step(step, dt, gap=0.25):
+        clock[0] += gap
+        gp.on_step_start(tr, step)
+        clock[0] += dt
+        gp.on_step_end(tr, step, 0.0)
+
+    run_step(1, 2.0)          # first step: compile_warmup
+    run_step(2, 0.5)          # steady state
+    gp.on_checkpoint(tr, 2, "/tmp/ck")
+    run_step(3, 0.5, gap=1.0)  # the 1.0s gap is checkpoint save wall
+    assert gp.classes["checkpoint_save"] == pytest.approx(1.0)
+    # recovery rewinds to step 2: the gap is restore, the re-run steps
+    # are rewind_replay badput, and one incident prices the episode
+    run_step(2, 0.5, gap=0.75)
+    assert gp.classes["restore"] == pytest.approx(0.75)
+    assert len(gp.incidents) == 1 and gp.incidents[0]["open"]
+    assert gp.incidents[0]["rewound_to"] == 2
+    assert gp.incidents[0]["step_detected"] == 3
+    run_step(3, 0.5)          # re-reaches high-water: incident closes
+    inc = gp.incidents[0]
+    assert not inc["open"] and inc["replayed_steps"] == 2
+    assert inc["mttr_s"] == pytest.approx(1.25)  # 0.5 + 0.25 + 0.5
+    run_step(4, 0.5)          # back to goodput
+    gp.on_fit_end(tr)
+    rep = gp.report()
+    assert rep["conservation_ok"], rep
+    assert rep["replayed_steps"] == 2
+    assert rep["classes"]["compile_warmup"] == pytest.approx(2.0)
+    assert rep["classes"]["rewind_replay"] == pytest.approx(1.0)
+    assert rep["classes"]["step_compute"] == pytest.approx(1.5)
+    total = sum(rep["classes"].values())
+    assert total == pytest.approx(rep["fit_wall_s"])
+    assert 0 < rep["goodput_fraction"] < 1
+
+
+def test_trainer_goodput_publishes_gauges_and_sorts_first():
+    reg = MetricsRegistry(enabled=True)
+    clock = [0.0]
+    gp = TrainerGoodput(clock=lambda: clock[0], registry=reg)
+    assert gp.order == -100  # books step wall before recovery/ckpt act
+    tr = _FakeTrainer()
+    gp.on_fit_start(tr)
+    clock[0] = 1.0
+    gp.on_step_start(tr, 1)
+    clock[0] = 2.0
+    gp.on_step_end(tr, 1, 0.0)
+    gp.on_fit_abort(tr, RuntimeError("x"))
+    snap = reg.snapshot()
+    assert "train.goodput.fraction" in snap["gauges"]
+    assert snap["gauges"]["train.goodput.compile_warmup_seconds"] \
+        == pytest.approx(1.0)
+
+
+# --- autoscaler audit-log bound (satellite) --------------------------------
+
+
+def test_autoscaler_log_bounded_with_dropped_counter():
+    from pipegoose_tpu.serving.control_plane import (
+        Autoscaler,
+        AutoscalerConfig,
+    )
+
+    mon = SimpleNamespace(evaluate=lambda now=None: {"targets": {}})
+    asc = Autoscaler(mon, AutoscalerConfig(cooldown_ticks=0,
+                                           max_replicas=100),
+                     max_log=4)
+    for tick in range(10):
+        # an uncompensated failure forces an "up" decision every tick
+        assert asc.decide(tick, n_serving=1, backlog=0,
+                          n_failed=1) == "up"
+    assert len(asc.log) == 4
+    assert asc.log_dropped == 6
+    assert [e["tick"] for e in asc.log] == [6, 7, 8, 9]  # newest kept

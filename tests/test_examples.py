@@ -26,7 +26,7 @@ ENV = {
 SERVING_DEMOS = {
     "serve_bloom.py", "request_trace_demo.py", "disagg_serving_demo.py",
     "quantized_serving_demo.py", "control_plane_demo.py",
-    "kv_tier_demo.py",
+    "kv_tier_demo.py", "goodput_demo.py",
 }
 CACHE_ENV = {
     "JAX_COMPILATION_CACHE_DIR": os.environ.get(
@@ -67,6 +67,8 @@ CASES = [
                                "--out-dir",
                                "/tmp/pipegoose_control_plane_demo_test"]),
     ("kv_tier_demo.py", ["--fake-devices", "8", "--requests", "4"]),
+    ("goodput_demo.py", ["--fake-devices", "8", "--requests", "8",
+                         "--out-dir", "/tmp/pipegoose_goodput_demo_test"]),
 ]
 
 
