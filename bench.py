@@ -554,8 +554,13 @@ def run_bench(force_cpu: bool) -> None:
             # quant arms (ISSUE 10): fp/int8w/int8kv/int8w+int8kv rows —
             # tokens/s + TTFT + the measured HBM/page-capacity ratios —
             # land in the same serving artifact every bench run
+            # paged-kernel arm (ISSUE 20): the fused Pallas
+            # paged-attention kernel vs the XLA gather on the same
+            # int8-pool workload — tokens/s, token identity, and the
+            # profiled decode-step compute/comm/idle split
             res = serving_ab_benchmark(sparams, scfg, specs,
-                                       quant_arms=True, **kw)
+                                       quant_arms=True, paged_kernel=True,
+                                       **kw)
             # KV memory hierarchy (ISSUE 16): an overflow replay whose
             # working set exceeds HBM pages, through LRU-recompute vs
             # host-tier restore vs cross-replica pull — hit rate, TTFT
@@ -963,6 +968,23 @@ def run_bench(force_cpu: bool) -> None:
                         smem["conservation_failures"],
                     "leaks": smem["leaks"],
                 }
+            # paged-attention kernel (ISSUE 20): both arms' profiled
+            # decode-step component fractions ride the trajectory row,
+            # so a kernel regression (compute share collapsing back
+            # toward the gather path's idle-dominated split, or the
+            # step wall ratio drifting) is machine-readable
+            if (isinstance(serving, dict)
+                    and isinstance(serving.get("paged_kernel"), dict)):
+                spk = serving["paged_kernel"]
+                row["serving_paged_kernel"] = {
+                    arm: {
+                        "step_wall_s": spk[arm]["step_wall_s"],
+                        "compute_fraction": spk[arm]["compute_fraction"],
+                        "comm_fraction": spk[arm]["comm_fraction"],
+                        "idle_fraction": spk[arm]["idle_fraction"],
+                    }
+                    for arm in ("gather", "paged") if arm in spk
+                } | {"summary": spk.get("summary")}
             # fleet goodput (ISSUE 19): availability fraction +
             # incident count per trajectory row — PerfSentinel can
             # watch goodput the same way it watches tokens/s

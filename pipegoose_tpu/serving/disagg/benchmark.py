@@ -62,12 +62,17 @@ def disagg_serving_benchmark(
         kv_dtype: Optional[str] = None,
         prefill_mesh=None, prefill_param_specs=None,
         decode_mesh=None, decode_param_specs=None,
-        tp_axis: str = "tensor"):
+        tp_axis: str = "tensor",
+        attn_kernel: str = "gather"):
     """Measure disagg vs monolithic on one trace (module docstring);
     returns a JSON-able dict with both arms, the transfer block, and
     the token-identity verdict. Pass ``prefill_mesh``/``decode_mesh``
     (+ matching param-spec trees) to put the pools on different
-    meshes — tp 2 -> 1 is the reshard the tests pin."""
+    meshes — tp 2 -> 1 is the reshard the tests pin.
+    ``attn_kernel="paged"`` routes BOTH arms (monolithic reference,
+    disagg prefill + decode pools) through the fused Pallas
+    paged-attention kernel — the disagg decode worker is the pool the
+    kernel is sized for."""
     vocab = getattr(config, "valid_vocab_size", None) or config.vocab_size
     replay = make_skewed_replay(
         n_requests=n_requests, n_prefixes=n_prefixes, prefix_len=prefix_len,
@@ -83,6 +88,7 @@ def disagg_serving_benchmark(
         prefix_cache=True, prefill_chunk=prefill_chunk,
         kv_dtype=kv_dtype, mesh=decode_mesh,
         param_specs=decode_param_specs, tp_axis=tp_axis,
+        attn_kernel=attn_kernel,
     )
     single.run(_requests(replay))           # cold warmup: compiles
     single.run(_requests(replay))           # warm warmup: hit paths
@@ -103,7 +109,7 @@ def disagg_serving_benchmark(
             prefix_cache=True, prefill_chunk=prefill_chunk,
             prefill_only=True, kv_dtype=kv_dtype, mesh=prefill_mesh,
             param_specs=prefill_param_specs, tp_axis=tp_axis,
-            registry=MetricsRegistry(),
+            registry=MetricsRegistry(), attn_kernel=attn_kernel,
         )
         de = ServingEngine(
             params, config, num_slots=num_slots, num_pages=decode_pages,
@@ -112,6 +118,7 @@ def disagg_serving_benchmark(
             kv_dtype=kv_dtype, mesh=decode_mesh,
             param_specs=decode_param_specs, tp_axis=tp_axis,
             registry=MetricsRegistry(), stall_patience=10_000,
+            attn_kernel=attn_kernel,
         )
         return DisaggEngine(pe, de, max_inflight=max_inflight,
                             registry=MetricsRegistry())
@@ -134,6 +141,7 @@ def disagg_serving_benchmark(
     results["summary"] = {
         "requests": n_requests,
         "kv_dtype": kv_dtype or "fp",
+        "attn_kernel": attn_kernel,
         "outputs_token_identical": bool(identical),
         # prefill off the decode pool's critical path: its measured
         # rate vs the monolithic arm's decode-only rate

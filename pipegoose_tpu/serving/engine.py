@@ -83,6 +83,7 @@ from pipegoose_tpu.models._decode import (
 from pipegoose_tpu.models.generate import forward_cached, init_cache
 from pipegoose_tpu.serving.kv_pool import (
     PagePool,
+    check_attn_impl,
     check_kv_dtype,
     copy_page,
     init_pages,
@@ -200,7 +201,8 @@ class ServingEngine:
                  host_tier=None,
                  host_tier_wire: Optional[str] = None,
                  cost_model=None,
-                 memledger=None):
+                 memledger=None,
+                 attn_kernel: str = "gather"):
         """``recorder``: optional ``telemetry.FlightRecorder`` — every
         decode step lands in its ring, and the no-decode-progress
         watchdog dumps a black box through it before raising.
@@ -249,7 +251,15 @@ class ServingEngine:
         (or ``True`` to construct one) — live byte-exact per-owner-
         class page accounting with leak audits and an exhaustion
         forecast. Default None keeps every pool event and tick at one
-        attribute read + branch (guard-tested < 5 µs)."""
+        attribute read + branch (guard-tested < 5 µs).
+
+        ``attn_kernel`` ("gather" | "paged", default "gather"): decode/
+        chunk attention implementation. "paged" routes every paged
+        program (decode step, speculative draft/verify, chunked
+        prefill) through the fused Pallas kernel
+        (ops/paged_attention.py) — one HBM pass over raw pages at wire
+        precision, no contiguous KV materialization. "gather" is the
+        two-pass XLA reference the kernel is parity-pinned against."""
         if max_context % page_size:
             raise ValueError("max_context must be a multiple of page_size")
         if prefill_only and prefill_chunk is None:
@@ -350,6 +360,8 @@ class ServingEngine:
             weight_dtype = None
         self.weight_dtype = weight_dtype
         self.kv_dtype = check_kv_dtype(kv_dtype)
+        check_attn_impl(attn_kernel)
+        self.attn_kernel = attn_kernel
         self.quant_spec = None
         if weight_dtype is not None:
             from pipegoose_tpu.quant import (
@@ -450,14 +462,15 @@ class ServingEngine:
 
             def _step(params, tokens, k_pages, v_pages, table, seq_lens):
                 logits, k_pages, v_pages = paged_decode_step(
-                    params, tokens, k_pages, v_pages, table, seq_lens, config
+                    params, tokens, k_pages, v_pages, table, seq_lens, config,
+                    attn_impl=attn_kernel,
                 )
                 return greedy_token(logits, mask_fn), k_pages, v_pages
 
             def _chunk(params, ids, k_pages, v_pages, table, start, n_valid):
                 logits, k_pages, v_pages = paged_prefill_chunk(
                     params, ids, k_pages, v_pages, table, start, n_valid,
-                    config,
+                    config, attn_impl=attn_kernel,
                 )
                 return greedy_token(logits, mask_fn), k_pages, v_pages
 
@@ -468,13 +481,14 @@ class ServingEngine:
                 logits, k_pages, v_pages = paged_decode_step(
                     params, tokens, k_pages, v_pages, table, seq_lens,
                     config, write_ok=ok, draft_layers=spec_k,
+                    attn_impl=attn_kernel,
                 )
                 return greedy_token(logits, mask_fn), k_pages, v_pages
 
             def _verify(params, ids, k_pages, v_pages, table, start, n_valid):
                 logits, k_pages, v_pages = paged_prefill_chunk(
                     params, ids, k_pages, v_pages, table, start, n_valid,
-                    config, all_logits=True,
+                    config, all_logits=True, attn_impl=attn_kernel,
                 )
                 return greedy_token(logits, mask_fn), k_pages, v_pages
 
@@ -512,7 +526,7 @@ class ServingEngine:
             def _step_body(params, tokens, k_pages, v_pages, table, seq_lens):
                 logits, k_pages, v_pages = paged_decode_step(
                     params, tokens, k_pages, v_pages, table, seq_lens,
-                    config, tp_axis,
+                    config, tp_axis, attn_impl=attn_kernel,
                 )
                 tok = global_greedy_pick(logits, tp_axis, valid)
                 return tok, k_pages, v_pages
@@ -521,7 +535,7 @@ class ServingEngine:
                             n_valid):
                 logits, k_pages, v_pages = paged_prefill_chunk(
                     params, ids, k_pages, v_pages, table, start, n_valid,
-                    config, tp_axis,
+                    config, tp_axis, attn_impl=attn_kernel,
                 )
                 tok = global_greedy_pick(logits, tp_axis, valid)
                 return tok, k_pages, v_pages
@@ -534,6 +548,7 @@ class ServingEngine:
                 logits, k_pages, v_pages = paged_decode_step(
                     params, tokens, k_pages, v_pages, table, seq_lens,
                     config, tp_axis, write_ok=ok, draft_layers=spec_k,
+                    attn_impl=attn_kernel,
                 )
                 tok = global_greedy_pick(logits, tp_axis, valid)
                 return tok, k_pages, v_pages
@@ -542,7 +557,7 @@ class ServingEngine:
                              n_valid):
                 logits, k_pages, v_pages = paged_prefill_chunk(
                     params, ids, k_pages, v_pages, table, start, n_valid,
-                    config, tp_axis, all_logits=True,
+                    config, tp_axis, all_logits=True, attn_impl=attn_kernel,
                 )
                 b, c, _ = logits.shape
                 tok = global_greedy_pick(
@@ -628,9 +643,24 @@ class ServingEngine:
                     "seq_lens"),
             mesh=self.mesh, large_bytes=large_bytes,
         )
+        if self.attn_kernel == "paged":
+            report.extras = {"paged_tile": self._paged_tile(n_queries=1)}
         set_doctor_gauges(report, registry=registry or self.registry)
         self.last_doctor_report = report   # /debug/doctor serves this
         return report
+
+    def _paged_tile(self, n_queries: int) -> dict:
+        """Chosen Pallas paged-attention tile geometry for this engine's
+        pool — logged into the doctor report (``extras["paged_tile"]``)
+        so the CI artifact records which VMEM footprint the feasibility
+        guard approved."""
+        from pipegoose_tpu.ops.paged_attention import paged_tile_geometry
+
+        head_dim = self.config.hidden_size // self.config.n_head
+        return paged_tile_geometry(
+            self.page_size, head_dim, n_queries,
+            quantized=self.kv_dtype == "int8",
+        )
 
     def doctor_chunk(self, large_bytes: int = 1 << 20, registry=None):
         """Same report for the compiled CHUNKED-PREFILL program — the
@@ -658,6 +688,8 @@ class ServingEngine:
                     "start", "n_valid"),
             mesh=self.mesh, large_bytes=large_bytes,
         )
+        if self.attn_kernel == "paged":
+            report.extras = {"paged_tile": self._paged_tile(n_queries=c)}
         set_doctor_gauges(report, registry=registry or self.registry)
         self.last_doctor_report = report
         return report
@@ -1681,7 +1713,8 @@ def _quant_arm_row(engine, outs, metrics):
 def serving_ab_benchmark(params, config, request_specs, *, num_slots=4,
                          num_pages=64, page_size=16, max_context=256,
                          mesh=None, param_specs=None, tp_axis="tensor",
-                         seed=0, quant_arms=False, **engine_kwargs):
+                         seed=0, quant_arms=False, paged_kernel=False,
+                         **engine_kwargs):
     """A/B the continuous-batching scheduler against naive padded
     batching on ONE model + request mix; returns a JSON-able dict.
 
@@ -1697,6 +1730,13 @@ def serving_ab_benchmark(params, config, request_specs, *, num_slots=4,
     int8w+int8kv (ROADMAP item 4): tokens/s, TTFT p50/p99, and the
     HBM + page-capacity numbers from ``memory_report()``, each pinned
     against the fp row of the same run.
+
+    ``paged_kernel=True`` adds a ``paged_kernel`` block A/B-ing the
+    fused Pallas paged-attention kernel against the XLA gather path on
+    the SAME int8-pool workload: tokens/s, measured wall, and the
+    ``profile()`` decode-step component split (compute/comm/idle
+    fractions — the kernel's regression surface for PerfSentinel),
+    plus the token-identity verdict and the chosen tile geometry.
     """
     rng = np.random.RandomState(seed)
     vocab = getattr(config, "valid_vocab_size", None) or config.vocab_size
@@ -1769,6 +1809,58 @@ def serving_ab_benchmark(params, config, request_specs, *, num_slots=4,
             ),
         }
         results["quant"] = quant
+    if paged_kernel:
+        paged: dict = {}
+        pk_kwargs = dict(engine_kwargs)
+        # the kernel's headline case is wire-precision int8 pages; an
+        # explicit kv_dtype in engine_kwargs still wins
+        pk_kv = pk_kwargs.pop("kv_dtype", "int8")
+        arm_outs = {}
+        for label in ("gather", "paged"):
+            engine = ServingEngine(
+                params, config, num_slots=num_slots, num_pages=num_pages,
+                page_size=page_size, max_context=max_context, mesh=mesh,
+                param_specs=param_specs, tp_axis=tp_axis, continuous=True,
+                kv_dtype=pk_kv, attn_kernel=label, **pk_kwargs,
+            )
+            engine.run(make_requests())          # warmup: compile
+            outs, metrics = engine.run(make_requests())
+            arm_outs[label] = outs
+            prof = engine.profile(steps=3, warmup=1)
+            row = {
+                "decode_tokens_per_s": metrics["decode_tokens_per_s"],
+                "decode_step_time_s": metrics["decode_step_time_s"],
+                "wall_time_s": metrics["wall_time_s"],
+                # measured decode-step attribution (telemetry/xprof.py):
+                # the component fractions PerfSentinel tracks as the
+                # kernel's regression surface
+                "step_wall_s": round(prof.wall_step_s, 6),
+                "compute_fraction": round(prof.compute_fraction, 4),
+                "comm_fraction": round(prof.comm_fraction, 4),
+                "idle_fraction": round(prof.idle_fraction, 4),
+            }
+            if "max_decode_gap_s" in metrics:
+                row["max_decode_gap_s"] = metrics["max_decode_gap_s"]
+            if label == "paged":
+                row["tile"] = engine._paged_tile(n_queries=1)
+            paged[label] = row
+        identical = all(
+            np.array_equal(a.generated, b.generated)
+            for a, b in zip(arm_outs["gather"], arm_outs["paged"])
+        )
+        paged["summary"] = {
+            "kv_dtype": pk_kv or "fp",
+            "outputs_token_identical": bool(identical),
+            "tokens_per_s_vs_gather": round(
+                paged["paged"]["decode_tokens_per_s"]
+                / max(paged["gather"]["decode_tokens_per_s"], 1e-9), 3,
+            ),
+            "step_wall_vs_gather": round(
+                paged["paged"]["step_wall_s"]
+                / max(paged["gather"]["step_wall_s"], 1e-9), 3,
+            ),
+        }
+        results["paged_kernel"] = paged
     return results
 
 

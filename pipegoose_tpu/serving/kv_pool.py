@@ -56,6 +56,7 @@ from jax import lax
 
 from pipegoose_tpu.models.bloom import NEG_INF, alibi_slopes, bloom_gelu, layer_norm, logits_fn
 from pipegoose_tpu.models.generate import _attn_core, _qkv_proj
+from pipegoose_tpu.ops.paged_attention import paged_attention
 from pipegoose_tpu.nn.tensor_parallel.layers import (
     column_parallel_linear,
     row_parallel_linear,
@@ -65,6 +66,15 @@ from pipegoose_tpu.nn.tensor_parallel.layers import (
 NULL_PAGE = 0
 
 KV_DTYPES = (None, "fp", "int8")
+
+ATTN_IMPLS = ("gather", "paged")
+
+
+def check_attn_impl(attn_impl: str) -> str:
+    if attn_impl not in ATTN_IMPLS:
+        raise ValueError(f"attn_impl must be one of {ATTN_IMPLS}, got "
+                         f"{attn_impl!r}")
+    return attn_impl
 
 _KV_INT8_MAX = 127.0
 
@@ -377,7 +387,8 @@ def _paged_bias(config, seq_lens, n_keys, tp_axis):
 
 def paged_decode_step(params, tokens, k_pages, v_pages, page_table, seq_lens,
                       config, tp_axis=None, write_ok=None,
-                      draft_layers: Optional[int] = None):
+                      draft_layers: Optional[int] = None,
+                      attn_impl: str = "gather"):
     """One decode step for every slot of the ragged active batch.
 
     ``tokens`` (B,) are the pending tokens (each slot's last emitted
@@ -399,10 +410,17 @@ def paged_decode_step(params, tokens, k_pages, v_pages, page_table, seq_lens,
     byte-identical values, since layer i's k/v depend only on the token
     sequence and layers < i).
 
+    ``attn_impl`` selects the attention read: ``"gather"`` (default)
+    materializes the page view (gather_pages + _attn_core, the parity
+    reference), ``"paged"`` walks the page table in one fused Pallas
+    pass (ops/paged_attention.py) — same mask/bias semantics, no
+    contiguous KV buffer, int8 pages dequantized in-register.
+
     Returns (logits (B, V_local), k_pages, v_pages). Under ``tp_axis``
     the logits are the LOCAL vocab shard — pair with
     ``_decode.global_greedy_pick`` like the sharded generate driver.
     """
+    check_attn_impl(attn_impl)
     b = tokens.shape[0]
     ps = page_size_of(k_pages)
     n_keys = page_table.shape[1] * ps
@@ -410,7 +428,11 @@ def paged_decode_step(params, tokens, k_pages, v_pages, page_table, seq_lens,
     x = vocab_parallel_embedding(params["embed"], tokens[:, None], tp_axis)
     x = x.astype(config.dtype)
     x = layer_norm(params["embed_ln"], x, config.layer_norm_epsilon)
-    bias = _paged_bias(config, seq_lens, n_keys, tp_axis)
+    if attn_impl == "paged":
+        slopes = _local_slopes(config, tp_axis)
+        bias = None
+    else:
+        bias = _paged_bias(config, seq_lens, n_keys, tp_axis)
 
     page_idx = seq_lens // ps
     off = seq_lens % ps
@@ -433,9 +455,14 @@ def paged_decode_step(params, tokens, k_pages, v_pages, page_table, seq_lens,
         q, k, v = _qkv_proj({"qkv": blk["attn"]["qkv"]}, ln1, config, tp_axis)
         kp = _write_kv(kp, phys, off, k[:, 0])
         vp = _write_kv(vp, phys, off, v[:, 0])
-        keys = gather_pages(kp, page_table)
-        vals = gather_pages(vp, page_table)
-        ctx = _attn_core(q, keys, vals, bias, None, h.dtype)
+        if attn_impl == "paged":
+            ctx = paged_attention(q, kp, vp, page_table, seq_lens,
+                                  slopes=slopes)
+            ctx = ctx.astype(h.dtype).reshape(b, 1, -1)
+        else:
+            keys = gather_pages(kp, page_table)
+            vals = gather_pages(vp, page_table)
+            ctx = _attn_core(q, keys, vals, bias, None, h.dtype)
         h = h + row_parallel_linear(blk["attn"]["out"], ctx, tp_axis)
         ln2 = layer_norm(blk["ln_2"], h, config.layer_norm_epsilon)
         up = column_parallel_linear(blk["mlp"]["up"], ln2, tp_axis)
@@ -514,7 +541,8 @@ def copy_page(k_pages, v_pages, src, dst):
 
 
 def paged_prefill_chunk(params, tokens, k_pages, v_pages, page_table, start,
-                        n_valid, config, tp_axis=None, all_logits=False):
+                        n_valid, config, tp_axis=None, all_logits=False,
+                        attn_impl: str = "gather"):
     """Forward one CHUNK of C tokens per row straight through the pool.
 
     The prefill half of a chunked-prefill mixed step: ``tokens`` (B, C)
@@ -533,7 +561,15 @@ def paged_prefill_chunk(params, tokens, k_pages, v_pages, page_table, start,
     prefill needs — or at EVERY chunk position, (B, C, V_local), with
     ``all_logits=True`` (self-speculative verification scores the whole
     draft bundle in one pass through this same paged path).
+
+    ``attn_impl="paged"`` routes the attention read through the fused
+    Pallas page-table walk (ops/paged_attention.py) in its ragged
+    multi-token mode — the same kernel the decode step uses, with
+    ``start`` as the per-row global query origin; pad queries beyond
+    ``n_valid`` are zeroed by the same qmask multiply as the gather
+    path.
     """
+    check_attn_impl(attn_impl)
     b, c = tokens.shape
     ps = page_size_of(k_pages)
     n_keys = page_table.shape[1] * ps
@@ -550,12 +586,15 @@ def paged_prefill_chunk(params, tokens, k_pages, v_pages, page_table, start,
     dest_off = jnp.where(valid, pos % ps, 0)
 
     slopes = _local_slopes(config, tp_axis)
-    key_pos = jnp.arange(n_keys)
-    keep = key_pos[None, None, :] <= pos[:, :, None]          # (B, C, K)
-    bias = slopes[None, :, None, None] * key_pos[None, None, None, :].astype(
-        jnp.float32
-    )
-    bias = bias + jnp.where(keep[:, None, :, :], 0.0, NEG_INF)
+    if attn_impl == "paged":
+        bias = None
+    else:
+        key_pos = jnp.arange(n_keys)
+        keep = key_pos[None, None, :] <= pos[:, :, None]      # (B, C, K)
+        bias = slopes[None, :, None, None] * key_pos[
+            None, None, None, :
+        ].astype(jnp.float32)
+        bias = bias + jnp.where(keep[:, None, :, :], 0.0, NEG_INF)
     qmask = valid
 
     def scan_fn(carry, blk_and_pages):
@@ -565,9 +604,15 @@ def paged_prefill_chunk(params, tokens, k_pages, v_pages, page_table, start,
         q, k, v = _qkv_proj({"qkv": blk["attn"]["qkv"]}, ln1, config, tp_axis)
         kp = _write_kv(kp, dest_page, dest_off, k)
         vp = _write_kv(vp, dest_page, dest_off, v)
-        keys = gather_pages(kp, page_table)
-        vals = gather_pages(vp, page_table)
-        ctx = _attn_core(q, keys, vals, bias, qmask, h.dtype)
+        if attn_impl == "paged":
+            ctx = paged_attention(q, kp, vp, page_table, start,
+                                  slopes=slopes)
+            ctx = ctx * qmask[:, :, None, None].astype(ctx.dtype)
+            ctx = ctx.astype(h.dtype).reshape(b, c, -1)
+        else:
+            keys = gather_pages(kp, page_table)
+            vals = gather_pages(vp, page_table)
+            ctx = _attn_core(q, keys, vals, bias, qmask, h.dtype)
         h = h + row_parallel_linear(blk["attn"]["out"], ctx, tp_axis)
         ln2 = layer_norm(blk["ln_2"], h, config.layer_norm_epsilon)
         up = column_parallel_linear(blk["mlp"]["up"], ln2, tp_axis)

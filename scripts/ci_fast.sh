@@ -701,6 +701,60 @@ print(f"goodput smoke OK: {len(cons['replicas'])} replicas conserved "
       f"{gs['goodput_fraction']:.0%}")
 PY
 
+# Paged-attention kernel smoke (ops/paged_attention.py, ISSUE 20):
+# one int8 decode step through the paged one-pass attention (off-TPU
+# auto mode: the compiled XLA lane of the kernel's algorithm) must
+# match the XLA gather reference's logits (allclose) and greedy token
+# exactly, and the VMEM feasibility guard must REFUSE an oversized
+# tile for compiled runs instead of silently falling back to gather. (The tp=2 zero-resharding pin on the kernel step rides the
+# mesh-doctor --serving gate above — its serving reports now include
+# the paged decode/chunk programs.)
+echo "== paged-attention kernel smoke (int8 parity + VMEM guard) =="
+env $JAX_SERVING_CACHE_ENV python - <<'PY'
+from pipegoose_tpu.testing import force_cpu_devices
+
+force_cpu_devices(1)
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pipegoose_tpu.models import bloom
+from pipegoose_tpu.ops import check_paged_tile
+from pipegoose_tpu.serving.kv_pool import (
+    init_pages,
+    paged_decode_step,
+    paged_prefill_chunk,
+)
+
+cfg = bloom.BloomConfig(vocab_size=64, hidden_size=64, n_layer=2, n_head=4)
+params = bloom.init_params(cfg, jax.random.PRNGKey(0))
+rng = np.random.RandomState(3)
+kp, vp = init_pages(cfg, 16, 4, kv_dtype="int8")
+pt = jnp.asarray(rng.permutation(np.arange(1, 16))[:8][None], jnp.int32)
+ids = jnp.asarray(rng.randint(1, 64, (1, 7)), jnp.int32)
+n_valid = jnp.asarray([7], jnp.int32)
+_, kp, vp = paged_prefill_chunk(params, ids, kp, vp, pt,
+                                jnp.zeros((1,), jnp.int32), n_valid, cfg)
+tok = jnp.asarray(rng.randint(1, 64, (1,)), jnp.int32)
+ref, _, _ = paged_decode_step(params, tok, kp, vp, pt, n_valid, cfg)
+out, _, _ = paged_decode_step(params, tok, kp, vp, pt, n_valid, cfg,
+                              attn_impl="paged")
+err = float(jnp.max(jnp.abs(ref - out)))
+assert err < 1e-4, f"kernel diverged from gather: max |dlogits| = {err}"
+assert int(jnp.argmax(ref, -1)[0]) == int(jnp.argmax(out, -1)[0])
+# the guard refuses an infeasible tile loudly for compiled runs and
+# stays exempt in interpret mode (the interpreter has no VMEM limit)
+try:
+    check_paged_tile(4096, 4096, 1, quantized=True, interpret=False)
+    raise SystemExit("VMEM guard accepted an impossible tile")
+except ValueError as e:
+    assert "VMEM" in str(e), e
+check_paged_tile(4096, 4096, 1, quantized=True, interpret=True)
+print(f"paged kernel smoke OK: int8 decode step token-identical "
+      f"(max |dlogits| {err:.1e}), VMEM guard raises on oversized tile")
+PY
+
 echo "== fast tier =="
 python -m pytest tests/ -q -m fast -p no:cacheprovider \
     --continue-on-collection-errors "$@"

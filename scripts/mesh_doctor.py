@@ -67,7 +67,11 @@ def build_serving_reports(args, ctx, cfg, params, bloom):
     """Decode step AND the chunked-prefill program of the mixed step
     (prefix cache + chunking on): ISSUE 6 pins BOTH at zero
     partitioner-inserted resharding, so a PartitionSpec regression in
-    either half of the serving tick dies here at compile time."""
+    either half of the serving tick dies here at compile time. The
+    fused paged-attention variants (ISSUE 20, int8 pool — the kernel's
+    headline case) are pinned the same way: the Pallas call must lower
+    under the head-sharded mesh without the partitioner moving a page,
+    and their reports log the tile geometry the VMEM guard approved."""
     from pipegoose_tpu.serving import ServingEngine
 
     engine = ServingEngine(
@@ -75,9 +79,18 @@ def build_serving_reports(args, ctx, cfg, params, bloom):
         max_context=32, mesh=ctx.mesh, param_specs=bloom.tp_specs(params),
         prefix_cache=True, prefill_chunk=16,
     )
+    paged = ServingEngine(
+        params, cfg, num_slots=2, num_pages=16, page_size=8,
+        max_context=32, mesh=ctx.mesh, param_specs=bloom.tp_specs(params),
+        prefix_cache=True, prefill_chunk=16, kv_dtype="int8",
+        attn_kernel="paged",
+    )
     return {
         "decode_step": engine.doctor(large_bytes=args.large_bytes),
         "prefill_chunk": engine.doctor_chunk(large_bytes=args.large_bytes),
+        "decode_step_paged": paged.doctor(large_bytes=args.large_bytes),
+        "prefill_chunk_paged": paged.doctor_chunk(
+            large_bytes=args.large_bytes),
     }
 
 

@@ -14,6 +14,10 @@ RTT subtracted. One attach per run (tunnel is single-client).
         # int8w / int8kv / int8w+int8kv arms (tokens/s, TTFT, HBM,
         # page-capacity ratio vs the fp rows); composes with
         # --prefix-replay
+    python scripts/sweep_tpu_perf.py serving --paged   # ISSUE 20: add
+        # the fused Pallas paged-attention arm (gather vs kernel
+        # tokens/s + profiled decode-step component split at the
+        # bloom-560m geometry)
     python scripts/sweep_tpu_perf.py plan   # ISSUE 7: static layout
         # ranking (pipegoose_tpu/planner/), then measure ONLY the
         # top-K (PLAN_TOP_K) and record predicted-vs-measured deltas
@@ -535,7 +539,7 @@ def disagg_sweep():
 
 
 def serving_sweep(prefix_replay: bool = False, quant: bool = False,
-                  tiered: bool = False):
+                  tiered: bool = False, paged: bool = False):
     """Continuous-batching vs naive padded serving (serving/engine.py)
     across slot counts on the real chip: the decode-step savings grow
     with the slot count as long as the mixed-length workload keeps
@@ -559,7 +563,13 @@ def serving_sweep(prefix_replay: bool = False, quant: bool = False,
     set > HBM pages) through LRU-evict-and-recompute vs host-tier
     restore vs cross-replica pull — hit rate, TTFT p99, and the
     recompute-token reduction per slot count. Implies
-    ``--prefix-replay``."""
+    ``--prefix-replay``.
+
+    ``--paged`` (ISSUE 20) adds the fused Pallas paged-attention arm
+    to the A/B workload at the bloom-560m geometry: gather vs kernel
+    decode tokens/s, token identity, and the profiled decode-step
+    compute/comm/idle split per slot count — the on-hardware numbers
+    the bench.py CPU smoke is a stand-in for."""
     from pipegoose_tpu.models import bloom
     from pipegoose_tpu.serving import (
         prefix_replay_benchmark,
@@ -596,7 +606,7 @@ def serving_sweep(prefix_replay: bool = False, quant: bool = False,
                 results[label] = serving_ab_benchmark(
                     params, cfg, specs, num_slots=slots,
                     num_pages=1 + 3 * slots, page_size=32, max_context=128,
-                    quant_arms=quant,
+                    quant_arms=quant, paged_kernel=paged,
                 )
         except Exception as e:  # noqa: BLE001
             results[label] = {"error": f"{type(e).__name__}: {e}"[:300]}
@@ -628,6 +638,7 @@ if __name__ == "__main__":
             prefix_replay="--prefix-replay" in sys.argv[2:],
             quant="--quant" in sys.argv[2:],
             tiered="--tiered" in sys.argv[2:],
+            paged="--paged" in sys.argv[2:],
         )
     # telemetry JSONL artifact (the serving sweep's engines emit their
     # per-step time series into it; every mode gets a final snapshot) —

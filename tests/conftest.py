@@ -284,6 +284,15 @@ FAST_TESTS = {
     # tier-1
     "tests/serving/test_fleet_trace.py::test_crash_salvage_conservation[fp]",
     "tests/serving/test_fleet_trace.py::test_host_stall_slo_exemplar_names_dominant_hop",
+    # fused paged attention (ISSUE 20): kernel-vs-gather parity on the
+    # quantized pool, the loud VMEM guard, the partial-last-page edge
+    # case through the kernel, and the engine's int8 warm/cold greedy
+    # identity (the tp2 cells, spec/mixed-page cells, and the profile
+    # rank pin stay tier-1; ci_fast.sh runs a dedicated kernel smoke)
+    "tests/ops/test_paged_attention.py::test_kernel_matches_gather_reference[int8]",
+    "tests/ops/test_paged_attention.py::test_guard_raises_compiled_exempt_interpret",
+    "tests/serving/test_paged_kernel.py::test_partial_last_page_decode_parity[int8]",
+    "tests/serving/test_paged_kernel.py::test_greedy_parity_cold_and_warm[int8]",
 }
 
 
@@ -467,6 +476,39 @@ SLOW_TESTS = {
     # tests/planner/test_serving_plan.py (precedent: six other demos)
     "tests/serving/test_quantized.py::test_greedy_parity_single_device[int4w]",
     "tests/test_examples.py::test_example_runs[quantized_serving_demo.py]",
+    # fused paged attention (ISSUE 20): the profile rank-agreement e2e
+    # profiles two real compiled engines and asserts measured rank
+    # agreement — the same load-sensitive shape as the calibration
+    # closes-the-loop e2e above (rank between near-equal walls flips
+    # under box contention); the deterministic siblings stay tier-1
+    # (the doctor tile pin, the engine parity matrix) and the bench
+    # paged_kernel arm records the same split every run. The fp twins
+    # of the cold/warm and mixed-page cells move out too — their int8
+    # cells (the kernel's headline pool) stay tier-1/fast, and fp
+    # engine coverage stays tier-1 via the tp2[fp] cell and the fp
+    # kv_pool edge-case nodes
+    "tests/serving/test_paged_kernel.py::test_profile_and_live_step_walls_rank_consistently",
+    "tests/serving/test_paged_kernel.py::test_greedy_parity_cold_and_warm[fp]",
+    "tests/serving/test_paged_kernel.py::test_mixed_imported_and_local_pages_parity[fp]",
+    # third re-curation pass from measured durations (the full
+    # `not slow` run measured 868s against the 870s wall after the
+    # ISSUE 20 suite landed — zero headroom for box drift): the three
+    # heaviest redundant MULTI-STEP nodes move out, each keeping
+    # cheaper tier-1/fast siblings —
+    # * seeded chaos loss-trajectory twin runs: determinism is pinned
+    #   byte-identical by the fast-tier schedule nodes
+    #   (test_chaos_schedule_new_kinds_seeded_byte_identical) and every
+    #   chaos-injection e2e asserts its own seeded detection
+    "tests/testing/test_chaos.py::test_same_seed_same_injections_same_loss_trajectory",
+    # * overlap hybrid full-run vs monolithic: the overlap ACCEPTANCE
+    #   pins stay fast-tier (layer parity[2], the compiled
+    #   ppermute/zero-resharding doctor pin) and tier-1 keeps the int8
+    #   payload-bytes drop + short-run tracks-fp32 siblings
+    "tests/test_comm_hybrid.py::test_overlap_hybrid_matches_monolithic",
+    # * hybrid demo: the 3D/4D training equivalences it walks are
+    #   tier-1-pinned directly (test_3d_parallel/test_4d_parallel fast
+    #   nodes, test_hybrid) — precedent: eight other demos above
+    "tests/test_examples.py::test_example_runs[hybrid_parallelism.py]",
 }
 
 
